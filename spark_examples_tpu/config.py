@@ -1,0 +1,212 @@
+"""CLI configuration: the reference's flag grammar, preserved.
+
+``GenomicsConf`` mirrors ``GenomicsConf.scala:29-64`` and ``PcaConf`` mirrors
+``GenomicsConf.scala:66-98``. The flag surface is the API contract
+(``BASELINE.md``): names, defaults, and the ``--references`` grammar
+(``ref:start:end,...`` — one list per variant set) are identical. TPU-specific
+additions are kept separate and optional:
+
+- ``--source {synthetic,rest}``: which genomics backend to stream from (the
+  reference always hit the live Google Genomics API, which no longer exists);
+- ``--pca-backend {tpu,host}``: device pipeline vs. pure-NumPy reference
+  implementation (the BASELINE.json north-star flag);
+- ``--mesh-shape``: devices for the data×samples mesh; by analogy with the
+  reference, ``--num-reduce-partitions`` bounds the data-axis size when
+  ``--mesh-shape`` is not given (BASELINE.json maps the Spark reduce
+  parallelism onto the device mesh).
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+from spark_examples_tpu.constants import GoogleGenomicsPublicData
+from spark_examples_tpu.sharding.contig import (
+    BRCA1,
+    DEFAULT_BASES_PER_SHARD,
+    Contig,
+    SexChromosomeFilter,
+    parse_contigs,
+)
+
+
+def _build_base_parser(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    parser.add_argument(
+        "--bases-per-partition",
+        type=int,
+        default=DEFAULT_BASES_PER_SHARD,
+        help="Partition each reference using a fixed number of bases",
+    )
+    parser.add_argument("--client-secrets", default="client_secrets.json")
+    parser.add_argument("--input-path", default=None)
+    parser.add_argument(
+        "--num-reduce-partitions",
+        type=int,
+        default=10,
+        help=(
+            "Set it to a number greater than the number of cores, to achieve "
+            "maximum throughput. Maps onto the device-mesh data axis."
+        ),
+    )
+    parser.add_argument("--output-path", default=None)
+    parser.add_argument(
+        "--references",
+        default=BRCA1,
+        help=(
+            "Comma separated tuples of reference:start:end,... one list of "
+            "tuples should be specified per variantset in the corresponding "
+            "order (lists separated by ';')."
+        ),
+    )
+    parser.add_argument(
+        "--spark-master",
+        default=None,
+        help="Accepted for flag compatibility with the reference; unused.",
+    )
+    parser.add_argument(
+        "--variant-set-id",
+        default=GoogleGenomicsPublicData.THOUSAND_GENOMES_PHASE_1,
+        help="Comma-separated list of VariantSetIds to use in the analysis.",
+    )
+    # TPU-native additions.
+    parser.add_argument(
+        "--source",
+        choices=["synthetic", "rest"],
+        default="synthetic",
+        help="Genomics backend to stream from.",
+    )
+    parser.add_argument(
+        "--num-samples",
+        type=int,
+        default=2504,
+        help="Synthetic-source cohort size (1KG phase 1 has 2,504 samples).",
+    )
+    parser.add_argument(
+        "--seed", type=int, default=42, help="Synthetic-source base seed."
+    )
+    return parser
+
+
+@dataclass
+class GenomicsConf:
+    """Parsed base flags (``GenomicsConf.scala:29-64``)."""
+
+    bases_per_partition: int = DEFAULT_BASES_PER_SHARD
+    client_secrets: str = "client_secrets.json"
+    input_path: Optional[str] = None
+    num_reduce_partitions: int = 10
+    output_path: Optional[str] = None
+    references: str = BRCA1
+    spark_master: Optional[str] = None
+    variant_set_id: List[str] = field(
+        default_factory=lambda: [GoogleGenomicsPublicData.THOUSAND_GENOMES_PHASE_1]
+    )
+    source: str = "synthetic"
+    num_samples: int = 2504
+    seed: int = 42
+
+    @classmethod
+    def parse(cls, argv: Sequence[str]) -> "GenomicsConf":
+        parser = _build_base_parser(argparse.ArgumentParser())
+        ns = parser.parse_args(list(argv))
+        return cls._from_namespace(ns)
+
+    @classmethod
+    def _from_namespace(cls, ns: argparse.Namespace) -> "GenomicsConf":
+        conf = cls()
+        for f in conf.__dataclass_fields__:
+            if hasattr(ns, f):
+                setattr(conf, f, getattr(ns, f))
+        if isinstance(conf.variant_set_id, str):
+            conf.variant_set_id = [
+                v for v in conf.variant_set_id.split(",") if v.strip()
+            ]
+        return conf
+
+    def get_references(self) -> List[List[Contig]]:
+        """One contig list per variant set (``GenomicsConf.scala:59-63``).
+
+        The reference passes one ``--references`` list per variant set in
+        order; we use ';' to separate the per-variantset lists and ',' within
+        a list, mirroring the documented grammar.
+        """
+        return [parse_contigs(spec) for spec in self.references.split(";")]
+
+
+@dataclass
+class PcaConf(GenomicsConf):
+    """PCA flags (``GenomicsConf.scala:70-98``)."""
+
+    all_references: bool = False
+    debug_datasets: bool = False
+    min_allele_frequency: Optional[float] = None
+    num_pc: int = 2
+    pca_backend: str = "tpu"
+    mesh_shape: Optional[str] = None
+    block_size: int = 1024
+
+    EXCLUDE_XY = SexChromosomeFilter.EXCLUDE_XY
+
+    @classmethod
+    def parse(cls, argv: Sequence[str]) -> "PcaConf":
+        parser = _build_base_parser(argparse.ArgumentParser())
+        parser.add_argument(
+            "--all-references",
+            action="store_true",
+            help=(
+                "Use all references (except X and Y) to compute PCA "
+                "(overrides --references)."
+            ),
+        )
+        parser.add_argument("--debug-datasets", action="store_true")
+        parser.add_argument("--min-allele-frequency", type=float, default=None)
+        parser.add_argument("--num-pc", type=int, default=2)
+        parser.add_argument(
+            "--pca-backend",
+            choices=["tpu", "host"],
+            default="tpu",
+            help="Similarity/PCA compute path: device pipeline or NumPy host path.",
+        )
+        parser.add_argument(
+            "--mesh-shape",
+            default=None,
+            help="Device mesh as 'data,samples' (e.g. '4,2'). Default: all "
+            "devices on the data axis, capped by --num-reduce-partitions.",
+        )
+        parser.add_argument(
+            "--block-size",
+            type=int,
+            default=1024,
+            help="Variants per device block in the Gramian accumulation.",
+        )
+        ns = parser.parse_args(list(argv))
+        return cls._from_namespace(ns)
+
+    def get_contigs(self, source, variant_set_ids: Sequence[str]) -> List[Contig]:
+        """Contigs for all datasets (``GenomicsConf.scala:83-97``).
+
+        ``--all-references`` asks the source for every contig in each variant
+        set, excluding X/Y; otherwise the per-variantset ``--references``
+        lists are parsed positionally.
+        """
+        print(f"Running PCA on {len(variant_set_ids)} datasets.")
+        contigs: List[Contig] = []
+        if self.all_references:
+            for variant_set_id in variant_set_ids:
+                print(f"Variantset: {variant_set_id}; All refs, exclude XY")
+                contigs.extend(
+                    source.get_contigs(variant_set_id, SexChromosomeFilter.EXCLUDE_XY)
+                )
+        else:
+            reference_lists = self.references.split(";")
+            if len(reference_lists) == 1:
+                reference_lists = reference_lists * len(variant_set_ids)
+            for variant_set_id, spec in zip(variant_set_ids, reference_lists):
+                print(f"Variantset: {variant_set_id}; Refs: {spec}")
+                contigs.extend(parse_contigs(spec))
+        return contigs
+
+
+__all__ = ["GenomicsConf", "PcaConf"]

@@ -1,0 +1,12 @@
+from spark_examples_tpu.models.variant import Call, Variant, VariantKey, VariantsBuilder
+from spark_examples_tpu.models.read import Read, ReadKey, ReadBuilder
+
+__all__ = [
+    "Call",
+    "Variant",
+    "VariantKey",
+    "VariantsBuilder",
+    "Read",
+    "ReadKey",
+    "ReadBuilder",
+]

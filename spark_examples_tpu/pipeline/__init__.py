@@ -1,0 +1,4 @@
+from spark_examples_tpu.pipeline.stats import VariantsDatasetStats
+from spark_examples_tpu.pipeline.datasets import ReadsDataset, VariantsDataset
+
+__all__ = ["VariantsDatasetStats", "VariantsDataset", "ReadsDataset"]

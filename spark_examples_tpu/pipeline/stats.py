@@ -1,0 +1,61 @@
+"""I/O statistics accumulators.
+
+The reference tracks ingest health with six Spark accumulators flushed from
+executors (``rdd/VariantsRDD.scala:152-172``) and pretty-prints them at the
+end of a run (``VariantsPca.scala:321-326``). Without Spark, the host
+streaming loop is in-process (or one process per host under
+``jax.distributed``), so the accumulators are plain counters aggregated by
+the dataset layer; the report format is kept identical so runs are
+comparable line-for-line.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from spark_examples_tpu.sources.base import ClientCounters
+
+
+class VariantsDatasetStats:
+    """Mirror of ``VariantsRddStats`` (``rdd/VariantsRDD.scala:152-172``)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.partitions = 0
+        self.reference_bases = 0
+        self.requests = 0
+        self.unsuccessful_responses = 0
+        self.io_exceptions = 0
+        self.variants = 0
+
+    def add_partition(self, reference_bases: int) -> None:
+        with self._lock:
+            self.partitions += 1
+            self.reference_bases += int(reference_bases)
+
+    def add_variants(self, n: int) -> None:
+        with self._lock:
+            self.variants += int(n)
+
+    def add_client(self, counters: ClientCounters) -> None:
+        """Flush a per-partition client's counters
+        (``rdd/VariantsRDD.scala:192-196``)."""
+        with self._lock:
+            self.requests += counters.initialized_requests
+            self.unsuccessful_responses += counters.unsuccessful_responses
+            self.io_exceptions += counters.io_exceptions
+
+    def __str__(self) -> str:
+        return (
+            "Variants API stats:\n"
+            "-------------------------------\n"
+            f"# of partitions: {self.partitions}\n"
+            f"# of bases requested: {self.reference_bases}\n"
+            f"# of variants read: {self.variants}\n"
+            f"# of API requests: {self.requests}\n"
+            f"# of unsuccessful responses: {self.unsuccessful_responses}\n"
+            f"# of IO exceptions: {self.io_exceptions}\n"
+        )
+
+
+__all__ = ["VariantsDatasetStats"]

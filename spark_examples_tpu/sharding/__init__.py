@@ -1,0 +1,31 @@
+from spark_examples_tpu.sharding.contig import (
+    BRCA1,
+    DEFAULT_BASES_PER_SHARD,
+    Contig,
+    SexChromosomeFilter,
+    parse_contigs,
+)
+from spark_examples_tpu.sharding.partitioners import (
+    FixedSplits,
+    ReadsPartition,
+    ReadsPartitioner,
+    SequenceSplitter,
+    TargetSizeSplits,
+    VariantsPartition,
+    VariantsPartitioner,
+)
+
+__all__ = [
+    "BRCA1",
+    "DEFAULT_BASES_PER_SHARD",
+    "Contig",
+    "SexChromosomeFilter",
+    "parse_contigs",
+    "FixedSplits",
+    "ReadsPartition",
+    "ReadsPartitioner",
+    "SequenceSplitter",
+    "TargetSizeSplits",
+    "VariantsPartition",
+    "VariantsPartitioner",
+]

@@ -1,0 +1,19 @@
+from spark_examples_tpu.sources.base import (
+    ClientCounters,
+    GenomicsClient,
+    GenomicsSource,
+    OfflineAuth,
+    ShardBoundary,
+    get_access_token,
+)
+from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource
+
+__all__ = [
+    "ClientCounters",
+    "GenomicsClient",
+    "GenomicsSource",
+    "OfflineAuth",
+    "ShardBoundary",
+    "get_access_token",
+    "SyntheticGenomicsSource",
+]

@@ -1,0 +1,169 @@
+"""Google Genomics v1beta2 REST backend.
+
+The real-API counterpart of the reference's ``Client`` + ``Paginator``
+(``Client.scala:42-54``; paging loop behavior of
+``Paginator.Variants.create(...).search(req)`` at
+``rdd/VariantsRDD.scala:201-207``): POST search requests, follow
+``nextPageToken`` until exhausted, apply the shard-boundary filter
+client-side, and count requests / unsuccessful responses / IO exceptions.
+
+This environment has no network egress and the v1beta2 API itself has been
+sunset, so this backend exists for API-shape parity and for deployments that
+point ``base_url`` at a live, compatible endpoint (e.g. a GA4GH-style
+server). All logic except the actual socket I/O is exercised by unit tests
+via an injectable ``transport`` callable.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, Iterator, List, Mapping, Optional, Sequence
+
+from spark_examples_tpu.sharding.contig import Contig, SexChromosomeFilter, filter_sex_chromosomes
+from spark_examples_tpu.sources.base import (
+    GenomicsClient,
+    GenomicsSource,
+    OfflineAuth,
+    ShardBoundary,
+)
+
+DEFAULT_BASE_URL = "https://www.googleapis.com/genomics/v1beta2"
+
+#: transport(url, payload_dict, headers) -> response_dict
+Transport = Callable[[str, Mapping, Mapping], Dict]
+
+
+def _urllib_transport(url: str, payload: Mapping, headers: Mapping) -> Dict:
+    data = json.dumps(payload).encode("utf-8")
+    request = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json", **headers}
+    )
+    with urllib.request.urlopen(request, timeout=60) as response:
+        return json.loads(response.read().decode("utf-8"))
+
+
+class RestClient(GenomicsClient):
+    def __init__(
+        self,
+        auth: Optional[OfflineAuth],
+        base_url: str = DEFAULT_BASE_URL,
+        transport: Transport = _urllib_transport,
+        max_retries: int = 3,
+    ):
+        super().__init__()
+        self.auth = auth
+        self.base_url = base_url.rstrip("/")
+        self.transport = transport
+        self.max_retries = max_retries
+
+    def _headers(self) -> Dict[str, str]:
+        if self.auth and self.auth.access_token:
+            return {"Authorization": f"Bearer {self.auth.access_token}"}
+        return {}
+
+    def _post(self, path: str, payload: Mapping) -> Dict:
+        url = f"{self.base_url}/{path}"
+        last_error: Optional[Exception] = None
+        for _ in range(self.max_retries):
+            self.counters.initialized_requests += 1
+            try:
+                return self.transport(url, payload, self._headers())
+            except urllib.error.HTTPError as e:
+                self.counters.unsuccessful_responses += 1
+                last_error = e
+            except (urllib.error.URLError, OSError) as e:
+                self.counters.io_exceptions += 1
+                last_error = e
+        raise RuntimeError(f"request to {url} failed after retries") from last_error
+
+    def _paginate(
+        self, path: str, request: Mapping, items_field: str, page_size: int
+    ) -> Iterator[Dict]:
+        payload = dict(request)
+        payload["pageSize"] = page_size
+        token: Optional[str] = None
+        while True:
+            if token is not None:
+                payload["pageToken"] = token
+            response = self._post(path, payload)
+            for item in response.get(items_field, []):
+                yield item
+            token = response.get("nextPageToken")
+            if not token:
+                return
+
+    def search_variants(
+        self,
+        request: Mapping,
+        boundary: ShardBoundary = ShardBoundary.STRICT,
+        page_size: int = 1024,
+    ) -> Iterator[Dict]:
+        start = int(request.get("start", 0))
+        end = int(request.get("end", 1 << 62))
+        for variant in self._paginate("variants/search", request, "variants", page_size):
+            if boundary is ShardBoundary.STRICT:
+                if not (start <= int(variant["start"]) < end):
+                    continue
+            yield variant
+
+    def search_reads(
+        self,
+        request: Mapping,
+        boundary: ShardBoundary = ShardBoundary.STRICT,
+        page_size: int = 256,
+    ) -> Iterator[Dict]:
+        start = int(request.get("start", 0))
+        end = int(request.get("end", 1 << 62))
+        for read in self._paginate("reads/search", request, "alignments", page_size):
+            position = int(read["alignment"]["position"]["position"])
+            if boundary is ShardBoundary.STRICT and not (start <= position < end):
+                continue
+            yield read
+
+
+class RestGenomicsSource(GenomicsSource):
+    def __init__(
+        self,
+        auth: Optional[OfflineAuth] = None,
+        base_url: str = DEFAULT_BASE_URL,
+        transport: Transport = _urllib_transport,
+    ):
+        self.auth = auth
+        self.base_url = base_url
+        self.transport = transport
+
+    def client(self) -> RestClient:
+        return RestClient(self.auth, self.base_url, self.transport)
+
+    def search_callsets(self, variant_set_ids: Sequence[str]) -> List[Dict]:
+        """Driver-side callset fetch (``VariantsPca.scala:97-109``)."""
+        client = self.client()
+        return [
+            {"id": cs["id"], "name": cs.get("name")}
+            for cs in client._paginate(
+                "callsets/search",
+                {"variantSetIds": list(variant_set_ids)},
+                "callSets",
+                1024,
+            )
+        ]
+
+    def get_contigs(
+        self,
+        variant_set_id: str,
+        sex_filter: SexChromosomeFilter = SexChromosomeFilter.INCLUDE_XY,
+    ) -> List[Contig]:
+        """``Contig.getContigsInVariantSet`` over the variant-set metadata's
+        ``referenceBounds`` (used at ``GenomicsConf.scala:88``)."""
+        client = self.client()
+        response = client._post(f"variantsets/{variant_set_id}", {})
+        contigs = [
+            Contig(b["referenceName"], 0, int(b["upperBound"]))
+            for b in response.get("referenceBounds", [])
+        ]
+        return filter_sex_chromosomes(contigs, sex_filter)
+
+
+__all__ = ["RestClient", "RestGenomicsSource", "DEFAULT_BASE_URL"]

@@ -1,0 +1,448 @@
+"""Deterministic synthetic genomics backend.
+
+This is the fake-backend test seam the reference authors wished for
+(``SearchVariantsExample.scala:74-76``) promoted to a first-class component,
+and it doubles as the benchmark data plane.
+
+Design rules:
+
+- **Partition invariance.** Every random draw is counter-based hashing
+  (splitmix64 finalizer) keyed by ``(seed, variant_set_id, contig, absolute
+  position, stream, sample, allele)``. Any shard of any window therefore
+  generates byte-identical records — the synthetic analog of
+  ``ShardBoundary.STRICT`` exactness, and the property that makes
+  determinism tests across device counts meaningful.
+- **Population structure.** Samples are assigned to ``n_pops`` blocks with
+  per-population allele-frequency shifts, so the flagship PCoA pipeline
+  produces separable clusters (a meaningful end-to-end signal, not noise).
+- **Two paths, one implementation.** The wire path yields the same JSON
+  record shapes the reference's Java client deserializes; the packed path
+  (:meth:`SyntheticGenomicsSource.genotype_blocks`) yields dense
+  ``{0,1}`` has-variation blocks ready for the MXU Gramian. Both call the
+  same ``_u01`` hash streams, and a test asserts they agree.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterator, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_examples_tpu.constants import Examples
+from spark_examples_tpu.sharding.contig import Contig, SexChromosomeFilter, filter_sex_chromosomes
+from spark_examples_tpu.sources.base import (
+    GenomicsClient,
+    GenomicsSource,
+    ShardBoundary,
+)
+from spark_examples_tpu.utils.murmur3 import murmur3_x64_128
+
+_U64 = np.uint64
+_P1 = _U64(0x9E3779B97F4A7C15)
+_P2 = _U64(0xC2B2AE3D27D4EB4F)
+_P3 = _U64(0x165667B19E3779F9)
+_P4 = _U64(0xD6E8FEB86659FD93)
+
+# Draw-stream tags.
+_S_REF_BLOCK = 1
+_S_AF = 2
+_S_POP_BASE = 3  # stream 3+p for population p
+_S_REF_BASE = 20
+_S_ALT_BASE = 21
+_S_GENOTYPE = 100
+_S_READ_MAPQ = 200
+_S_READ_BASEQ = 201
+_S_READ_ALLELE = 202
+_S_SOMATIC = 203
+_S_GERMLINE_BASE = 204
+
+_BASES = "ACGT"
+
+
+def _mix(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer, vectorized over uint64 arrays (wrapping mod 2^64)."""
+    with np.errstate(over="ignore"):
+        x = (x + _P1).astype(_U64)
+        x = ((x ^ (x >> _U64(30))) * _U64(0xBF58476D1CE4E5B9)).astype(_U64)
+        x = ((x ^ (x >> _U64(27))) * _U64(0x94D049BB133111EB)).astype(_U64)
+        return (x ^ (x >> _U64(31))).astype(_U64)
+
+
+def _string_key(s: str) -> np.uint64:
+    return _U64(int.from_bytes(murmur3_x64_128(s.encode("utf-8"))[:8], "little"))
+
+
+def _u01(key: np.uint64, pos, stream: int, sample=0, allele=0) -> np.ndarray:
+    """Deterministic uniform [0,1) draws keyed by all arguments.
+
+    ``pos`` / ``sample`` / ``allele`` may be scalars or broadcastable arrays.
+    """
+    with np.errstate(over="ignore"):
+        h = _mix(key ^ (np.asarray(pos, dtype=np.int64).astype(_U64) * _P2))
+        h = _mix(h ^ (_U64(stream) * _P3))
+        h = _mix(h ^ (np.asarray(sample, dtype=np.int64).astype(_U64) * _P4))
+        h = _mix(h ^ (np.asarray(allele, dtype=np.int64).astype(_U64) * _P1))
+    return (h >> _U64(11)).astype(np.float64) * (2.0**-53)
+
+
+def _u64(key: np.uint64, pos, stream: int, sample=0, allele=0) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        h = _mix(key ^ (np.asarray(pos, dtype=np.int64).astype(_U64) * _P2))
+        h = _mix(h ^ (_U64(stream) * _P3))
+        h = _mix(h ^ (np.asarray(sample, dtype=np.int64).astype(_U64) * _P4))
+        h = _mix(h ^ (np.asarray(allele, dtype=np.int64).astype(_U64) * _P1))
+    return h
+
+
+class SyntheticGenomicsSource(GenomicsSource):
+    """A deterministic cohort with population structure.
+
+    Args:
+        num_samples: cohort size per variant set (1KG phase 1: 2,504).
+        seed: base seed; all draws derive from it.
+        variant_spacing: one candidate variant site every N bases (~1/100
+            approximates 1KG phase 1's ~39M sites over ~2.9 Gb).
+        ref_block_fraction: fraction of sites that are reference-matching
+            blocks (``referenceBases == "N"``, no alternates — the record
+            class the Klotho/BRCA1 examples count).
+        n_pops: number of synthetic populations.
+        read_length / read_depth: synthetic read geometry for the reads API.
+    """
+
+    def __init__(
+        self,
+        num_samples: int = 2504,
+        seed: int = 42,
+        variant_spacing: int = 100,
+        ref_block_fraction: float = 0.1,
+        n_pops: int = 4,
+        read_length: int = 100,
+        read_depth: int = 8,
+        somatic_rate: float = 0.002,
+    ):
+        self.num_samples = int(num_samples)
+        self.seed = int(seed)
+        self.variant_spacing = int(variant_spacing)
+        self.ref_block_fraction = float(ref_block_fraction)
+        self.n_pops = int(n_pops)
+        self.read_length = int(read_length)
+        self.read_depth = int(read_depth)
+        self.somatic_rate = float(somatic_rate)
+        # Contiguous population blocks: sample s → pop s*n_pops//N.
+        self._pops = (
+            np.arange(self.num_samples, dtype=np.int64) * self.n_pops
+        ) // self.num_samples
+
+    # ------------------------------------------------------------------ keys
+
+    def _vs_key(self, variant_set_id: str) -> np.uint64:
+        with np.errstate(over="ignore"):
+            return _mix(_U64(self.seed) ^ _string_key(variant_set_id))
+
+    def _rgs_key(self, read_group_set_id: str) -> np.uint64:
+        with np.errstate(over="ignore"):
+            return _mix(_U64(self.seed) ^ _string_key(read_group_set_id))
+
+    # ------------------------------------------------------- driver metadata
+
+    def callset_id(self, variant_set_id: str, i: int) -> str:
+        """Callset ids follow the public-data convention ``<variantset>-<i>``;
+        ``emitResult`` splits on '-' to recover the dataset id
+        (``VariantsPca.scala:275``)."""
+        return f"{variant_set_id}-{i}"
+
+    def callset_name(self, variant_set_id: str, i: int) -> str:
+        tag = int(self._vs_key(variant_set_id) % _U64(90))
+        return f"S{tag:02d}N{i:05d}"
+
+    def search_callsets(self, variant_set_ids: Sequence[str]) -> List[Dict]:
+        out = []
+        for vsid in variant_set_ids:
+            for i in range(self.num_samples):
+                out.append(
+                    {"id": self.callset_id(vsid, i), "name": self.callset_name(vsid, i)}
+                )
+        return out
+
+    def get_contigs(
+        self,
+        variant_set_id: str,
+        sex_filter: SexChromosomeFilter = SexChromosomeFilter.INCLUDE_XY,
+    ) -> List[Contig]:
+        contigs = [
+            Contig(name, 0, length)
+            for name, length in Examples.HUMAN_CHROMOSOMES.items()
+        ]
+        return filter_sex_chromosomes(contigs, sex_filter)
+
+    def client(self) -> "SyntheticClient":
+        return SyntheticClient(self)
+
+    # ------------------------------------------------------- variant payloads
+
+    def _site_positions(self, start: int, end: int) -> np.ndarray:
+        """Candidate variant sites on the global grid inside [start, end)."""
+        spacing = self.variant_spacing
+        first = ((max(start, 0) + spacing - 1) // spacing) * spacing
+        if first >= end:
+            return np.empty(0, dtype=np.int64)
+        return np.arange(first, end, spacing, dtype=np.int64)
+
+    def _site_fields(
+        self, variant_set_id: str, positions: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Per-site draws shared by both paths.
+
+        Returns (is_ref_block, af, af_pop[B,P], ref_base_idx, alt_base_idx).
+        Site identity (existence, ref/alt, base AF) is keyed by position only,
+        NOT by variant set — so distinct variant sets share sites and their
+        murmur3 variant keys match across datasets, exercising the
+        join/merge paths the way 1KG + Platinum would
+        (``VariantsPca.scala:155-188``).
+        """
+        site_key = _mix(_U64(self.seed))
+        is_ref_block = _u01(site_key, positions, _S_REF_BLOCK) < self.ref_block_fraction
+        u_af = _u01(site_key, positions, _S_AF)
+        af = 0.01 + (u_af**2) * 0.49
+        af_pop = np.stack(
+            [
+                np.clip(af * (0.25 + 1.5 * _u01(site_key, positions, _S_POP_BASE + p)), 0.002, 0.95)
+                for p in range(self.n_pops)
+            ],
+            axis=1,
+        )
+        ref_idx = (_u64(site_key, positions, _S_REF_BASE) % _U64(4)).astype(np.int64)
+        alt_off = (_u64(site_key, positions, _S_ALT_BASE) % _U64(3)).astype(np.int64)
+        alt_idx = (ref_idx + 1 + alt_off) % 4
+        return is_ref_block, af, af_pop, ref_idx, alt_idx
+
+    def _genotype_alleles(
+        self, variant_set_id: str, positions: np.ndarray
+    ) -> np.ndarray:
+        """(B, N, 2) {0,1} allele draws; genotypes are per variant set
+        (different datasets = different individuals at shared sites)."""
+        vs_key = self._vs_key(variant_set_id)
+        _, _, af_pop, _, _ = self._site_fields(variant_set_id, positions)
+        prob = af_pop[:, self._pops]  # (B, N)
+        samples = np.arange(self.num_samples, dtype=np.int64)[None, :, None]
+        alleles = np.array([1, 2], dtype=np.int64)[None, None, :]
+        u = _u01(vs_key, positions[:, None, None], _S_GENOTYPE, samples, alleles)
+        return (u < prob[:, :, None]).astype(np.int8)
+
+    def genotype_blocks(
+        self,
+        variant_set_id: str,
+        contig: Contig,
+        block_size: int = 1024,
+        min_allele_frequency: Optional[float] = None,
+    ) -> Iterator[Dict[str, np.ndarray]]:
+        """Packed fast path: dense has-variation blocks for the Gramian.
+
+        Yields dicts with ``positions`` (B,), ``has_variation`` uint8 (B, N),
+        ``af`` (B,). Reference-block sites are all-zero rows (no call has
+        variation) and are dropped, matching the ``filter(_.size > 0)`` stage
+        (``VariantsPca.scala:206``). ``min_allele_frequency`` applies the
+        ``--min-allele-frequency`` filter (``VariantsPca.scala:136-148``,
+        strictly greater, on the site's AF info value).
+        """
+        all_positions = self._site_positions(contig.start, contig.end)
+        for off in range(0, len(all_positions), block_size):
+            positions = all_positions[off : off + block_size]
+            is_ref_block, af, _, _, _ = self._site_fields(variant_set_id, positions)
+            keep = ~is_ref_block
+            if min_allele_frequency is not None:
+                keep &= af.astype(np.float32) > np.float32(min_allele_frequency)
+            positions = positions[keep]
+            af = af[keep]
+            if len(positions) == 0:
+                continue
+            alleles = self._genotype_alleles(variant_set_id, positions)
+            has_variation = (alleles.max(axis=2) > 0).astype(np.uint8)
+            nonzero = has_variation.any(axis=1)
+            yield {
+                "positions": positions[nonzero],
+                "has_variation": has_variation[nonzero],
+                "af": af[nonzero],
+            }
+
+    def variant_json(self, variant_set_id: str, contig_name: str, pos: int) -> Dict:
+        """One wire-format variant record (the JSON the reference's Java
+        client would deserialize, ``rdd/VariantsRDD.scala:98-149``)."""
+        positions = np.array([pos], dtype=np.int64)
+        is_ref_block, af, _, ref_idx, alt_idx = self._site_fields(
+            variant_set_id, positions
+        )
+        record: Dict = {
+            "id": f"{variant_set_id}:{contig_name}:{pos}",
+            "variantSetId": variant_set_id,
+            "referenceName": contig_name,
+            "start": int(pos),
+            "created": 0,
+        }
+        if bool(is_ref_block[0]):
+            record["end"] = int(pos) + self.variant_spacing
+            record["referenceBases"] = "N"
+            genotypes = np.zeros((1, self.num_samples, 2), dtype=np.int8)
+        else:
+            record["end"] = int(pos) + 1
+            record["referenceBases"] = _BASES[int(ref_idx[0])]
+            record["alternateBases"] = [_BASES[int(alt_idx[0])]]
+            record["info"] = {"AF": [f"{float(af[0]):.6f}"]}
+            genotypes = self._genotype_alleles(variant_set_id, positions)
+        record["calls"] = [
+            {
+                "callSetId": self.callset_id(variant_set_id, s),
+                "callSetName": self.callset_name(variant_set_id, s),
+                "genotype": [int(genotypes[0, s, 0]), int(genotypes[0, s, 1])],
+                "phaseset": "*",
+            }
+            for s in range(self.num_samples)
+        ]
+        return record
+
+    # --------------------------------------------------------- read payloads
+
+    def _germline_base(self, contig_name: str, positions: np.ndarray) -> np.ndarray:
+        key = _mix(_U64(self.seed) ^ _string_key(contig_name))
+        return (_u64(key, positions, _S_GERMLINE_BASE) % _U64(4)).astype(np.int64)
+
+    def _is_somatic_site(self, contig_name: str, positions: np.ndarray) -> np.ndarray:
+        key = _mix(_U64(self.seed) ^ _string_key(contig_name))
+        return _u01(key, positions, _S_SOMATIC) < self.somatic_rate
+
+    def read_json(
+        self, read_group_set_id: str, contig_name: str, start: int, tile: int
+    ) -> Dict:
+        """One wire-format read.
+
+        The read's bases follow the deterministic germline reference of
+        ``contig_name``; read group sets whose id contains ``"Tumor"`` (or the
+        DREAM tumor id) additionally carry somatic alternates at hash-selected
+        sites with ~50% variant allele fraction — giving SearchReadsExample4's
+        tumor/normal comparison a real signal.
+        """
+        rgs_key = self._rgs_key(read_group_set_id)
+        L = self.read_length
+        positions = np.arange(start, start + L, dtype=np.int64)
+        base_idx = self._germline_base(contig_name, positions)
+        is_tumor = (
+            "Tumor" in read_group_set_id
+            or read_group_set_id == Examples.GOOGLE_DREAM_SET3_TUMOR
+        )
+        if is_tumor:
+            somatic = self._is_somatic_site(contig_name, positions)
+            carries_alt = (
+                _u01(rgs_key, positions, _S_READ_ALLELE, sample=start, allele=tile)
+                < 0.5
+            )
+            flip = somatic & carries_alt
+            base_idx = np.where(flip, (base_idx + 1) % 4, base_idx)
+        sequence = "".join(_BASES[i] for i in base_idx)
+        qual = (
+            20
+            + (
+                _u64(rgs_key, positions, _S_READ_BASEQ, sample=start, allele=tile)
+                % _U64(21)
+            ).astype(np.int64)
+        )
+        mapq = int(
+            20
+            + int(
+                _u64(rgs_key, np.int64(start), _S_READ_MAPQ, allele=tile) % _U64(41)
+            )
+        )
+        return {
+            "id": f"{read_group_set_id}:{contig_name}:{start}:{tile}",
+            "fragmentName": f"frag-{contig_name}-{start}-{tile}",
+            "readGroupSetId": read_group_set_id,
+            "alignedSequence": sequence,
+            "alignedQuality": [int(q) for q in qual],
+            "fragmentLength": 300,
+            "alignment": {
+                "position": {"referenceName": contig_name, "position": int(start)},
+                "mappingQuality": mapq,
+                "cigar": [
+                    {"operationLength": L, "operation": "ALIGNMENT_MATCH"}
+                ],
+            },
+        }
+
+    def read_starts(self, start: int, end: int) -> Iterator[Tuple[int, int]]:
+        """(position, tile) pairs of reads starting in [start, end).
+
+        Reads are laid out as ``read_depth`` staggered full tilings of length
+        ``read_length``: tile j starts at offsets ≡ j*(L//depth) (mod L), so
+        per-base depth is uniformly ``read_depth``.
+        """
+        L = self.read_length
+        step = max(1, L // self.read_depth)
+        for tile in range(self.read_depth):
+            offset = tile * step
+            first = ((max(start - offset, 0) + L - 1) // L) * L + offset
+            for pos in range(first, end, L):
+                if pos >= start:
+                    yield pos, tile
+
+
+class SyntheticClient(GenomicsClient):
+    """A per-partition session over the synthetic source, with the page
+    accounting of the reference's ``Paginator`` (one initialized request per
+    page, ``rdd/VariantsRDD.scala:212-224``)."""
+
+    def __init__(self, source: SyntheticGenomicsSource):
+        super().__init__()
+        self.source = source
+
+    def search_variants(
+        self,
+        request: Mapping,
+        boundary: ShardBoundary = ShardBoundary.STRICT,
+        page_size: int = 1024,
+    ) -> Iterator[Dict]:
+        src = self.source
+        variant_set_id = request["variantSetIds"][0]
+        contig_name = request["referenceName"]
+        start, end = int(request["start"]), int(request["end"])
+        # Candidate sites, including one spacing of lookback for records that
+        # overlap the range start (reference-matching blocks have extent).
+        candidates = src._site_positions(start - src.variant_spacing, end)
+        emitted = 0
+        for pos in candidates:
+            pos = int(pos)
+            if boundary is ShardBoundary.STRICT:
+                if not (start <= pos < end):
+                    continue
+            else:  # OVERLAPS
+                site_end = pos + src.variant_spacing  # max extent (ref blocks)
+                if site_end <= start or pos >= end:
+                    continue
+            if emitted % page_size == 0:
+                self.counters.initialized_requests += 1
+            emitted += 1
+            yield src.variant_json(variant_set_id, contig_name, pos)
+        if emitted == 0:
+            # Even an empty shard costs one request.
+            self.counters.initialized_requests += 1
+
+    def search_reads(
+        self,
+        request: Mapping,
+        boundary: ShardBoundary = ShardBoundary.STRICT,
+        page_size: int = 256,
+    ) -> Iterator[Dict]:
+        src = self.source
+        contig_name = request["referenceName"]
+        start, end = int(request["start"]), int(request["end"])
+        emitted = 0
+        for read_group_set_id in request["readGroupSetIds"]:
+            for pos, tile in src.read_starts(start, end):
+                if emitted % page_size == 0:
+                    self.counters.initialized_requests += 1
+                emitted += 1
+                yield src.read_json(read_group_set_id, contig_name, pos, tile)
+        if emitted == 0:
+            self.counters.initialized_requests += 1
+
+
+__all__ = ["SyntheticGenomicsSource", "SyntheticClient"]

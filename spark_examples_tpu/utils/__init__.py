@@ -1,0 +1,3 @@
+from spark_examples_tpu.utils.murmur3 import murmur3_x64_128, murmur3_x64_128_hex
+
+__all__ = ["murmur3_x64_128", "murmur3_x64_128_hex"]

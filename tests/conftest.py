@@ -1,0 +1,25 @@
+"""Test harness configuration.
+
+Multi-device code is exercised on a virtual 8-device CPU mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``) — the moral
+equivalent of the reference's ``local[4]`` Spark master (``README.md:38``,
+SURVEY.md §4). These env vars must be set before JAX is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def small_source():
+    from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource
+
+    return SyntheticGenomicsSource(num_samples=40, seed=7, variant_spacing=100)

@@ -1,0 +1,133 @@
+"""Variant/Read builders: normalization, field mapping, round-trip."""
+
+from spark_examples_tpu.models.read import ReadBuilder
+from spark_examples_tpu.models.variant import VariantKey, VariantsBuilder
+
+
+def test_normalize_strips_chr_prefix():
+    # rdd/VariantsRDD.scala:89-96 — ([a-z]*)?([0-9]*) full-match, keep digits.
+    assert VariantsBuilder.normalize("chr17") == "17"
+    assert VariantsBuilder.normalize("17") == "17"
+    assert VariantsBuilder.normalize("chr1") == "1"
+
+
+def test_normalize_drops_nonmatching_contigs():
+    # Uppercase and dotted names do not full-match → dropped (None).
+    assert VariantsBuilder.normalize("X") is None
+    assert VariantsBuilder.normalize("chrX") is None
+    assert VariantsBuilder.normalize("MT") is None
+    assert VariantsBuilder.normalize("GL000229.1") is None
+
+
+def _wire_variant(**kw):
+    base = {
+        "referenceName": "chr17",
+        "id": "var-1",
+        "start": 41196320,
+        "end": 41196321,
+        "referenceBases": "A",
+        "alternateBases": ["G"],
+        "variantSetId": "vs-1",
+        "created": 123,
+        "info": {"AF": ["0.25"]},
+        "calls": [
+            {
+                "callSetId": "vs-1-0",
+                "callSetName": "NA00001",
+                "genotype": [0, 1],
+                "phaseset": "*",
+            },
+            {
+                "callSetId": "vs-1-1",
+                "callSetName": "NA00002",
+                "genotype": [0, 0],
+                "genotypeLikelihood": [-0.1, -0.5, -2.0],
+            },
+        ],
+    }
+    base.update(kw)
+    return base
+
+
+def test_build_maps_fields_and_normalizes():
+    key, variant = VariantsBuilder.build(_wire_variant())
+    # Partition key keeps the RAW reference name (rdd/VariantsRDD.scala:99).
+    assert key == VariantKey("chr17", 41196320)
+    # The variant's contig is normalized (rdd/VariantsRDD.scala:118-124).
+    assert variant.contig == "17"
+    assert variant.reference_bases == "A"
+    assert variant.alternate_bases == ("G",)
+    assert variant.info["AF"] == ["0.25"]
+    assert variant.calls[0].genotype == (0, 1)
+    assert variant.calls[0].has_variation()
+    assert not variant.calls[1].has_variation()
+    assert variant.calls[1].genotype_likelihood == (-0.1, -0.5, -2.0)
+
+
+def test_build_drops_bad_contig():
+    assert VariantsBuilder.build(_wire_variant(referenceName="chrX")) is None
+
+
+def test_build_missing_optionals():
+    wire = _wire_variant()
+    del wire["alternateBases"], wire["calls"], wire["info"], wire["created"]
+    _, variant = VariantsBuilder.build(wire)
+    assert variant.alternate_bases is None
+    assert variant.calls is None
+    assert variant.info == {}
+    assert variant.created == 0
+
+
+def test_variant_json_round_trip():
+    # The analog of the toJavaVariant round-trip smoke check
+    # (SearchVariantsExample.scala:77-79).
+    _, variant = VariantsBuilder.build(_wire_variant())
+    wire2 = variant.to_json()
+    # Round-tripping the normalized record is stable.
+    _, variant2 = VariantsBuilder.build(wire2)
+    assert variant2 == variant
+
+
+def test_read_builder_flattens_alignment_and_cigar():
+    wire = {
+        "id": "read-1",
+        "fragmentName": "frag-1",
+        "readGroupSetId": "rgs-1",
+        "alignedSequence": "ACGT",
+        "alignedQuality": [30, 31, 32, 33],
+        "fragmentLength": 300,
+        "nextMatePosition": {"referenceName": "11", "position": 999},
+        "alignment": {
+            "position": {"referenceName": "11", "position": 100},
+            "mappingQuality": 60,
+            "cigar": [
+                {"operationLength": 3, "operation": "ALIGNMENT_MATCH"},
+                {"operationLength": 1, "operation": "CLIP_SOFT"},
+            ],
+        },
+    }
+    key, read = ReadBuilder.build(wire)
+    assert key.sequence == "11" and key.position == 100
+    assert read.cigar == "3M1S"  # rdd/ReadsRDD.scala:46-63
+    assert read.mapping_quality == 60
+    assert read.mate_position == 999
+    assert read.mate_reference_name == "11"
+    assert read.aligned_quality == (30, 31, 32, 33)
+
+
+def test_read_builder_no_mate():
+    wire = {
+        "id": "r",
+        "fragmentName": "f",
+        "readGroupSetId": "g",
+        "alignedSequence": "A",
+        "alignedQuality": [30],
+        "alignment": {
+            "position": {"referenceName": "1", "position": 5},
+            "mappingQuality": 20,
+            "cigar": [],
+        },
+    }
+    _, read = ReadBuilder.build(wire)
+    assert read.mate_position is None
+    assert read.cigar == ""

@@ -1,0 +1,73 @@
+"""murmur3 x64 128 vectors (public MurmurHash3/Guava test vectors) and the
+variant-key protocol of ``VariantsPca.scala:71-86``."""
+
+from spark_examples_tpu.utils.murmur3 import murmur3_x64_128, murmur3_x64_128_hex
+from spark_examples_tpu.models.variant import Variant
+
+
+def test_empty_input_is_zero():
+    # MurmurHash3_x64_128("", seed=0) == 0 (canonical vector).
+    assert murmur3_x64_128(b"") == b"\x00" * 16
+
+
+def test_hello_vector():
+    # MurmurHash3_x64_128("hello", 0) = h1=0xcbd8a7b341bd9b02, h2=0x5b1e906a48ae1d19;
+    # Guava HashCode.toString() emits h1 LE then h2 LE as lowercase hex.
+    assert murmur3_x64_128_hex(b"hello") == "029bbd41b3a7d8cb191dae486a901e5b"
+
+
+def test_tail_lengths_are_stable():
+    # Exercise every tail length 0..16; self-consistency (regression pin).
+    digests = {murmur3_x64_128_hex(b"a" * n) for n in range(17)}
+    assert len(digests) == 17
+
+
+def test_seed_changes_digest():
+    assert murmur3_x64_128(b"abc", 0) != murmur3_x64_128(b"abc", 1)
+
+
+def _mk_variant(**kw):
+    base = dict(
+        contig="17",
+        id="v1",
+        names=None,
+        start=41196311,
+        end=41196312,
+        reference_bases="A",
+        alternate_bases=("G",),
+        info={},
+        created=0,
+        variant_set_id="vs",
+        calls=None,
+    )
+    base.update(kw)
+    return Variant(**base)
+
+
+def test_variant_key_depends_on_all_fields():
+    v = _mk_variant()
+    assert v.variant_key() != _mk_variant(contig="18").variant_key()
+    assert v.variant_key() != _mk_variant(start=41196312).variant_key()
+    assert v.variant_key() != _mk_variant(end=41196313).variant_key()
+    assert v.variant_key() != _mk_variant(reference_bases="C").variant_key()
+    assert v.variant_key() != _mk_variant(alternate_bases=("T",)).variant_key()
+
+
+def test_variant_key_joins_multiallelic_alternates():
+    # alternateBases are concatenated with no separator (VariantsPca.scala:72-73).
+    joined = _mk_variant(alternate_bases=("G", "T")).variant_key()
+    single = _mk_variant(alternate_bases=("GT",)).variant_key()
+    assert joined == single
+
+
+def test_variant_key_none_alternates_is_empty_string():
+    assert (
+        _mk_variant(alternate_bases=None).variant_key()
+        == _mk_variant(alternate_bases=()).variant_key()
+    )
+
+
+def test_variant_key_is_32_hex_chars():
+    key = _mk_variant().variant_key()
+    assert len(key) == 32
+    assert all(c in "0123456789abcdef" for c in key)

@@ -1,0 +1,107 @@
+"""Contig shard math, split policies, partitioners."""
+
+import pytest
+
+from spark_examples_tpu.sharding.contig import (
+    Contig,
+    SexChromosomeFilter,
+    filter_sex_chromosomes,
+    parse_contigs,
+)
+from spark_examples_tpu.sharding.partitioners import (
+    FixedSplits,
+    ReadsPartitioner,
+    TargetSizeSplits,
+    VariantsPartitioner,
+)
+
+
+def test_get_shards_covers_range_exactly():
+    shards = Contig("17", 100, 1050).get_shards(250)
+    assert [(s.start, s.end) for s in shards] == [
+        (100, 350),
+        (350, 600),
+        (600, 850),
+        (850, 1050),
+    ]
+    assert all(s.reference_name == "17" for s in shards)
+
+
+def test_get_shards_single_window():
+    assert Contig("1", 0, 10).get_shards(100) == [Contig("1", 0, 10)]
+
+
+def test_parse_contigs_grammar():
+    # GenomicsConf.scala:40-43 grammar: reference:start:end,...
+    contigs = parse_contigs("17:41196311:41277499,13:33628137:33628138")
+    assert contigs == [
+        Contig("17", 41196311, 41277499),
+        Contig("13", 33628137, 33628138),
+    ]
+
+
+def test_parse_contigs_rejects_bad_spec():
+    with pytest.raises(ValueError):
+        parse_contigs("17:123")
+
+
+def test_sex_chromosome_filter():
+    contigs = [Contig("1", 0, 10), Contig("X", 0, 10), Contig("Y", 0, 10)]
+    kept = filter_sex_chromosomes(contigs, SexChromosomeFilter.EXCLUDE_XY)
+    assert [c.reference_name for c in kept] == ["1"]
+    assert (
+        filter_sex_chromosomes(contigs, SexChromosomeFilter.INCLUDE_XY) == contigs
+    )
+
+
+def test_variants_partitioner_enumerates_windows():
+    partitioner = VariantsPartitioner([Contig("17", 0, 2500)], 1000)
+    parts = partitioner.get_partitions("vs-1")
+    assert [p.index for p in parts] == [0, 1, 2]
+    assert parts[1].get_variants_request() == {
+        "variantSetIds": ["vs-1"],
+        "referenceName": "17",
+        "start": 1000,
+        "end": 2000,
+    }
+    assert parts[2].range == 500
+
+
+def test_fixed_splits_caps_at_sequence_length():
+    # rdd/ReadsPartitioner.scala:76-78
+    assert FixedSplits(4).splits(1000) == 4
+    assert FixedSplits(4).splits(2) == 2
+
+
+def test_target_size_splits_formula():
+    # rdd/ReadsPartitioner.scala:84-90: 1 + ((len/readLen)*depth*size)/(partSize+1)
+    splitter = TargetSizeSplits(100, 5, 1024, 16 * 1024 * 1024)
+    assert splitter.splits(48129895) == 1 + (
+        (48129895 // 100) * 5 * 1024
+    ) // (16 * 1024 * 1024 + 1)
+
+
+def test_reads_partitioner_layout():
+    partitioner = ReadsPartitioner(
+        {"11": (1000, 2000), "1": (0, 300)}, FixedSplits(2)
+    )
+    # Sequence-name order ("1" < "11"), global indices contiguous.
+    parts = partitioner.get_partitions(["rgs-a"])
+    assert partitioner.count == 4
+    assert [(p.sequence, p.start, p.end) for p in parts] == [
+        ("1", 0, 150),
+        ("1", 150, 300),
+        ("11", 1000, 1500),
+        ("11", 1500, 2000),
+    ]
+    assert [p.index for p in parts] == [0, 1, 2, 3]
+    assert parts[0].get_reads_request()["readGroupSetIds"] == ["rgs-a"]
+
+
+def test_reads_partitioner_get_partition_inverts_layout():
+    partitioner = ReadsPartitioner(
+        {"11": (1000, 2000), "1": (0, 300)}, FixedSplits(2)
+    )
+    for part in partitioner.get_partitions(["rgs"]):
+        for pos in (part.start, part.start + 1, part.end - 1):
+            assert partitioner.get_partition(part.sequence, pos) == part.index
