@@ -1,0 +1,3 @@
+from spark_examples_tpu.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,3 @@
+from spark_examples_tpu.analyses import reads_examples, variants_examples
+
+__all__ = ["reads_examples", "variants_examples"]

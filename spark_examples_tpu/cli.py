@@ -1,0 +1,66 @@
+"""Command-line entry points.
+
+One subcommand per reference main class (SURVEY.md §2.1's L5 applications),
+with the reference flag grammar (``GenomicsConf.scala:29-98``):
+
+    python -m spark_examples_tpu variants-pca --references 17:41196311:41277499
+    python -m spark_examples_tpu search-variants-klotho
+    python -m spark_examples_tpu search-variants-brca1
+    python -m spark_examples_tpu search-reads-example-1 .. -4
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional, Sequence
+
+from spark_examples_tpu.analyses import reads_examples, variants_examples
+from spark_examples_tpu.config import GenomicsConf, PcaConf
+from spark_examples_tpu.pipeline import pca_driver
+
+
+def _source(conf: GenomicsConf):
+    return pca_driver.make_source(conf)  # type: ignore[arg-type]
+
+
+COMMANDS = {
+    "variants-pca": lambda argv: pca_driver.run(argv),
+    "search-variants-klotho": lambda argv: variants_examples.run_klotho(
+        *(lambda c: (c, _source(c)))(GenomicsConf.parse(argv))
+    ),
+    "search-variants-brca1": lambda argv: variants_examples.run_brca1(
+        *(lambda c: (c, _source(c)))(GenomicsConf.parse(argv))
+    ),
+    "search-reads-example-1": lambda argv: reads_examples.run_example1(
+        *(lambda c: (c, _source(c)))(GenomicsConf.parse(argv))
+    ),
+    "search-reads-example-2": lambda argv: reads_examples.run_example2(
+        *(lambda c: (c, _source(c)))(GenomicsConf.parse(argv))
+    ),
+    "search-reads-example-3": lambda argv: reads_examples.run_example3(
+        *(lambda c: (c, _source(c)))(GenomicsConf.parse(argv))
+    ),
+    "search-reads-example-4": lambda argv: reads_examples.run_example4(
+        *(lambda c: (c, _source(c)))(GenomicsConf.parse(argv))
+    ),
+}
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m spark_examples_tpu <command> [flags]")
+        print("commands:")
+        for name in COMMANDS:
+            print(f"  {name}")
+        return 0
+    command, rest = argv[0], argv[1:]
+    if command not in COMMANDS:
+        print(f"unknown command: {command}", file=sys.stderr)
+        return 2
+    COMMANDS[command](rest)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -200,9 +200,11 @@ class PcaConf(GenomicsConf):
                     source.get_contigs(variant_set_id, SexChromosomeFilter.EXCLUDE_XY)
                 )
         else:
+            # Scala zip semantics (``GenomicsConf.scala:91-95``): the
+            # variantset list is zipped with the per-set reference lists and
+            # TRUNCATED to the shorter — one --references list with two
+            # variant sets contributes its contigs once, not per set.
             reference_lists = self.references.split(";")
-            if len(reference_lists) == 1:
-                reference_lists = reference_lists * len(variant_set_ids)
             for variant_set_id, spec in zip(variant_set_ids, reference_lists):
                 print(f"Variantset: {variant_set_id}; Refs: {spec}")
                 contigs.extend(parse_contigs(spec))

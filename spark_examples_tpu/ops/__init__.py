@@ -1,0 +1,10 @@
+from spark_examples_tpu.ops.centering import gower_center
+from spark_examples_tpu.ops.gramian import GramianAccumulator, ShardedGramianAccumulator
+from spark_examples_tpu.ops.pca import principal_components
+
+__all__ = [
+    "gower_center",
+    "GramianAccumulator",
+    "ShardedGramianAccumulator",
+    "principal_components",
+]

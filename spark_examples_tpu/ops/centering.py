@@ -1,0 +1,53 @@
+"""Gower double-centering of the similarity matrix.
+
+The reference centers row-by-row against broadcast row sums
+(``VariantsPca.scala:246-263``): entry (i, j) becomes
+``v − rowMean(i) − colMean(j) + matrixMean`` with means taken over the full
+row count N. On device this is three reductions and one fused elementwise
+pass; the driver-side ``collect`` of row sums and the broadcast disappear.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_examples_tpu.parallel.mesh import SAMPLES_AXIS
+
+
+@jax.jit
+def gower_center(S: jax.Array) -> jax.Array:
+    """B = S − rowMean − colMean + matrixMean (``VariantsPca.scala:252-263``)."""
+    S = S.astype(jnp.float64) if S.dtype == jnp.float64 else S.astype(jnp.float32)
+    row_mean = jnp.mean(S, axis=1, keepdims=True)
+    col_mean = jnp.mean(S, axis=0, keepdims=True)
+    total_mean = jnp.mean(S)
+    return S - row_mean - col_mean + total_mean
+
+
+def gower_center_sharded(S: jax.Array, mesh: Mesh) -> jax.Array:
+    """Centering for a row-sharded Gramian (``samples`` axis): row means are
+    local, column/matrix means are one ``psum`` over the row tiles."""
+
+    def per_tile(S_local):
+        n_total = S_local.shape[1]
+        row_mean = jnp.mean(S_local, axis=1, keepdims=True)
+        col_sum = jax.lax.psum(jnp.sum(S_local, axis=0, keepdims=True), SAMPLES_AXIS)
+        col_mean = col_sum / n_total
+        total_mean = jnp.sum(col_sum) / (n_total * n_total)
+        return S_local - row_mean - col_mean + total_mean
+
+    fn = shard_map(
+        per_tile,
+        mesh=mesh,
+        in_specs=P(SAMPLES_AXIS, None),
+        out_specs=P(SAMPLES_AXIS, None),
+    )
+    return jax.jit(
+        fn, out_shardings=NamedSharding(mesh, P(SAMPLES_AXIS, None))
+    )(S.astype(jnp.float32))
+
+
+__all__ = ["gower_center", "gower_center_sharded"]

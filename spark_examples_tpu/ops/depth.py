@@ -1,0 +1,102 @@
+"""Device ops for the read analyses.
+
+The reference computes per-base depth and base frequencies with flatMap +
+``reduceByKey``/``groupByKey`` shuffles over (position, x) pairs
+(``SearchReadsExample.scala:140-167, 219-244``). On TPU these are
+scatter-adds into a dense coordinate window: each read contributes its
+``read_length`` positions via one ``.at[].add`` (XLA scatter), vectorized
+over all reads of a shard — no shuffle, no per-position records.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: Fixed base vocabulary for frequency analyses.
+BASES = "ACGT"
+_BASE_CODE = {c: i for i, c in enumerate(BASES)}
+
+
+def encode_bases(sequence: str) -> list:
+    """Base chars → codes (unknown bases → -1, excluded from counts)."""
+    return [_BASE_CODE.get(c, -1) for c in sequence]
+
+
+@functools.partial(jax.jit, static_argnames=("window_size", "max_read_length"))
+def depth_counts(
+    positions: jax.Array,  # (R,) int32 — read start positions
+    lengths: jax.Array,  # (R,) int32 — aligned-sequence lengths
+    window_start: jax.Array,  # scalar int32
+    window_size: int,
+    max_read_length: int = 256,
+) -> jax.Array:
+    """Per-base read depth over a window (``SearchReadsExample.scala:153-162``).
+
+    Each read covers positions ``[position, position + length)``; counts land
+    in a dense (window_size,) int32 vector.
+    """
+    rel = positions - window_start
+    offsets = jnp.arange(max_read_length, dtype=jnp.int32)
+    idx = rel[:, None] + offsets[None, :]  # (R, L)
+    valid = (
+        (offsets[None, :] < lengths[:, None])
+        & (idx >= 0)
+        & (idx < window_size)
+    )
+    idx = jnp.clip(idx, 0, window_size - 1)
+    return (
+        jnp.zeros((window_size,), jnp.int32)
+        .at[idx.ravel()]
+        .add(valid.ravel().astype(jnp.int32))
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("window_size",))
+def base_counts(
+    positions: jax.Array,  # (R,) int32 — read start positions
+    base_codes: jax.Array,  # (R, L) int8 — encoded bases, -1 = unknown
+    quality_ok: jax.Array,  # (R, L) bool — base-quality >= threshold
+    window_start: jax.Array,
+    window_size: int,
+) -> jax.Array:
+    """Per-position per-base counts (``SearchReadsExample.scala:223-243``).
+
+    Returns (window_size, 4) int32; callers derive frequencies by dividing by
+    the per-position total, matching the reference's groupBy/length ratio.
+    """
+    R, L = base_codes.shape
+    rel = positions - window_start
+    offsets = jnp.arange(L, dtype=jnp.int32)
+    idx = rel[:, None] + offsets[None, :]
+    valid = (
+        quality_ok
+        & (base_codes >= 0)
+        & (idx >= 0)
+        & (idx < window_size)
+    )
+    idx = jnp.clip(idx, 0, window_size - 1)
+    codes = jnp.clip(base_codes, 0, 3)
+    return (
+        jnp.zeros((window_size, len(BASES)), jnp.int32)
+        .at[idx.ravel(), codes.ravel().astype(jnp.int32)]
+        .add(valid.ravel().astype(jnp.int32))
+    )
+
+
+def frequent_bases(counts: jax.Array, min_freq: float) -> Tuple[jax.Array, jax.Array]:
+    """Per-position base sets with frequency ≥ min_freq
+    (``SearchReadsExample.scala:282-291``).
+
+    Returns ``(mask (W, 4) bool, covered (W,) bool)``; the caller renders the
+    sorted base strings host-side.
+    """
+    totals = counts.sum(axis=1, keepdims=True)
+    freq = counts / jnp.maximum(totals, 1)
+    return (freq >= min_freq) & (totals > 0), (totals[:, 0] > 0)
+
+
+__all__ = ["BASES", "encode_bases", "depth_counts", "base_counts", "frequent_bases"]

@@ -1,0 +1,116 @@
+"""Principal components of the centered similarity matrix.
+
+The reference feeds centered rows into MLlib's
+``RowMatrix.computePrincipalComponents`` (``VariantsPca.scala:264-266``),
+which builds the column covariance and eigendecomposes it. For a Gower
+double-centered matrix B (symmetric, zero row/column means) the covariance is
+``BᵀB/(n−1) = B²/(n−1)``, whose eigenvectors are B's eigenvectors ordered by
+eigenvalue *magnitude*. So the TPU-native equivalent is a single
+``jnp.linalg.eigh`` on the HBM-resident B with |λ|-descending ordering —
+no covariance materialization, no driver round-trip. A unit test pins this
+equivalence against a literal NumPy replication of the MLlib semantics.
+
+Eigenvector sign is arbitrary in both implementations; we fix a deterministic
+convention (largest-magnitude component positive) so runs are reproducible.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("num_pc",))
+def principal_components(
+    centered: jax.Array, num_pc: int = 2
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k principal components of a centered symmetric matrix.
+
+    Returns ``(components, eigenvalues)`` where ``components`` is (N, k) —
+    row i is sample i's coordinates, matching the reference's consumption of
+    the MLlib result (``VariantsPca.scala:267-270``) — and ``eigenvalues``
+    holds the corresponding eigenvalues of B (descending |λ|).
+    """
+    B = centered.astype(jnp.float32)
+    # Symmetrize against accumulated roundoff; B is symmetric by construction.
+    B = (B + B.T) * 0.5
+    eigenvalues, eigenvectors = jnp.linalg.eigh(B)
+    order = jnp.argsort(-jnp.abs(eigenvalues))[:num_pc]
+    top = eigenvectors[:, order]
+    # Deterministic sign: largest-|component| entry of each PC is positive.
+    idx = jnp.argmax(jnp.abs(top), axis=0)
+    signs = jnp.sign(top[idx, jnp.arange(num_pc)])
+    signs = jnp.where(signs == 0, 1.0, signs)
+    return top * signs, eigenvalues[order]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_pc", "iterations", "oversample")
+)
+def principal_components_subspace(
+    centered: jax.Array,
+    num_pc: int = 2,
+    iterations: int = 80,
+    oversample: int = 8,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k principal components by subspace iteration + Rayleigh–Ritz.
+
+    The TPU-first eigensolver for the driver path: ``num_pc`` is tiny (the
+    reference defaults to 2, ``GenomicsConf.scala:76``), so the full O(N³)
+    ``eigh`` is the wrong tool — XLA's TPU eigh at N=2,504 compiles for
+    minutes, runs in tens of seconds, and degrades subsequent dispatch
+    throughput ~20× on remote-attached backends (measured), while subspace
+    iteration is a few hundred skinny (N×N)@(N×k) MXU matmuls: ~20 ms warm.
+    Subspace iteration converges to the largest-|λ| eigenpairs — exactly the
+    MLlib covariance ordering (see :func:`principal_components`). It also
+    extends to a row-sharded B unchanged, where sharded eigh would not.
+
+    Deterministic: fixed PRNG key, fixed iteration count, and the same sign
+    convention as :func:`principal_components`.
+    """
+    B = centered.astype(jnp.float32)
+    B = (B + B.T) * 0.5
+    n = B.shape[0]
+    k = min(num_pc + oversample, n)
+    V = jax.random.normal(jax.random.PRNGKey(0), (n, k), dtype=B.dtype)
+    V, _ = jnp.linalg.qr(V)
+
+    def body(_, V):
+        Q, _ = jnp.linalg.qr(B @ V)
+        return Q
+
+    V = jax.lax.fori_loop(0, iterations, body, V)
+    T = V.T @ (B @ V)
+    evals, W = jnp.linalg.eigh((T + T.T) * 0.5)
+    order = jnp.argsort(-jnp.abs(evals))[:num_pc]
+    top = V @ W[:, order]
+    idx = jnp.argmax(jnp.abs(top), axis=0)
+    signs = jnp.sign(top[idx, jnp.arange(num_pc)])
+    signs = jnp.where(signs == 0, 1.0, signs)
+    return top * signs, evals[order]
+
+
+def mllib_reference_pca(centered, num_pc: int = 2):
+    """NumPy oracle replicating MLlib ``computePrincipalComponents``
+    literally: column covariance of the rows, then eigh, descending
+    eigenvalues (used by tests to pin the equivalence argument above)."""
+    import numpy as np
+
+    M = np.asarray(centered, dtype=np.float64)
+    n = M.shape[0]
+    mean = M.mean(axis=0, keepdims=True)
+    cov = (M - mean).T @ (M - mean) / (n - 1)
+    eigenvalues, eigenvectors = np.linalg.eigh(cov)
+    order = np.argsort(-eigenvalues)[:num_pc]
+    return eigenvectors[:, order], eigenvalues[order]
+
+
+__all__ = [
+    "principal_components",
+    "principal_components_subspace",
+    "mllib_reference_pca",
+]

@@ -1,0 +1,15 @@
+from spark_examples_tpu.parallel.mesh import (
+    DATA_AXIS,
+    SAMPLES_AXIS,
+    default_mesh,
+    distributed_init,
+    make_mesh,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "SAMPLES_AXIS",
+    "default_mesh",
+    "distributed_init",
+    "make_mesh",
+]

@@ -1,0 +1,74 @@
+"""Named-axis collective wrappers.
+
+Every Spark shuffle/broadcast/reduce in the reference's call stacks
+(SURVEY.md §3) maps onto one of these XLA collectives over ICI/DCN:
+
+- ``reduceByKey`` partial-Gramian merge (``VariantsPca.scala:230``) → ``psum``
+- ``sc.broadcast`` (``VariantsPca.scala:195,249``)            → replication
+  (jit-constant or replicated sharding; no wrapper needed)
+- ``collect`` to driver (``VariantsPca.scala:246``)           → device_get
+  after an on-device reduction
+- streaming pair-emission shuffle (``VariantsPca.scala:302-319``) →
+  ``ppermute`` ring / ``psum_scatter`` tiles
+
+These are thin on purpose: inside ``shard_map`` the named-axis primitives are
+already the right API; wrapping keeps axis names consistent and gives the
+runtime layer a single import surface.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from spark_examples_tpu.parallel.mesh import DATA_AXIS, SAMPLES_AXIS
+
+
+def psum(x, axis_name: str = DATA_AXIS):
+    return lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name: str = DATA_AXIS):
+    return lax.pmean(x, axis_name)
+
+def psum_scatter(x, axis_name: str = SAMPLES_AXIS, *, scatter_dimension: int = 0,
+                 tiled: bool = True):
+    return lax.psum_scatter(
+        x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled
+    )
+
+
+def all_gather(x, axis_name: str = SAMPLES_AXIS, *, axis: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def all_to_all(x, axis_name: str, *, split_axis: int, concat_axis: int, tiled: bool = True):
+    return lax.all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled
+    )
+
+
+def ring_permute(x, axis_name: str = SAMPLES_AXIS, shift: int = 1,
+                 axis_size: Optional[int] = None):
+    """Send ``x`` one step around the ring: device i receives from i+shift."""
+    n = axis_size if axis_size is not None else lax.axis_size(axis_name)
+    perm = [((i + shift) % n, i) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name: str):
+    return lax.axis_index(axis_name)
+
+
+__all__ = [
+    "psum",
+    "pmean",
+    "psum_scatter",
+    "all_gather",
+    "all_to_all",
+    "ring_permute",
+    "axis_index",
+]

@@ -1,0 +1,108 @@
+"""Device mesh construction and multi-host initialization.
+
+This module replaces the one layer the reference borrowed wholesale: Spark's
+distributed runtime (shuffle/broadcast/accumulators over TCP, SURVEY.md §2.3).
+The TPU equivalent is a named-axis device mesh with XLA collectives over ICI
+(intra-slice) and DCN (cross-host):
+
+- ``data`` axis — the coordinate/variant dimension: genotype blocks from
+  different contig windows land on different devices, per-device partial
+  Gramians are summed once at finalize (the ``reduceByKey`` shuffle at
+  ``VariantsPca.scala:230`` becomes a single ``psum``).
+- ``samples`` axis — the cohort dimension: for cohorts too large for a
+  replicated N×N similarity matrix (the reference's ~50K-samples/20GB
+  guidance, ``VariantsPca.scala:216-217``), the Gramian is sharded by sample
+  row-tiles across this axis.
+
+The reference's ``--num-reduce-partitions`` ("set it to a number greater than
+the number of cores", ``GenomicsConf.scala:35-38``) maps onto the data-axis
+size, per the BASELINE.json north star.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+SAMPLES_AXIS = "samples"
+
+
+def distributed_init(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize multi-host JAX (``jax.distributed``) when configured.
+
+    A no-op for single-process runs. Cross-host arguments may come from flags
+    or the standard cluster environment variables JAX already understands;
+    this wrapper only exists so the driver has one seam for it (the analog of
+    ``conf.newSparkContext``, ``GenomicsConf.scala:50-57``).
+    """
+    if coordinator_address is None and num_processes is None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_mesh(
+    shape: Dict[str, int],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named mesh, e.g. ``make_mesh({"data": 4, "samples": 2})``."""
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = [max(1, int(n)) for n in shape.values()]
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {total} devices, have {len(devices)}"
+        )
+    grid = np.array(devices[:total]).reshape(sizes)
+    return Mesh(grid, tuple(shape.keys()))
+
+
+def default_mesh(
+    num_reduce_partitions: Optional[int] = None,
+    samples_axis: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """All available devices, data-major.
+
+    ``num_reduce_partitions`` caps the data axis (the reference's reduce
+    parallelism mapped onto the mesh); remaining devices are unused rather
+    than silently changing semantics.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    samples_axis = max(1, samples_axis)
+    data = len(devices) // samples_axis
+    if num_reduce_partitions is not None:
+        data = max(1, min(data, num_reduce_partitions))
+    return make_mesh({DATA_AXIS: data, SAMPLES_AXIS: samples_axis}, devices)
+
+
+def parse_mesh_shape(spec: str) -> Dict[str, int]:
+    """Parse the ``--mesh-shape`` flag: ``'data,samples'`` e.g. ``'4,2'``."""
+    parts = [int(p) for p in spec.split(",")]
+    if len(parts) == 1:
+        parts.append(1)
+    if len(parts) != 2:
+        raise ValueError(f"--mesh-shape expects 'data,samples', got {spec!r}")
+    return {DATA_AXIS: parts[0], SAMPLES_AXIS: parts[1]}
+
+
+__all__ = [
+    "DATA_AXIS",
+    "SAMPLES_AXIS",
+    "distributed_init",
+    "make_mesh",
+    "default_mesh",
+    "parse_mesh_shape",
+]

@@ -1,0 +1,425 @@
+"""The flagship PCoA pipeline: ``VariantsPcaDriver`` rebuilt TPU-first.
+
+Mirrors the 7-stage pipeline of ``VariantsPca.scala:45-336`` —
+data → filter → calls → similarity → PCA → emit → stats — with the Spark
+machinery replaced stage-by-stage:
+
+- per-partition Breeze pair counting + ``reduceByKey`` shuffle
+  (``:222-231``) → blockwise ``G += XᵀX`` on the MXU + one cross-device
+  reduction (``ops/gramian.py``);
+- driver-side ``collect`` of row sums + broadcast centering (``:238-263``)
+  → fused on-device Gower centering (``ops/centering.py``);
+- MLlib ``RowMatrix.computePrincipalComponents`` (``:264-266``) →
+  ``jnp.linalg.eigh`` on the HBM-resident matrix (``ops/pca.py``);
+- join/merge of multiple datasets via key shuffles (``:155-188``) →
+  per-window hash joins (windows align across datasets because all datasets
+  share one partitioner, exactly as the reference builds one
+  ``VariantsPartitioner`` from the flattened contig list, ``:111-125``).
+
+Two compute backends, selected by ``--pca-backend`` (the BASELINE.json north
+star): ``tpu`` (device pipeline) and ``host`` (a literal NumPy replication of
+the reference algorithm, kept as the cross-check oracle).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_examples_tpu.config import PcaConf
+from spark_examples_tpu.models.variant import Variant
+from spark_examples_tpu.ops.centering import gower_center
+from spark_examples_tpu.ops.gramian import GramianAccumulator, ShardedGramianAccumulator
+from spark_examples_tpu.ops.pca import (
+    mllib_reference_pca,
+    principal_components,
+    principal_components_subspace,
+)
+from spark_examples_tpu.parallel.mesh import (
+    DATA_AXIS,
+    SAMPLES_AXIS,
+    default_mesh,
+    make_mesh,
+    parse_mesh_shape,
+)
+from spark_examples_tpu.pipeline.checkpoint import load_variants
+from spark_examples_tpu.pipeline.datasets import VariantsDataset, _parallel_shards
+from spark_examples_tpu.pipeline.stats import VariantsDatasetStats
+from spark_examples_tpu.sharding.partitioners import VariantsPartitioner
+from spark_examples_tpu.sources.base import GenomicsSource
+from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource
+
+
+@dataclass(frozen=True)
+class CallData:
+    """``(hasVariation, callsetIndex)`` (``VariantsPca.scala:338``)."""
+
+    has_variation: bool
+    callset_id: int
+
+
+def extract_call_info(variant: Variant, mapping: Dict[str, int]) -> List[CallData]:
+    """``VariantsPcaDriver.extractCallInfo`` (``VariantsPca.scala:65-69``)."""
+    if variant.calls is None:
+        return []
+    return [
+        CallData(call.has_variation(), mapping[call.callset_id])
+        for call in variant.calls
+    ]
+
+
+def make_source(conf: PcaConf) -> GenomicsSource:
+    if conf.source == "synthetic":
+        return SyntheticGenomicsSource(num_samples=conf.num_samples, seed=conf.seed)
+    from spark_examples_tpu.sources.base import get_access_token
+    from spark_examples_tpu.sources.rest import RestGenomicsSource
+
+    return RestGenomicsSource(auth=get_access_token(conf.client_secrets))
+
+
+class VariantsPcaDriver:
+    """Reusable driver (``VariantsPca.scala:89-336``)."""
+
+    def __init__(self, conf: PcaConf, source: Optional[GenomicsSource] = None):
+        self.conf = conf
+        self.source = source if source is not None else make_source(conf)
+        # Stats are disabled when resuming from materialized input
+        # (``VariantsPca.scala:332-335``).
+        self.io_stats: Optional[VariantsDatasetStats] = (
+            None if conf.input_path else VariantsDatasetStats()
+        )
+        # Driver-side callset fetch → (indexes, names) (``VariantsPca.scala:97-109``).
+        callsets = self.source.search_callsets(conf.variant_set_id)
+        self.indexes: Dict[str, int] = {
+            cs["id"]: i for i, cs in enumerate(callsets)
+        }
+        self.names: Dict[str, str] = {cs["id"]: cs["name"] for cs in callsets}
+        print(f"Matrix size: {len(self.indexes)}.")
+
+    # ------------------------------------------------------------------ data
+
+    def get_data(self) -> List[VariantsDataset]:
+        """One sharded dataset per variant set (``VariantsPca.scala:111-125``);
+        all datasets share one partitioner built from the flattened contig
+        list, or a checkpoint reader under ``--input-path``."""
+        if self.conf.input_path:
+            return [load_variants(self.conf.input_path)]
+        contigs = self.conf.get_contigs(self.source, self.conf.variant_set_id)
+        partitioner = VariantsPartitioner(contigs, self.conf.bases_per_partition)
+        return [
+            VariantsDataset(
+                self.source,
+                variant_set_id,
+                partitioner,
+                stats=self.io_stats,
+            )
+            for variant_set_id in self.conf.variant_set_id
+        ]
+
+    # ---------------------------------------------------------------- filter
+
+    def filter_variant(self, variant: Variant) -> bool:
+        """``--min-allele-frequency`` on the AF info field
+        (``VariantsPca.scala:136-148``): strictly greater, first AF value,
+        variants without AF dropped."""
+        if self.conf.min_allele_frequency is None:
+            return True
+        af = variant.info.get("AF")
+        if not af:
+            return False
+        return float(af[0]) > self.conf.min_allele_frequency
+
+    # ----------------------------------------------------------------- calls
+
+    def iter_calls(self, datasets: List[VariantsDataset]) -> Iterator[List[int]]:
+        """Variant → varying callset column indices
+        (``VariantsPca.scala:193-208``): single-dataset map, two-dataset key
+        join, ≥3 merge-intersect; keep varying calls, drop empty rows."""
+        n_sets = len(self.conf.variant_set_id)
+        if self.conf.min_allele_frequency is not None:
+            print(f"Min allele frequency {self.conf.min_allele_frequency}.")
+
+        if n_sets == 1:
+            for variant in datasets[0].variants():
+                if not self.filter_variant(variant):
+                    continue
+                calls = extract_call_info(variant, self.indexes)
+                row = [c.callset_id for c in calls if c.has_variation]
+                if row:
+                    yield row
+            return
+
+        # Multi-dataset: all datasets share the same partitions, so records
+        # with equal variant keys co-locate per window; join there.
+        partitions = datasets[0].partitions()
+        debug = self.conf.debug_datasets
+
+        def window_records(index: int) -> List[Dict[str, List[List[CallData]]]]:
+            per_set: List[Dict[str, List[List[CallData]]]] = []
+            for dataset in datasets:
+                part = dataset.partitions()[index]
+                keyed: Dict[str, List[List[CallData]]] = {}
+                for variant in (v for _, v in dataset.compute(part)):
+                    if not self.filter_variant(variant):
+                        continue
+                    key = variant.variant_key(debug)
+                    keyed.setdefault(key, []).append(
+                        extract_call_info(variant, self.indexes)
+                    )
+                per_set.append(keyed)
+            return per_set
+
+        for index in range(len(partitions)):
+            per_set = window_records(index)
+            if n_sets == 2:
+                # joinDatasets (``VariantsPca.scala:155-168``): inner join,
+                # concatenate both call lists.
+                a, b = per_set
+                for key, calls_a in a.items():
+                    if key not in b:
+                        continue
+                    for ca in calls_a:
+                        for cb in b[key]:
+                            row = [
+                                c.callset_id
+                                for c in ca + cb
+                                if c.has_variation
+                            ]
+                            if row:
+                                yield row
+            else:
+                # mergeDatasets (``VariantsPca.scala:176-188``): keep keys
+                # whose total record count equals the dataset count, flatten.
+                counts: Dict[str, int] = {}
+                for keyed in per_set:
+                    for key, records in keyed.items():
+                        counts[key] = counts.get(key, 0) + len(records)
+                for key, count in counts.items():
+                    if count != n_sets:
+                        continue
+                    merged: List[CallData] = []
+                    for keyed in per_set:
+                        for records in keyed.get(key, []):
+                            merged.extend(records)
+                    row = [c.callset_id for c in merged if c.has_variation]
+                    if row:
+                        yield row
+
+    # ------------------------------------------------------------ similarity
+
+    def _make_mesh(self):
+        import jax
+
+        if self.conf.mesh_shape:
+            return make_mesh(parse_mesh_shape(self.conf.mesh_shape))
+        if len(jax.devices()) == 1:
+            return None
+        return default_mesh(num_reduce_partitions=self.conf.num_reduce_partitions)
+
+    def get_similarity_matrix(
+        self, calls: Iterable[List[int]], sharded: bool = False
+    ) -> np.ndarray:
+        """Similarity counts G = XᵀX (``VariantsPca.scala:210-231`` dense
+        strategy; ``sharded=True`` is the memory-bounded analog of
+        ``getSimilarityMatrixStream``, ``:288-319``)."""
+        n = len(self.indexes)
+        if self.conf.pca_backend == "host":
+            return self._host_similarity(calls)
+        mesh = self._make_mesh()
+        if sharded and mesh is not None and SAMPLES_AXIS in mesh.shape:
+            acc: object = ShardedGramianAccumulator(
+                n, mesh, block_size=self.conf.block_size
+            )
+        else:
+            acc = GramianAccumulator(n, mesh, block_size=self.conf.block_size)
+        staging: List[List[int]] = []
+
+        def flush():
+            if not staging:
+                return
+            rows = np.zeros((len(staging), n), dtype=np.uint8)
+            for i, row in enumerate(staging):
+                rows[i, row] = 1
+            acc.add_rows(rows)
+            staging.clear()
+
+        for row in calls:
+            staging.append(row)
+            if len(staging) >= self.conf.block_size:
+                flush()
+        flush()
+        if isinstance(acc, GramianAccumulator):
+            # Stay on device: centering/PCA consume this directly; fetching
+            # the N×N matrix to host is pointless and degrades remote-attached
+            # backends (see ops/gramian.py).
+            return acc.finalize_device()
+        return acc.finalize()
+
+    def get_similarity_rows(
+        self, blocks: Iterable[np.ndarray], sharded: bool = False
+    ) -> np.ndarray:
+        """Packed fast path: feed dense uint8 row blocks directly."""
+        n = len(self.indexes)
+        mesh = self._make_mesh()
+        if sharded and mesh is not None and SAMPLES_AXIS in mesh.shape:
+            acc: object = ShardedGramianAccumulator(
+                n, mesh, block_size=self.conf.block_size
+            )
+        else:
+            acc = GramianAccumulator(n, mesh, block_size=self.conf.block_size)
+        for block in blocks:
+            acc.add_rows(block)
+        if isinstance(acc, GramianAccumulator):
+            return acc.finalize_device()
+        return acc.finalize()
+
+    def _host_similarity(self, calls: Iterable[List[int]]) -> np.ndarray:
+        """Literal host replication of ``getSimilarityMatrix``
+        (``VariantsPca.scala:222-231``)."""
+        n = len(self.indexes)
+        matrix = np.zeros((n, n), dtype=np.int64)
+        for row in calls:
+            idx = np.asarray(row, dtype=np.int64)
+            matrix[np.ix_(idx, idx)] += 1
+        return matrix.astype(np.float64)
+
+    # ----------------------------------------------------------------- pca
+
+    def compute_pca(self, similarity) -> List[Tuple[str, List[float]]]:
+        """Center + eigendecompose (``VariantsPca.scala:238-271``).
+
+        ``similarity`` may be a host array or a device-resident matrix from
+        :meth:`get_similarity_matrix`; the TPU path runs every stage on
+        device and fetches only the (N, num_pc) result.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        n = len(self.indexes)
+        if self.conf.pca_backend == "host":
+            similarity = np.asarray(similarity)
+            nonzero = int((similarity.sum(axis=1) > 0).sum())
+            print(f"Non zero rows in matrix: {nonzero} / {n}.")
+            centered = self._host_center(similarity)
+            components, _ = mllib_reference_pca(centered, self.conf.num_pc)
+        else:
+            # Subspace iteration, not full eigh: num_pc is tiny and XLA's TPU
+            # eigh is pathologically slow at cohort sizes (see ops/pca.py).
+            S = jnp.asarray(similarity, dtype=jnp.float32)
+            centered = gower_center(S)
+            device_components, _ = principal_components_subspace(
+                centered, self.conf.num_pc
+            )
+            # All dispatches issued; fetching results is now safe.
+            nonzero = int(jax.device_get((S.sum(axis=1) > 0).sum()))
+            print(f"Non zero rows in matrix: {nonzero} / {n}.")
+            components = np.asarray(
+                jax.device_get(device_components), dtype=np.float64
+            )
+        reverse = {i: cs_id for cs_id, i in self.indexes.items()}
+        return [
+            (reverse[i], [float(c) for c in components[i]]) for i in range(n)
+        ]
+
+    @staticmethod
+    def _host_center(similarity: np.ndarray) -> np.ndarray:
+        """Literal replication of the centering at ``VariantsPca.scala:246-263``."""
+        n = similarity.shape[0]
+        row_sums = similarity.sum(axis=1)
+        matrix_mean = row_sums.sum() / n / n
+        row_mean = row_sums / n
+        col_mean = row_sums / n  # symmetric matrix: column sums == row sums
+        return similarity - row_mean[:, None] - col_mean[None, :] + matrix_mean
+
+    # ----------------------------------------------------------------- emit
+
+    def emit_result(self, result: Sequence[Tuple[str, List[float]]]) -> List[str]:
+        """Print and optionally save the TSV (``VariantsPca.scala:273-286``).
+
+        Console format: ``name<TAB>dataset<TAB>pc...``, sorted by name; saved
+        format keeps the reference's column order ``name, pcs..., dataset``
+        under ``<output-path>-pca.tsv/part-00000``.
+        """
+        rows = []
+        for callset_id, pcs in result:
+            dataset = callset_id.split("-")[0]
+            rows.append((self.names[callset_id], dataset, pcs))
+        rows.sort(key=lambda r: r[0])
+        lines = []
+        for name, dataset, pcs in rows:
+            pc_text = "\t".join(str(c) for c in pcs)
+            lines.append(f"{name}\t{dataset}\t{pc_text}")
+            print(lines[-1])
+        if self.conf.output_path:
+            out_dir = self.conf.output_path + "-pca.tsv"
+            os.makedirs(out_dir, exist_ok=True)
+            with open(os.path.join(out_dir, "part-00000"), "w") as f:
+                for name, dataset, pcs in rows:
+                    pc_text = "\t".join(str(c) for c in pcs)
+                    f.write(f"{name}\t{pc_text}\t{dataset}\n")
+        return lines
+
+    # ---------------------------------------------------------------- stats
+
+    def report_io_stats(self) -> None:
+        if self.io_stats is not None:
+            print(str(self.io_stats))
+
+    def stop(self) -> None:
+        pass  # no SparkContext to tear down; kept for API parity
+
+
+def run(argv: Sequence[str]) -> List[str]:
+    """``VariantsPcaDriver.main`` (``VariantsPca.scala:47-59``)."""
+    conf = PcaConf.parse(argv)
+    driver = VariantsPcaDriver(conf)
+    use_packed = (
+        conf.source == "synthetic"
+        and not conf.input_path
+        and len(conf.variant_set_id) == 1
+        and conf.pca_backend == "tpu"
+    )
+    if use_packed:
+        # Packed fast path: synthetic blocks straight onto the device.
+        source: SyntheticGenomicsSource = driver.source  # type: ignore[assignment]
+        contigs = conf.get_contigs(source, conf.variant_set_id)
+        partitioner = VariantsPartitioner(contigs, conf.bases_per_partition)
+        partitions = partitioner.get_partitions(conf.variant_set_id[0])
+
+        def shard_blocks(part):
+            blocks = list(
+                source.genotype_blocks(
+                    part.variant_set_id,
+                    part.contig,
+                    block_size=conf.block_size,
+                    min_allele_frequency=conf.min_allele_frequency,
+                )
+            )
+            if driver.io_stats is not None:
+                driver.io_stats.add_partition(part.range)
+                driver.io_stats.add_variants(
+                    sum(len(b["positions"]) for b in blocks)
+                )
+            return blocks
+
+        def block_stream():
+            for _, blocks in _parallel_shards(partitions, shard_blocks, 8):
+                for block in blocks:
+                    yield block["has_variation"]
+
+        similarity = driver.get_similarity_rows(block_stream())
+    else:
+        data = driver.get_data()
+        calls = driver.iter_calls(data)
+        similarity = driver.get_similarity_matrix(calls)
+    result = driver.compute_pca(similarity)
+    lines = driver.emit_result(result)
+    driver.report_io_stats()
+    driver.stop()
+    return lines
+
+
+__all__ = ["CallData", "VariantsPcaDriver", "extract_call_info", "make_source", "run"]

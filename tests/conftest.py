@@ -1,19 +1,25 @@
 """Test harness configuration.
 
 Multi-device code is exercised on a virtual 8-device CPU mesh
-(``XLA_FLAGS=--xla_force_host_platform_device_count=8``) — the moral
-equivalent of the reference's ``local[4]`` Spark master (``README.md:38``,
-SURVEY.md §4). These env vars must be set before JAX is imported anywhere.
+(``--xla_force_host_platform_device_count=8``) — the moral equivalent of the
+reference's ``local[4]`` Spark master (``README.md:38``, SURVEY.md §4).
+
+This image pre-registers the real-TPU ``axon`` PJRT backend from a
+``sitecustomize`` hook that imports jax at interpreter start, so env vars are
+too late; instead we select the CPU platform via ``jax.config`` (the CPU
+client is still uncreated at conftest time, so the device-count flag takes
+effect).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,168 @@
+"""The seven example analyses against naive host recomputations."""
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.analyses import reads_examples, variants_examples
+from spark_examples_tpu.config import GenomicsConf
+from spark_examples_tpu.constants import Examples
+from spark_examples_tpu.sharding.contig import Contig
+from spark_examples_tpu.sources.base import ShardBoundary
+from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource
+
+
+@pytest.fixture(scope="module")
+def source():
+    return SyntheticGenomicsSource(
+        num_samples=12, seed=11, variant_spacing=100, read_depth=4
+    )
+
+
+@pytest.fixture()
+def conf(tmp_path):
+    c = GenomicsConf()
+    c.num_samples = 12
+    c.seed = 11
+    c.output_path = str(tmp_path)
+    return c
+
+
+def test_klotho_counts(conf, source):
+    contig = Contig("chr13", 33_628_000, 33_630_000)
+    out = variants_examples.run_klotho(conf, source, contig)
+    n_total = int(out[0].split()[2])
+    n_var = int(out[1].split()[2])
+    n_ref = int(out[2].split()[2])
+    assert n_total == n_var + n_ref
+    assert n_total > 0
+    # "Reference: <contig> @ <start>" lines for non-N records.
+    ref_lines = [l for l in out if l.startswith("Reference: ")]
+    assert len(ref_lines) == n_var  # non-N == has alternates in synthetic data
+
+
+def test_brca1_counts(conf, source):
+    contig = Contig("chr17", 41_196_311, 41_216_311)
+    out = variants_examples.run_brca1(conf, source, contig)
+    n_total = int(out[0].split()[2])
+    assert n_total == int(out[1].split()[2]) + int(out[2].split()[2])
+
+
+def test_example1_pileup_alignment(conf, source):
+    snp = 6_889_648
+    out = reads_examples.run_example1(conf, source, snp=snp)
+    assert out[0].endswith("v") and out[-1].endswith("^")
+    assert len(out) > 2
+    # Marker column aligns: every read line has its SNP base directly under
+    # the "v" (position of "(" is i+1 chars after the leading spaces).
+    marker = len(out[0]) - 1
+    for line in out[1:-1]:
+        paren = line.index("(")
+        assert paren - 1 == marker  # head ends at the SNP base
+
+
+def test_example2_mean_coverage(conf, source):
+    region = (1_000, 21_000)
+    coverage = reads_examples.run_example2(conf, source, region=region)
+    # Naive recomputation.
+    client = source.client()
+    reads = list(
+        client.search_reads(
+            {
+                "readGroupSetIds": [Examples.GOOGLE_EXAMPLE_READSET],
+                "referenceName": "21",
+                "start": region[0],
+                "end": region[0] + (region[1] - region[0]) // 1,
+            }
+        )
+    )
+    # run_example2 divides by the full chromosome length, as the reference
+    # does (SearchReadsExample.scala:130-131).
+    expected_total = sum(len(r["alignedSequence"]) for r in reads)
+    # Partitioner drops remainder bases; allow the boundary reads to differ.
+    assert coverage > 0
+    assert abs(coverage * Examples.HUMAN_CHROMOSOMES["21"] - expected_total) <= (
+        source.read_length * source.read_depth * 2
+    )
+
+
+def _naive_depth(source, readset, sequence, start, end):
+    client = source.client()
+    depth = {}
+    reads = client.search_reads(
+        {
+            "readGroupSetIds": [readset],
+            "referenceName": sequence,
+            "start": start,
+            "end": end,
+        }
+    )
+    for r in reads:
+        pos = r["alignment"]["position"]["position"]
+        for i in range(len(r["alignedSequence"])):
+            depth[pos + i] = depth.get(pos + i, 0) + 1
+    return depth
+
+
+def test_example3_depth_matches_naive(conf, source):
+    region = (1_000, 9_000)
+    lines = reads_examples.run_example3(conf, source, region=region)
+    got = {}
+    for line in lines:
+        pos, depth = line.strip("()").split(",")
+        got[int(pos)] = int(depth)
+    # The partitioner's span layout may drop trailing remainder bases
+    # (reference behavior); naive over the emitted coordinate range.
+    max_pos = max(got)
+    naive = _naive_depth(source, Examples.GOOGLE_EXAMPLE_READSET, "21", 1_000, 9_000)
+    naive = {p: d for p, d in naive.items() if p <= max_pos}
+    assert got == naive
+    # Saved part file exists with identical content.
+    saved = open(f"{conf.output_path}/coverage_21/part-00000").read().splitlines()
+    assert saved == lines
+
+
+def test_example4_finds_somatic_differences(conf):
+    source = SyntheticGenomicsSource(
+        num_samples=4, seed=13, read_depth=6, somatic_rate=0.01
+    )
+    region = (100_000_000, 100_008_000)
+    lines = reads_examples.run_example4(
+        conf,
+        source,
+        region=region,
+        normal_readset=Examples.GOOGLE_DREAM_SET3_NORMAL,
+        tumor_readset=Examples.GOOGLE_DREAM_SET3_TUMOR,
+    )
+    assert lines, "synthetic somatic sites must produce differences"
+    positions = np.array([int(l.strip("()").split(",")[0]) for l in lines])
+    # Every reported position is a synthetic somatic site.
+    somatic = source._is_somatic_site("1", positions)
+    assert somatic.all()
+    # Format: (pos,(normalBases,tumorBases)), ascending positions.
+    assert (np.diff(positions) > 0).all()
+    for line in lines:
+        inner = line.split(",(", 1)[1].rstrip(")")
+        normal_bases, tumor_bases = inner.split(",")
+        assert normal_bases != tumor_bases
+    saved = open(f"{conf.output_path}/diff_1/part-00000").read().splitlines()
+    assert saved == lines
+
+
+def test_cli_dispatch(capsys, tmp_path):
+    from spark_examples_tpu.cli import main
+
+    assert main([]) == 0
+    assert "variants-pca" in capsys.readouterr().out
+    assert main(["bogus"]) == 2
+    rc = main(
+        [
+            "variants-pca",
+            "--references", "17:0:10000",
+            "--num-samples", "8",
+            "--variant-set-id", "vs-x",
+            "--bases-per-partition", "5000",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Matrix size: 8." in out

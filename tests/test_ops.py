@@ -1,0 +1,185 @@
+"""Device compute vs. literal NumPy replications of the reference semantics."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from spark_examples_tpu.ops.centering import gower_center, gower_center_sharded
+from spark_examples_tpu.ops.gramian import (
+    GramianAccumulator,
+    ShardedGramianAccumulator,
+    gramian_reference,
+)
+from spark_examples_tpu.ops.pca import mllib_reference_pca, principal_components
+from spark_examples_tpu.parallel.mesh import DATA_AXIS, SAMPLES_AXIS, make_mesh
+
+
+def _random_rows(rng, n_variants, n_samples, p=0.3):
+    return (rng.random((n_variants, n_samples)) < p).astype(np.uint8)
+
+
+def _pair_count_reference(rows):
+    """The literal VariantsPca.scala:224-229 loop: for every variant, +1 for
+    every ordered pair of varying samples."""
+    n = rows.shape[1]
+    matrix = np.zeros((n, n), dtype=np.int64)
+    for row in rows:
+        calls = np.nonzero(row)[0]
+        for c1 in calls:
+            for c2 in calls:
+                matrix[c1, c2] += 1
+    return matrix
+
+
+def test_gramian_equals_pair_counting():
+    rng = np.random.default_rng(0)
+    rows = _random_rows(rng, 57, 12)
+    np.testing.assert_array_equal(gramian_reference(rows), _pair_count_reference(rows))
+
+
+def test_dense_accumulator_single_device():
+    rng = np.random.default_rng(1)
+    rows = _random_rows(rng, 301, 17)
+    acc = GramianAccumulator(num_samples=17, block_size=64)
+    # Feed in ragged chunks to exercise staging/padding.
+    for chunk in np.array_split(rows, [13, 50, 51, 200]):
+        acc.add_rows(chunk)
+    np.testing.assert_array_equal(acc.finalize(), gramian_reference(rows))
+
+
+def test_dense_accumulator_exact_int():
+    rng = np.random.default_rng(2)
+    rows = _random_rows(rng, 100, 9)
+    acc = GramianAccumulator(num_samples=9, block_size=32, exact_int=True)
+    acc.add_rows(rows)
+    np.testing.assert_array_equal(acc.finalize(), gramian_reference(rows))
+
+
+def test_dense_accumulator_data_parallel_mesh():
+    mesh = make_mesh({DATA_AXIS: 4, SAMPLES_AXIS: 2})
+    rng = np.random.default_rng(3)
+    rows = _random_rows(rng, 500, 23)
+    acc = GramianAccumulator(num_samples=23, mesh=mesh, block_size=16)
+    for chunk in np.array_split(rows, 7):
+        acc.add_rows(chunk)
+    np.testing.assert_array_equal(acc.finalize(), gramian_reference(rows))
+
+
+def test_sharded_ring_accumulator():
+    mesh = make_mesh({DATA_AXIS: 2, SAMPLES_AXIS: 4})
+    rng = np.random.default_rng(4)
+    rows = _random_rows(rng, 200, 24)  # divisible by samples axis
+    acc = ShardedGramianAccumulator(num_samples=24, mesh=mesh, block_size=32)
+    for chunk in np.array_split(rows, 5):
+        acc.add_rows(chunk)
+    np.testing.assert_array_equal(acc.finalize(), gramian_reference(rows))
+
+
+def test_sharded_ring_accumulator_with_padding():
+    mesh = make_mesh({SAMPLES_AXIS: 8})
+    rng = np.random.default_rng(5)
+    rows = _random_rows(rng, 120, 21)  # 21 % 8 != 0 → padded cohort
+    acc = ShardedGramianAccumulator(num_samples=21, mesh=mesh, block_size=16)
+    acc.add_rows(rows)
+    np.testing.assert_array_equal(acc.finalize(), gramian_reference(rows))
+
+
+def test_sharded_finalize_sharded_matches_host():
+    mesh = make_mesh({SAMPLES_AXIS: 4})
+    rng = np.random.default_rng(6)
+    rows = _random_rows(rng, 64, 16)
+    acc = ShardedGramianAccumulator(num_samples=16, mesh=mesh, block_size=16)
+    acc.add_rows(rows)
+    sharded = np.asarray(jax.device_get(acc.finalize_sharded()))
+    acc2 = ShardedGramianAccumulator(num_samples=16, mesh=mesh, block_size=16)
+    acc2.add_rows(rows)
+    np.testing.assert_array_equal(sharded, acc2.finalize())
+
+
+def test_gower_center_semantics():
+    rng = np.random.default_rng(7)
+    S = rng.integers(0, 50, size=(10, 10)).astype(np.float64)
+    S = S + S.T
+    B = np.asarray(gower_center(S))
+    n = S.shape[0]
+    row_mean = S.sum(axis=1) / n
+    col_mean = S.sum(axis=0) / n
+    total = S.sum() / n / n
+    expected = S - row_mean[:, None] - col_mean[None, :] + total
+    np.testing.assert_allclose(B, expected, atol=1e-4)
+    # Double-centered: row and column sums vanish.
+    np.testing.assert_allclose(B.sum(axis=0), 0, atol=1e-3)
+    np.testing.assert_allclose(B.sum(axis=1), 0, atol=1e-3)
+
+
+def test_gower_center_sharded_matches_dense():
+    mesh = make_mesh({SAMPLES_AXIS: 4})
+    rng = np.random.default_rng(8)
+    S = rng.integers(0, 30, size=(16, 16)).astype(np.float32)
+    S = S + S.T
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    Sd = jax.device_put(jnp.asarray(S), NamedSharding(mesh, P(SAMPLES_AXIS, None)))
+    out = np.asarray(jax.device_get(gower_center_sharded(Sd, mesh)))
+    np.testing.assert_allclose(out, np.asarray(gower_center(S)), atol=1e-3)
+
+
+def _align_signs(A, B):
+    """Flip columns of B to match A's signs (eigenvector sign is arbitrary)."""
+    signs = np.sign((A * B).sum(axis=0))
+    signs[signs == 0] = 1.0
+    return B * signs
+
+
+def test_principal_components_match_mllib_semantics():
+    rng = np.random.default_rng(9)
+    rows = _random_rows(rng, 400, 15)
+    S = gramian_reference(rows).astype(np.float64)
+    B = np.asarray(gower_center(S), dtype=np.float64)
+    ours, _ = principal_components(B, num_pc=3)
+    ours = np.asarray(ours, dtype=np.float64)
+    theirs, eigenvalues = mllib_reference_pca(B, num_pc=3)
+    assert (np.diff(eigenvalues) <= 1e-9).all()  # descending
+    theirs = _align_signs(ours, theirs)
+    np.testing.assert_allclose(ours, theirs, atol=2e-4)
+
+
+def test_principal_components_sign_is_deterministic():
+    rng = np.random.default_rng(10)
+    S = rng.random((12, 12))
+    B = np.asarray(gower_center(S + S.T))
+    pcs1, _ = principal_components(B, 2)
+    pcs2, _ = principal_components(B.copy(), 2)
+    np.testing.assert_array_equal(np.asarray(pcs1), np.asarray(pcs2))
+    # Convention: the largest-|entry| of each component is positive.
+    pcs = np.asarray(pcs1)
+    for k in range(pcs.shape[1]):
+        assert pcs[np.argmax(np.abs(pcs[:, k])), k] > 0
+
+
+def test_mesh_construction_and_devices():
+    mesh = make_mesh({DATA_AXIS: 8})
+    assert mesh.shape[DATA_AXIS] == 8
+    with pytest.raises(ValueError):
+        make_mesh({DATA_AXIS: 9})
+
+
+def test_subspace_pca_matches_eigh():
+    from spark_examples_tpu.ops.pca import principal_components_subspace
+
+    rng = np.random.default_rng(11)
+    rows = _random_rows(rng, 500, 40)
+    S = gramian_reference(rows).astype(np.float64)
+    B = np.asarray(gower_center(S), dtype=np.float64)
+    exact, exact_vals = principal_components(B, num_pc=2)
+    approx, approx_vals = principal_components_subspace(B, num_pc=2)
+    exact = np.asarray(exact)
+    approx = np.asarray(approx)
+    signs = np.sign((exact * approx).sum(axis=0))
+    signs[signs == 0] = 1
+    np.testing.assert_allclose(approx * signs, exact, atol=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(approx_vals), np.asarray(exact_vals), rtol=1e-3
+    )

@@ -1,0 +1,231 @@
+"""End-to-end flagship pipeline: TPU backend vs. the literal host replication
+of the reference algorithm, multi-dataset join/merge, checkpoint resume,
+emit formats."""
+
+import os
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.config import PcaConf
+from spark_examples_tpu.pipeline import pca_driver
+from spark_examples_tpu.pipeline.checkpoint import load_variants, save_variants
+from spark_examples_tpu.pipeline.pca_driver import VariantsPcaDriver, extract_call_info
+from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource
+
+
+def _conf(**kw):
+    base = dict(
+        references="17:0:20000",
+        variant_set_id=["vs-a"],
+        num_samples=30,
+        seed=7,
+        bases_per_partition=5000,
+        block_size=64,
+    )
+    base.update(kw)
+    conf = PcaConf()
+    for k, v in base.items():
+        setattr(conf, k, v)
+    return conf
+
+
+def _source(conf):
+    return SyntheticGenomicsSource(num_samples=conf.num_samples, seed=conf.seed)
+
+
+def test_extract_call_info_semantics(small_source):
+    conf = _conf(num_samples=40)
+    driver = VariantsPcaDriver(conf, small_source)
+    data = driver.get_data()
+    variant = next(data[0].variants())
+    calls = extract_call_info(variant, driver.indexes)
+    assert len(calls) == 40
+    for call, model_call in zip(calls, variant.calls):
+        assert call.has_variation == any(g > 0 for g in model_call.genotype)
+        assert call.callset_id == driver.indexes[model_call.callset_id]
+
+
+def test_similarity_tpu_matches_host_reference():
+    conf = _conf()
+    driver = VariantsPcaDriver(conf, _source(conf))
+    calls = list(driver.iter_calls(driver.get_data()))
+    assert calls
+    tpu = driver.get_similarity_matrix(calls)
+
+    conf_host = _conf(pca_backend="host")
+    driver_host = VariantsPcaDriver(conf_host, _source(conf_host))
+    host = driver_host.get_similarity_matrix(iter(calls))
+    np.testing.assert_array_equal(tpu, host)
+    # Diagonal counts = per-sample variant counts.
+    assert (np.diag(host) > 0).any()
+
+
+def test_pca_tpu_matches_host_reference():
+    conf = _conf(references="17:0:40000")
+    driver = VariantsPcaDriver(conf, _source(conf))
+    calls = list(driver.iter_calls(driver.get_data()))
+    S = driver.get_similarity_matrix(calls)
+    ours = driver.compute_pca(S)
+
+    conf_host = _conf(references="17:0:40000", pca_backend="host")
+    driver_host = VariantsPcaDriver(conf_host, _source(conf_host))
+    theirs = driver_host.compute_pca(S)
+
+    A = np.array([pcs for _, pcs in ours])
+    B = np.array([pcs for _, pcs in theirs])
+    # Align arbitrary eigenvector signs, then compare.
+    signs = np.sign((A * B).sum(axis=0))
+    signs[signs == 0] = 1
+    np.testing.assert_allclose(A, B * signs, atol=5e-3)
+    assert [cid for cid, _ in ours] == [cid for cid, _ in theirs]
+
+
+def test_pca_separates_populations():
+    conf = _conf(references="17:0:100000", num_samples=24)
+    source = SyntheticGenomicsSource(num_samples=24, seed=3, n_pops=2)
+    driver = VariantsPcaDriver(conf, source)
+    calls = list(driver.iter_calls(driver.get_data()))
+    S = driver.get_similarity_matrix(calls)
+    result = driver.compute_pca(S)
+    pc1 = np.array([pcs[0] for _, pcs in result])
+    pops = np.asarray(source._pops)
+    # PC1 separates the two synthetic populations almost perfectly.
+    means = [pc1[pops == p].mean() for p in (0, 1)]
+    spread = max(pc1[pops == p].std() for p in (0, 1))
+    assert abs(means[0] - means[1]) > 3 * spread
+
+
+def test_min_allele_frequency_filters():
+    conf = _conf(min_allele_frequency=0.2)
+    driver = VariantsPcaDriver(conf, _source(conf))
+    filtered = list(driver.iter_calls(driver.get_data()))
+    conf2 = _conf()
+    driver2 = VariantsPcaDriver(conf2, _source(conf2))
+    unfiltered = list(driver2.iter_calls(driver2.get_data()))
+    assert 0 < len(filtered) < len(unfiltered)
+
+
+def test_two_dataset_join_doubles_matrix():
+    conf = _conf(variant_set_id=["vs-a", "vs-b"])
+    driver = VariantsPcaDriver(conf, _source(conf))
+    assert len(driver.indexes) == 60  # 30 + 30 columns
+    calls = list(driver.iter_calls(driver.get_data()))
+    assert calls
+    # Joined rows may contain indices from both datasets.
+    flat = {i for row in calls for i in row}
+    assert min(flat) < 30 <= max(flat)
+    S = driver.get_similarity_matrix(calls)
+    assert S.shape == (60, 60)
+    # Cross-dataset co-occurrence exists (shared sites).
+    assert S[:30, 30:].sum() > 0
+
+
+def test_three_dataset_merge_intersects():
+    conf = _conf(variant_set_id=["vs-a", "vs-b", "vs-c"], references="17:0:10000")
+    driver = VariantsPcaDriver(conf, _source(conf))
+    calls = list(driver.iter_calls(driver.get_data()))
+    assert calls
+    assert len(driver.indexes) == 90
+    flat = {i for row in calls for i in row}
+    assert max(flat) >= 60  # third dataset contributes
+
+
+def test_merge_equals_join_on_shared_sites():
+    """For synthetic data every site exists in every dataset exactly once, so
+    2-dataset join and 3-dataset merge (restricted to two sets) agree."""
+    conf2 = _conf(variant_set_id=["vs-a", "vs-b"], references="17:0:8000")
+    d2 = VariantsPcaDriver(conf2, _source(conf2))
+    joined = sorted(tuple(sorted(r)) for r in d2.iter_calls(d2.get_data()))
+
+    # Force the merge path with the same two datasets by monkey-patching the
+    # dataset count check is not possible; instead verify merge on 3 sets
+    # restricted to the first two datasets' columns matches the join rows.
+    conf3 = _conf(variant_set_id=["vs-a", "vs-b", "vs-c"], references="17:0:8000")
+    d3 = VariantsPcaDriver(conf3, _source(conf3))
+    merged = [
+        tuple(sorted(i for i in row if i < 60))
+        for row in d3.iter_calls(d3.get_data())
+    ]
+    merged = sorted(t for t in merged if t)
+    assert merged == [t for t in joined if t]
+
+
+def test_checkpoint_round_trip(tmp_path):
+    conf = _conf()
+    driver = VariantsPcaDriver(conf, _source(conf))
+    data = driver.get_data()
+    shards = [records for _, records in data[0].iter_shards()]
+    path = str(tmp_path / "variants-ckpt")
+    n = save_variants(path, shards)
+    assert n == sum(len(s) for s in shards)
+
+    loaded = load_variants(path)
+    original = [kv for shard in shards for kv in shard]
+    assert list(loaded) == original
+
+    # Driver resume path: --input-path replaces the API read
+    # (VariantsPca.scala:112-113) and disables stats (:332-335).
+    conf2 = _conf(input_path=path)
+    driver2 = VariantsPcaDriver(conf2, _source(conf2))
+    assert driver2.io_stats is None
+    calls_resumed = list(driver2.iter_calls(driver2.get_data()))
+    calls_fresh = list(driver.iter_calls(data))
+    assert calls_resumed == calls_fresh
+
+
+def test_emit_result_formats(tmp_path, capsys):
+    conf = _conf(output_path=str(tmp_path / "out"))
+    driver = VariantsPcaDriver(conf, _source(conf))
+    result = [
+        (driver_id, [0.125, -0.5])
+        for driver_id in list(driver.indexes)[:3]
+    ]
+    lines = driver.emit_result(result)
+    # Console: name<TAB>dataset<TAB>pc1<TAB>pc2, sorted by name.
+    names = [l.split("\t")[0] for l in lines]
+    assert names == sorted(names)
+    assert all(l.split("\t")[1] == "vs" for l in lines)
+    # Saved: name, pcs..., dataset (the reference's saved column order).
+    saved = open(str(tmp_path / "out-pca.tsv" / "part-00000")).read().splitlines()
+    assert len(saved) == 3
+    assert saved[0].split("\t")[-1] == "vs"
+
+
+def test_full_run_entrypoint(tmp_path, capsys):
+    lines = pca_driver.run(
+        [
+            "--references", "17:0:20000",
+            "--variant-set-id", "vs-a",
+            "--num-samples", "12",
+            "--seed", "5",
+            "--bases-per-partition", "5000",
+            "--block-size", "32",
+            "--output-path", str(tmp_path / "run"),
+        ]
+    )
+    assert len(lines) == 12
+    captured = capsys.readouterr().out
+    assert "Matrix size: 12." in captured
+    assert "Non zero rows in matrix:" in captured
+    assert "Variants API stats:" in captured
+    assert os.path.exists(str(tmp_path / "run-pca.tsv" / "part-00000"))
+
+
+def test_packed_run_matches_wire_run(tmp_path):
+    """The packed fast path (run()) and the wire-record path produce the
+    same similarity matrix, hence the same result lines."""
+    argv = [
+        "--references", "17:0:20000",
+        "--variant-set-id", "vs-a",
+        "--num-samples", "12",
+        "--seed", "5",
+        "--bases-per-partition", "5000",
+    ]
+    fast = pca_driver.run(argv)
+    conf = PcaConf.parse(argv)
+    driver = VariantsPcaDriver(conf)
+    calls = driver.iter_calls(driver.get_data())
+    S = driver.get_similarity_matrix(calls)
+    slow = driver.emit_result(driver.compute_pca(S))
+    assert fast == slow
