@@ -40,8 +40,11 @@ def main() -> None:
     import jax
 
     # Persistent compilation cache: eigh at (2504, 2504) costs minutes to
-    # compile on first run, milliseconds after.
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    # compile on first run, milliseconds after. Lives outside the repo so
+    # cache binaries never enter git.
+    cache_dir = os.path.join(
+        os.path.expanduser("~/.cache"), "spark_examples_tpu", "jax_cache"
+    )
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 

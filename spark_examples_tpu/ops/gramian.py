@@ -47,9 +47,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from spark_examples_tpu.parallel.mesh import DATA_AXIS, SAMPLES_AXIS
 
 
-def _operand_dtypes(exact_int: bool):
+def _operand_dtypes(exact_int: bool, mesh: Optional[Mesh] = None):
     if exact_int:
         return np.int8, jnp.int32
+    # bf16 operands feed the MXU on TPU (and tensor cores on GPU); the CPU
+    # thunk runtime cannot execute bf16×bf16→f32 dots for some shapes
+    # (UNIMPLEMENTED DotThunk), and on CPU f32 is the fast path anyway.
+    # Exactness is identical: 0/1 operands, integer partial sums exact to
+    # 2^24 per entry either way. Decide from the devices that will actually
+    # run the dot, not the process default.
+    platform = (
+        mesh.devices.flat[0].platform if mesh is not None else jax.default_backend()
+    )
+    if platform == "cpu":
+        return np.float32, jnp.float32
     return ml_dtypes.bfloat16, jnp.float32
 
 
@@ -100,7 +111,7 @@ class GramianAccumulator:
         self.num_samples = int(num_samples)
         self.mesh = mesh
         self.block_size = int(block_size)
-        self.operand_dtype, self.accum_dtype = _operand_dtypes(exact_int)
+        self.operand_dtype, self.accum_dtype = _operand_dtypes(exact_int, mesh)
         self.data_parallel = mesh.shape[DATA_AXIS] if mesh is not None else 1
         # Bound the async dispatch queue: an unboundedly deep chain of
         # in-flight updates degrades sustained throughput ~30× on
@@ -245,7 +256,7 @@ class ShardedGramianAccumulator:
             self._padded = num_samples
         self.num_samples = int(num_samples)
         self.block_size = int(block_size)
-        self.operand_dtype, self.accum_dtype = _operand_dtypes(exact_int)
+        self.operand_dtype, self.accum_dtype = _operand_dtypes(exact_int, mesh)
 
         rows = self.data_parallel * self.block_size
         self._staging = np.zeros((rows, self._padded), dtype=np.uint8)
