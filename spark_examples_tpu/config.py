@@ -146,6 +146,11 @@ class PcaConf(GenomicsConf):
     pca_backend: str = "tpu"
     mesh_shape: Optional[str] = None
     block_size: int = 1024
+    ingest: str = "auto"
+    blocks_per_dispatch: int = 32
+    exact_similarity: bool = False
+    similarity_strategy: str = "auto"
+    num_workers: int = 8
 
     EXCLUDE_XY = SexChromosomeFilter.EXCLUDE_XY
 
@@ -180,6 +185,56 @@ class PcaConf(GenomicsConf):
             type=int,
             default=1024,
             help="Variants per device block in the Gramian accumulation.",
+        )
+        parser.add_argument(
+            "--ingest",
+            choices=["auto", "device", "packed", "wire"],
+            default="auto",
+            help=(
+                "Genotype ingest path: 'device' generates the synthetic data "
+                "plane on the TPU fused with the Gramian (fastest; synthetic "
+                "source only), 'packed' builds dense blocks on host, 'wire' "
+                "streams full JSON records through the dataset layer. 'auto' "
+                "picks the fastest path valid for the configuration."
+            ),
+        )
+        parser.add_argument(
+            "--blocks-per-dispatch",
+            type=int,
+            default=32,
+            help=(
+                "Device-ingest blocks fused per dispatch (lax.scan length); "
+                "higher amortizes per-dispatch overhead on remote-attached "
+                "backends."
+            ),
+        )
+        parser.add_argument(
+            "--exact-similarity",
+            action="store_true",
+            help=(
+                "Force integer (int8xint8->int32) Gramian accumulation. By "
+                "default the f32-accumulation MXU path is used unless the "
+                "projected per-entry count approaches f32's 2^24 exact-integer "
+                "limit, in which case the integer path is auto-selected."
+            ),
+        )
+        parser.add_argument(
+            "--similarity-strategy",
+            choices=["auto", "dense", "sharded"],
+            default="auto",
+            help=(
+                "Similarity accumulation strategy: 'dense' replicates the NxN "
+                "Gramian per data-parallel device (VariantsPca.scala:210-231); "
+                "'sharded' row-tile-shards it over the mesh samples axis (the "
+                "memory-bounded analog of getSimilarityMatrixStream, "
+                ":288-319). 'auto' picks by cohort size."
+            ),
+        )
+        parser.add_argument(
+            "--num-workers",
+            type=int,
+            default=8,
+            help="Host threads for parallel shard streaming.",
         )
         ns = parser.parse_args(list(argv))
         return cls._from_namespace(ns)

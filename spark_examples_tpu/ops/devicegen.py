@@ -1,0 +1,241 @@
+"""On-device synthetic genotype generation fused with Gramian accumulation.
+
+The reference's runtime is dominated by ingest: executors stream variant
+pages from the Genomics API and the similarity pass consumes them
+(``VariantsRDD.scala:198-225`` feeding ``VariantsPca.scala:222-231``). The
+synthetic source stands in for that ingest, and its data plane is a
+counter-based hash (splitmix64 finalizer, ``sources/synthetic.py``) — which
+is trivially jittable. This module moves the genotype data plane onto the
+TPU:
+
+- the host computes only per-*site* metadata (allele frequencies, ref-block
+  flags, per-population comparison thresholds) — a few hundred bytes per
+  variant, the moral equivalent of the reference's variant metadata;
+- the device generates the (block, samples) genotype matrix with the exact
+  same splitmix64 draws as the host source (bitwise-identical, tested) and
+  feeds it straight into the MXU Gramian update, fused in one XLA program;
+- many blocks are processed per dispatch via ``lax.scan``, so the
+  host→device round-trip count stays in the hundreds for a whole-genome run.
+  (On remote-attached backends, per-dispatch overhead is ~7 ms and the final
+  result fetch pays O(prior dispatches) — measured; fusing is what makes the
+  end-to-end number honest rather than a projection.)
+
+Exactness of the comparison: the host draws ``u = (h >> 11) * 2**-53`` and
+keeps an allele when ``u < p`` (``sources/synthetic.py:_u01``). Because
+``m = h >> 11`` is a 53-bit integer, ``m * 2**-53 < p  ⟺  m < ceil(p * 2**53)``
+(for real ``p``; when ``p * 2**53`` is an integer, strictness matches because
+``m`` is an integer). ``p < 1`` has a 53-bit mantissa so ``p * 2**53`` is an
+exact float64 and its ``ceil`` converts to uint64 exactly — the device never
+touches float64, it compares 64-bit integers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# splitmix64 constants — must match sources/synthetic.py exactly.
+_P1 = 0x9E3779B97F4A7C15
+_P2 = 0xC2B2AE3D27D4EB4F
+_P3 = 0x165667B19E3779F9
+_P4 = 0xD6E8FEB86659FD93
+_M1 = 0xBF58476D1CE4E5B9
+_M2 = 0x94D049BB133111EB
+_MASK64 = (1 << 64) - 1
+_S_GENOTYPE = 100  # sources/synthetic.py draw-stream tag
+
+
+def _c64(value: int) -> jax.Array:
+    """uint64 constant, wrapped mod 2^64 (Python ints over 2^63 would
+    overflow the default int path)."""
+    return jnp.asarray(np.uint64(value & _MASK64))
+
+
+def mix64(x: jax.Array) -> jax.Array:
+    """splitmix64 finalizer on uint64 arrays — bitwise-identical to
+    ``sources/synthetic.py:_mix`` (tested)."""
+    x = (x + _c64(_P1)).astype(jnp.uint64)
+    x = ((x ^ (x >> jnp.uint64(30))) * _c64(_M1)).astype(jnp.uint64)
+    x = ((x ^ (x >> jnp.uint64(27))) * _c64(_M2)).astype(jnp.uint64)
+    return (x ^ (x >> jnp.uint64(31))).astype(jnp.uint64)
+
+
+def generate_has_variation(
+    positions: jax.Array,  # (B,) int64
+    thresholds: jax.Array,  # (B, P) uint64: ceil(af_pop * 2^53), 0 = dropped
+    vs_keys: jax.Array,  # (S,) uint64: per-variant-set genotype stream keys
+    pops: jax.Array,  # (N,) int32: sample → population
+) -> jax.Array:
+    """(B, S*N) {0,1} has-variation rows, bitwise-equal to the host packed
+    path (``sources/synthetic.py:genotype_blocks``) for kept sites; rows whose
+    thresholds are zeroed come out all-zero (contribute nothing to XᵀX).
+
+    Multi-dataset: synthetic variant sets share the site grid (site identity
+    is keyed by position only — ``sources/synthetic.py:_site_fields``), so the
+    reference's 2-set join and ≥3-set merge-intersect (``VariantsPca.scala:
+    155-188``) both reduce to column concatenation of per-set genotype
+    matrices; ``vs_keys`` carries one genotype stream per set.
+    """
+    n = pops.shape[0]
+    samples = (jnp.arange(n, dtype=jnp.uint64) * _c64(_P4))[None, :]
+    pos_term = positions.astype(jnp.uint64) * _c64(_P2)
+    t_full = jnp.take(thresholds, pops, axis=1)  # (B, N)
+    parts = []
+    for s in range(vs_keys.shape[0]):
+        h1 = mix64(vs_keys[s] ^ pos_term)  # (B,)
+        h2 = mix64(h1 ^ _c64(_S_GENOTYPE * _P3))
+        h3 = mix64(h2[:, None] ^ samples)  # (B, N)
+        m1 = mix64(h3 ^ _c64(1 * _P1)) >> jnp.uint64(11)
+        m2 = mix64(h3 ^ _c64(2 * _P1)) >> jnp.uint64(11)
+        parts.append((m1 < t_full) | (m2 < t_full))
+    return jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+
+
+class DeviceGenGramianAccumulator:
+    """Fused generate→accumulate pipeline for the synthetic data plane.
+
+    Carries the Gramian and a variant-row counter through chained scanned
+    dispatches; nothing is fetched from the device until
+    :meth:`finalize_device`'s result is consumed downstream. ``exact_int``
+    accumulates int8×int8→int32 on the MXU (always exact; whole-genome
+    diagonal counts ~12M would sit uncomfortably close to f32's 2^24 integer
+    limit — SURVEY §7 hard-part 3).
+    """
+
+    def __init__(
+        self,
+        num_samples: int,
+        vs_keys: Sequence[int],
+        pops: np.ndarray,
+        block_size: int = 2048,
+        blocks_per_dispatch: int = 32,
+        exact_int: bool = True,
+    ):
+        self.num_samples = int(num_samples)
+        self.n_sets = len(vs_keys)
+        self.total_columns = self.num_samples * self.n_sets
+        self.block_size = int(block_size)
+        self.blocks_per_dispatch = int(blocks_per_dispatch)
+        from spark_examples_tpu.ops.gramian import _operand_dtypes
+
+        # Shared dtype policy: int8→int32 when exact, bf16 on TPU / f32 on
+        # CPU otherwise (the CPU thunk runtime lacks some bf16 dot shapes).
+        operand_dtype, accum_dtype = _operand_dtypes(exact_int)
+        self.accum_dtype = accum_dtype
+        self.dispatches = 0
+
+        with jax.enable_x64(True):
+            self._vs_keys = jnp.asarray(
+                np.array([k & _MASK64 for k in vs_keys], dtype=np.uint64)
+            )
+            self._pops = jnp.asarray(np.asarray(pops, dtype=np.int32))
+            self.G = jnp.zeros(
+                (self.total_columns, self.total_columns), accum_dtype
+            )
+            # Per-set counts of rows with variation in that set's columns —
+            # matches the wire path's per-dataset record accounting.
+            self.variant_rows = jnp.zeros((self.n_sets,), jnp.int64)
+
+            vs_keys_arr, pops_arr = self._vs_keys, self._pops
+
+            @jax.jit
+            def update(G, count, positions, thresholds):
+                def body(carry, xs):
+                    G, count = carry
+                    pos, thr = xs
+                    hv = generate_has_variation(
+                        pos, thr, vs_keys_arr, pops_arr
+                    )
+                    per_set = hv.reshape(hv.shape[0], count.shape[0], -1)
+                    count += jnp.sum(jnp.any(per_set, axis=2), axis=0).astype(
+                        count.dtype
+                    )
+                    X = hv.astype(operand_dtype)
+                    G = G + jnp.einsum(
+                        "bn,bm->nm", X, X, preferred_element_type=accum_dtype
+                    )
+                    return (G, count), None
+
+                (G, count), _ = lax.scan(body, (G, count), (positions, thresholds))
+                return G, count
+
+            self._update = update
+
+    def add_plan(self, positions: np.ndarray, thresholds: np.ndarray) -> None:
+        """Dispatch one scanned group: ``positions`` (K, B) int64,
+        ``thresholds`` (K, B, P) uint64 (zero rows = dropped/padding)."""
+        if positions.shape != (self.blocks_per_dispatch, self.block_size):
+            raise ValueError(
+                f"expected ({self.blocks_per_dispatch}, {self.block_size}) "
+                f"positions, got {positions.shape}"
+            )
+        with jax.enable_x64(True):
+            self.G, self.variant_rows = self._update(
+                self.G,
+                self.variant_rows,
+                jnp.asarray(positions),
+                jnp.asarray(thresholds),
+            )
+        self.dispatches += 1
+
+    def finalize_device(self) -> jax.Array:
+        """The accumulated Gramian, still on device (single data slice, so no
+        cross-device reduce is needed here; multi-slice accumulation reduces
+        via the mesh paths in ``ops/gramian.py``)."""
+        return self.G
+
+    def finalize(self) -> np.ndarray:
+        with jax.enable_x64(True):
+            return np.asarray(jax.device_get(self.G)).astype(np.float64)
+
+
+def plan_blocks(
+    plan_iter: Iterator[Tuple[np.ndarray, np.ndarray]],
+    block_size: int,
+    blocks_per_dispatch: int,
+    n_pops: int,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Re-chunk a stream of (positions, thresholds) site batches into fixed
+    (K, B) dispatch groups, zero-padding the final group (zero thresholds
+    generate all-zero rows, which contribute nothing to XᵀX)."""
+    cap = block_size * blocks_per_dispatch
+    pos_buf = np.zeros(cap, dtype=np.int64)
+    thr_buf = np.zeros((cap, n_pops), dtype=np.uint64)
+    fill = 0
+    for positions, thresholds in plan_iter:
+        offset = 0
+        while offset < len(positions):
+            take = min(cap - fill, len(positions) - offset)
+            pos_buf[fill : fill + take] = positions[offset : offset + take]
+            thr_buf[fill : fill + take] = thresholds[offset : offset + take]
+            fill += take
+            offset += take
+            if fill == cap:
+                yield (
+                    pos_buf.reshape(blocks_per_dispatch, block_size).copy(),
+                    thr_buf.reshape(
+                        blocks_per_dispatch, block_size, n_pops
+                    ).copy(),
+                )
+                fill = 0
+    if fill:
+        pos_buf[fill:] = 0
+        thr_buf[fill:] = 0
+        yield (
+            pos_buf.reshape(blocks_per_dispatch, block_size).copy(),
+            thr_buf.reshape(blocks_per_dispatch, block_size, n_pops).copy(),
+        )
+
+
+__all__ = [
+    "DeviceGenGramianAccumulator",
+    "generate_has_variation",
+    "mix64",
+    "plan_blocks",
+]

@@ -64,6 +64,43 @@ def _operand_dtypes(exact_int: bool, mesh: Optional[Mesh] = None):
     return ml_dtypes.bfloat16, jnp.float32
 
 
+# f32 accumulation is exact for integers up to 2^24; past a projected
+# per-entry count of this limit the accumulators losslessly convert to the
+# int8->int32 MXU path (all entries are still exact integers at the moment of
+# conversion). SURVEY §7 hard-part 3: whole-genome diagonal counts (~12M)
+# approach this, and merged-cohort configs exceed it.
+EXACT_F32_LIMIT = 1 << 24
+
+
+def _maybe_switch_accumulator(acc, next_bound: int, out_shardings=None) -> bool:
+    """Losslessly convert an f32 accumulator to int32 before any entry could
+    cross the 2^24 exact-integer limit (entries are bounded by
+    Σ rows × max-count², all still exact integers at conversion time).
+    Returns True when a switch happened (callers may need to rebuild a
+    dtype-closed update function)."""
+    if acc.exact_int or acc.accum_dtype == jnp.int32:
+        return False
+    if next_bound <= EXACT_F32_LIMIT:
+        return False
+    acc.G = jax.jit(
+        lambda g: g.astype(jnp.int32), out_shardings=out_shardings
+    )(acc.G)
+    acc.operand_dtype, acc.accum_dtype = np.int8, jnp.int32
+    return True
+
+
+@functools.partial(jax.jit, static_argnames=("operand_dtype",))
+def _dense_update_counts(G, X, operand_dtype):
+    """G[d] += X[d]ᵀ X[d] for unpacked count-valued uint8 rows (the rare
+    same-set-join case where a callset column appears more than once per
+    variant — the reference's pair loop adds k² for k duplicates, which is
+    exactly the outer product of count vectors)."""
+    Xc = X.astype(operand_dtype)
+    return G + jnp.einsum(
+        "dbn,dbm->dnm", Xc, Xc, preferred_element_type=G.dtype
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("operand_dtype", "num_samples"))
 def _dense_update(G, X_packed, operand_dtype, num_samples):
     """G[d] += X[d]ᵀ X[d] — local per data-slice, no communication.
@@ -111,7 +148,9 @@ class GramianAccumulator:
         self.num_samples = int(num_samples)
         self.mesh = mesh
         self.block_size = int(block_size)
+        self.exact_int = bool(exact_int)
         self.operand_dtype, self.accum_dtype = _operand_dtypes(exact_int, mesh)
+        self._entry_bound = 0  # conservative max over per-entry counts
         self.data_parallel = mesh.shape[DATA_AXIS] if mesh is not None else 1
         # Bound the async dispatch queue: an unboundedly deep chain of
         # in-flight updates degrades sustained throughput ~30× on
@@ -162,16 +201,35 @@ class GramianAccumulator:
             # Zero rows contribute nothing to XᵀX — pad instead of masking.
             block = block.copy()
             block[self._fill :] = 0
-        X = np.packbits(
-            block.reshape(self.data_parallel, self.block_size, self.num_samples),
-            axis=-1,
+        max_count = int(block.max(initial=0))
+        _maybe_switch_accumulator(
+            self,
+            self._entry_bound + self._fill * max_count * max_count,
+            out_shardings=self._g_sharding,
         )
-        Xd = (
-            jax.device_put(X, self._x_sharding)
-            if self._x_sharding is not None
-            else jnp.asarray(X)
+        self._entry_bound += self._fill * max_count * max_count
+        shaped = block.reshape(
+            self.data_parallel, self.block_size, self.num_samples
         )
-        self.G = _dense_update(self.G, Xd, self.operand_dtype, self.num_samples)
+        if max_count > 1:
+            # Count-valued rows (same-set joins) can't be bit-packed; ship
+            # them unpacked through the counts kernel.
+            Xd = (
+                jax.device_put(shaped, self._x_sharding)
+                if self._x_sharding is not None
+                else jnp.asarray(shaped)
+            )
+            self.G = _dense_update_counts(self.G, Xd, self.operand_dtype)
+        else:
+            X = np.packbits(shaped, axis=-1)
+            Xd = (
+                jax.device_put(X, self._x_sharding)
+                if self._x_sharding is not None
+                else jnp.asarray(X)
+            )
+            self.G = _dense_update(
+                self.G, Xd, self.operand_dtype, self.num_samples
+            )
         self._fill = 0
         self._flushes += 1
         if self._flushes % self.sync_every == 0:
@@ -256,7 +314,9 @@ class ShardedGramianAccumulator:
             self._padded = num_samples
         self.num_samples = int(num_samples)
         self.block_size = int(block_size)
+        self.exact_int = bool(exact_int)
         self.operand_dtype, self.accum_dtype = _operand_dtypes(exact_int, mesh)
+        self._entry_bound = 0
 
         rows = self.data_parallel * self.block_size
         self._staging = np.zeros((rows, self._padded), dtype=np.uint8)
@@ -275,7 +335,11 @@ class ShardedGramianAccumulator:
             self._g_sharding,
         )
 
-        operand_dtype = self.operand_dtype
+        self._g_spec, self._x_spec = g_spec, x_spec
+        self._update = self._build_update(self.operand_dtype)
+
+    def _build_update(self, operand_dtype):
+        mesh, g_spec, x_spec = self.mesh, self._g_spec, self._x_spec
 
         @jax.jit
         def update(G, X):
@@ -292,7 +356,7 @@ class ShardedGramianAccumulator:
                 out_specs=g_spec,
             )(G, X)
 
-        self._update = update
+        return update
 
     def add_rows(self, rows: np.ndarray) -> None:
         rows = np.asarray(rows, dtype=np.uint8)
@@ -320,6 +384,14 @@ class ShardedGramianAccumulator:
         if self._fill < block.shape[0]:
             block = block.copy()
             block[self._fill :] = 0
+        max_count = int(block.max(initial=0))
+        next_bound = self._entry_bound + self._fill * max_count * max_count
+        if _maybe_switch_accumulator(
+            self, next_bound, out_shardings=self._g_sharding
+        ):
+            # The scanned update closes over the operand dtype — rebuild it.
+            self._update = self._build_update(self.operand_dtype)
+        self._entry_bound = next_bound
         X = block.reshape(self.data_parallel, self.block_size, self._padded)
         self.G = self._update(self.G, jax.device_put(X, self._x_sharding))
         self._fill = 0

@@ -50,9 +50,19 @@ def _parallel_shards(
             yield part, compute(part)
         return
     with concurrent.futures.ThreadPoolExecutor(max_workers=num_workers) as pool:
-        futures = {i: pool.submit(compute, p) for i, p in enumerate(partitions)}
+        # Bounded in-flight window: submit at most num_workers + margin ahead
+        # of the consumer so unconsumed shard results stay O(workers), not
+        # O(partitions) — a whole-genome run would otherwise materialize
+        # arbitrarily many shards ahead of a slow consumer and exhaust host
+        # memory.
+        window = num_workers + 2
+        futures = {}
+        next_submit = 0
         for i, part in enumerate(partitions):
-            yield part, futures[i].result()
+            while next_submit < min(len(partitions), i + window):
+                futures[next_submit] = pool.submit(compute, partitions[next_submit])
+                next_submit += 1
+            yield part, futures.pop(i).result()
 
 
 class VariantsDataset:

@@ -115,6 +115,7 @@ class VariantsPcaDriver:
                 variant_set_id,
                 partitioner,
                 stats=self.io_stats,
+                num_workers=getattr(self.conf, "num_workers", 8),
             )
             for variant_set_id in self.conf.variant_set_id
         ]
@@ -219,22 +220,47 @@ class VariantsPcaDriver:
             return None
         return default_mesh(num_reduce_partitions=self.conf.num_reduce_partitions)
 
+    def _resolve_sharded(self, sharded: Optional[bool], mesh) -> bool:
+        """``--similarity-strategy``: explicit dense/sharded, or auto by
+        cohort size (the reference's ~50K-samples/~20GB in-memory guidance,
+        ``VariantsPca.scala:216-217,296-297``, scaled to per-chip HBM)."""
+        strategy = getattr(self.conf, "similarity_strategy", "auto")
+        if sharded is None:
+            if strategy == "sharded":
+                sharded = True
+            elif strategy == "dense":
+                sharded = False
+            else:
+                sharded = len(self.indexes) >= 16384
+        if sharded and (mesh is None or SAMPLES_AXIS not in mesh.shape or mesh.shape[SAMPLES_AXIS] < 2):
+            if strategy == "sharded":
+                raise ValueError(
+                    "--similarity-strategy sharded needs a mesh with a "
+                    "samples axis of at least 2 (use --mesh-shape data,samples)"
+                )
+            sharded = False
+        return sharded
+
     def get_similarity_matrix(
-        self, calls: Iterable[List[int]], sharded: bool = False
+        self, calls: Iterable[List[int]], sharded: Optional[bool] = None
     ) -> np.ndarray:
         """Similarity counts G = XᵀX (``VariantsPca.scala:210-231`` dense
         strategy; ``sharded=True`` is the memory-bounded analog of
-        ``getSimilarityMatrixStream``, ``:288-319``)."""
+        ``getSimilarityMatrixStream``, ``:288-319``; ``None`` resolves
+        ``--similarity-strategy``)."""
         n = len(self.indexes)
         if self.conf.pca_backend == "host":
             return self._host_similarity(calls)
         mesh = self._make_mesh()
-        if sharded and mesh is not None and SAMPLES_AXIS in mesh.shape:
+        exact = getattr(self.conf, "exact_similarity", False)
+        if self._resolve_sharded(sharded, mesh):
             acc: object = ShardedGramianAccumulator(
-                n, mesh, block_size=self.conf.block_size
+                n, mesh, block_size=self.conf.block_size, exact_int=exact
             )
         else:
-            acc = GramianAccumulator(n, mesh, block_size=self.conf.block_size)
+            acc = GramianAccumulator(
+                n, mesh, block_size=self.conf.block_size, exact_int=exact
+            )
         staging: List[List[int]] = []
 
         def flush():
@@ -242,7 +268,12 @@ class VariantsPcaDriver:
                 return
             rows = np.zeros((len(staging), n), dtype=np.uint8)
             for i, row in enumerate(staging):
-                rows[i, row] = 1
+                # np.add.at accumulates duplicate indices: a callset column
+                # appearing k times in a row contributes k² per entry, the
+                # reference's pair-loop multiplicity (``VariantsPca.scala:
+                # 224-229``) — matters when a variant set is joined with
+                # itself.
+                np.add.at(rows[i], np.asarray(row, dtype=np.int64), 1)
             acc.add_rows(rows)
             staging.clear()
 
@@ -259,22 +290,99 @@ class VariantsPcaDriver:
         return acc.finalize()
 
     def get_similarity_rows(
-        self, blocks: Iterable[np.ndarray], sharded: bool = False
+        self, blocks: Iterable[np.ndarray], sharded: Optional[bool] = None
     ) -> np.ndarray:
         """Packed fast path: feed dense uint8 row blocks directly."""
         n = len(self.indexes)
         mesh = self._make_mesh()
-        if sharded and mesh is not None and SAMPLES_AXIS in mesh.shape:
+        exact = getattr(self.conf, "exact_similarity", False)
+        if self._resolve_sharded(sharded, mesh):
             acc: object = ShardedGramianAccumulator(
-                n, mesh, block_size=self.conf.block_size
+                n, mesh, block_size=self.conf.block_size, exact_int=exact
             )
         else:
-            acc = GramianAccumulator(n, mesh, block_size=self.conf.block_size)
+            acc = GramianAccumulator(
+                n, mesh, block_size=self.conf.block_size, exact_int=exact
+            )
         for block in blocks:
             acc.add_rows(block)
         if isinstance(acc, GramianAccumulator):
             return acc.finalize_device()
         return acc.finalize()
+
+    def get_similarity_device_gen(self, contigs) -> "object":
+        """Fully fused TPU ingest+similarity for the synthetic source: the
+        host streams per-site thresholds, the device generates genotypes and
+        accumulates ``G += XᵀX`` in one scanned XLA program per dispatch group
+        (``ops/devicegen.py``).
+
+        Multi-dataset configurations need no join machinery here: synthetic
+        variant sets share the site grid, so the reference's 2-set join and
+        ≥3-set merge-intersect (``VariantsPca.scala:155-188``) reduce to
+        column concatenation of per-set genotype matrices — verified against
+        the wire path in tests.
+        """
+        from spark_examples_tpu.ops.devicegen import (
+            DeviceGenGramianAccumulator,
+            plan_blocks,
+        )
+
+        source: SyntheticGenomicsSource = self.source  # type: ignore[assignment]
+        conf = self.conf
+        acc = DeviceGenGramianAccumulator(
+            num_samples=source.num_samples,
+            vs_keys=[
+                source.genotype_stream_key(v) for v in conf.variant_set_id
+            ],
+            pops=source.populations,
+            block_size=conf.block_size,
+            blocks_per_dispatch=conf.blocks_per_dispatch,
+            exact_int=True,
+        )
+
+        def plans():
+            page_size = 1024  # synthetic wire path's variants page size
+            for contig in contigs:
+                scanned_before = getattr(source, "plan_sites_scanned", 0)
+                for batch in source.site_threshold_plan(
+                    contig, min_allele_frequency=conf.min_allele_frequency
+                ):
+                    yield batch
+                if self.io_stats is not None:
+                    # Page accounting mirrors the wire path: one request per
+                    # page of scanned sites, at least one per partition, each
+                    # partition traversed once per variant set.
+                    scanned = source.plan_sites_scanned - scanned_before
+                    for shard in contig.get_shards(conf.bases_per_partition):
+                        for _ in conf.variant_set_id:
+                            self.io_stats.add_partition(shard.range)
+                    n_shards = max(
+                        1, len(contig.get_shards(conf.bases_per_partition))
+                    )
+                    self.io_stats.requests += max(
+                        n_shards, -(-scanned // page_size)
+                    ) * len(conf.variant_set_id)
+
+        for pos, thr in plan_blocks(
+            plans(), conf.block_size, conf.blocks_per_dispatch, source.n_pops
+        ):
+            acc.add_plan(pos, thr)
+        self._device_gen_acc = acc
+        return acc.finalize_device()
+
+    def flush_device_ingest_stats(self) -> None:
+        """Record the device-counted variant rows: per variant set, rows with
+        variation in that set's columns — the same count the packed host path
+        reports after its nonzero drop. Called after the pipeline's final
+        fetch so the device_get here is free."""
+        import jax
+
+        acc = getattr(self, "_device_gen_acc", None)
+        if acc is None or self.io_stats is None:
+            return
+        with jax.enable_x64(True):
+            per_set = np.asarray(jax.device_get(acc.variant_rows))
+        self.io_stats.add_variants(int(per_set.sum()))
 
     def _host_similarity(self, calls: Iterable[List[int]]) -> np.ndarray:
         """Literal host replication of ``getSimilarityMatrix``
@@ -283,7 +391,10 @@ class VariantsPcaDriver:
         matrix = np.zeros((n, n), dtype=np.int64)
         for row in calls:
             idx = np.asarray(row, dtype=np.int64)
-            matrix[np.ix_(idx, idx)] += 1
+            # Unbuffered accumulation: duplicate callset indices contribute
+            # per occurrence pair, as the reference's loop does
+            # (``VariantsPca.scala:224-229``).
+            np.add.at(matrix, np.ix_(idx, idx), 1)
         return matrix.astype(np.float64)
 
     # ----------------------------------------------------------------- pca
@@ -375,14 +486,50 @@ class VariantsPcaDriver:
 def run(argv: Sequence[str]) -> List[str]:
     """``VariantsPcaDriver.main`` (``VariantsPca.scala:47-59``)."""
     conf = PcaConf.parse(argv)
-    driver = VariantsPcaDriver(conf)
-    use_packed = (
+    synthetic_tpu = (
         conf.source == "synthetic"
         and not conf.input_path
-        and len(conf.variant_set_id) == 1
         and conf.pca_backend == "tpu"
     )
-    if use_packed:
+    # Device generation needs distinct variant sets (duplicate ids collapse
+    # the column index, a same-set join the wire path handles via count
+    # multiplicity) and the dense accumulator (it owns its fused update).
+    unique_sets = len(set(conf.variant_set_id)) == len(conf.variant_set_id)
+    dense_ok = conf.similarity_strategy != "sharded" and (
+        conf.similarity_strategy == "dense"
+        or len(conf.variant_set_id) * conf.num_samples < 16384
+    )
+    use_device = conf.ingest == "device" or (
+        conf.ingest == "auto" and synthetic_tpu and unique_sets and dense_ok
+    )
+    # Packed ingest supports both accumulator strategies, so it remains the
+    # auto choice for single-set sharded/large-cohort runs where device
+    # ingest (dense-only) doesn't apply.
+    use_packed = conf.ingest == "packed" or (
+        conf.ingest == "auto"
+        and not use_device
+        and synthetic_tpu
+        and len(conf.variant_set_id) == 1
+    )
+    if use_device and not (synthetic_tpu and unique_sets and dense_ok):
+        raise ValueError(
+            "--ingest device requires --source synthetic, --pca-backend tpu, "
+            "distinct variant-set ids, and the dense similarity strategy"
+        )
+    if use_packed and not synthetic_tpu:
+        raise ValueError(
+            "--ingest packed requires --source synthetic and --pca-backend tpu"
+        )
+    if use_packed and len(conf.variant_set_id) != 1:
+        raise ValueError(
+            "--ingest packed supports a single variant set; use --ingest "
+            "device (distinct sets) or --ingest wire"
+        )
+    driver = VariantsPcaDriver(conf)
+    if use_device:
+        contigs = conf.get_contigs(driver.source, conf.variant_set_id)
+        similarity = driver.get_similarity_device_gen(contigs)
+    elif use_packed:
         # Packed fast path: synthetic blocks straight onto the device.
         source: SyntheticGenomicsSource = driver.source  # type: ignore[assignment]
         contigs = conf.get_contigs(source, conf.variant_set_id)
@@ -406,7 +553,9 @@ def run(argv: Sequence[str]) -> List[str]:
             return blocks
 
         def block_stream():
-            for _, blocks in _parallel_shards(partitions, shard_blocks, 8):
+            for _, blocks in _parallel_shards(
+                partitions, shard_blocks, conf.num_workers
+            ):
                 for block in blocks:
                     yield block["has_variation"]
 
@@ -417,6 +566,7 @@ def run(argv: Sequence[str]) -> List[str]:
         similarity = driver.get_similarity_matrix(calls)
     result = driver.compute_pca(similarity)
     lines = driver.emit_result(result)
+    driver.flush_device_ingest_stats()
     driver.report_io_stats()
     driver.stop()
     return lines

@@ -60,6 +60,19 @@ _S_GERMLINE_BASE = 204
 _BASES = "ACGT"
 
 
+def _af6(af: np.ndarray) -> np.ndarray:
+    """Canonical 6-decimal AF, shared by every path.
+
+    The wire format serializes AF as ``f"{af6:.6f}"`` and the reference's
+    filter parses it back (``VariantsPca.scala:136-148``); rounding BEFORE
+    serializing makes ``float(f"{_af6(af):.6f}") == _af6(af)`` an exact
+    round-trip, so the packed/device paths (which compare ``_af6(af)``
+    directly) and the wire path (which compares the parsed string) apply
+    ``--min-allele-frequency`` identically on threshold-adjacent sites.
+    """
+    return np.round(np.asarray(af) * 1e6) / 1e6
+
+
 def _mix(x: np.ndarray) -> np.ndarray:
     """splitmix64 finalizer, vectorized over uint64 arrays (wrapping mod 2^64)."""
     with np.errstate(over="ignore"):
@@ -157,8 +170,16 @@ class SyntheticGenomicsSource(GenomicsSource):
         return f"S{tag:02d}N{i:05d}"
 
     def search_callsets(self, variant_set_ids: Sequence[str]) -> List[Dict]:
+        """Callsets across the requested variant sets. Duplicate variant-set
+        ids contribute their callsets once, as the real SearchCallSets API
+        (a search over a *set* of variant sets) would
+        (``VariantsPca.scala:97-105``)."""
         out = []
+        seen = set()
         for vsid in variant_set_ids:
+            if vsid in seen:
+                continue
+            seen.add(vsid)
             for i in range(self.num_samples):
                 out.append(
                     {"id": self.callset_id(vsid, i), "name": self.callset_name(vsid, i)}
@@ -217,6 +238,47 @@ class SyntheticGenomicsSource(GenomicsSource):
         alt_idx = (ref_idx + 1 + alt_off) % 4
         return is_ref_block, af, af_pop, ref_idx, alt_idx
 
+    def genotype_stream_key(self, variant_set_id: str) -> int:
+        """The per-variant-set uint64 key of the genotype draw stream — the
+        device generation path (``ops/devicegen.py``) reproduces
+        :meth:`_genotype_alleles` bitwise from this key."""
+        return int(self._vs_key(variant_set_id))
+
+    @property
+    def populations(self) -> np.ndarray:
+        """Sample → population index (``(N,)`` int64)."""
+        return self._pops
+
+    def site_threshold_plan(
+        self,
+        contig: Contig,
+        min_allele_frequency: Optional[float] = None,
+        chunk_sites: int = 1 << 20,
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Host half of the device-generation path: per-site integer
+        comparison thresholds for kept sites.
+
+        Yields dense ``(positions (B,), thresholds (B, n_pops) uint64)``
+        batches where ``thresholds[:, p] = ceil(af_pop[:, p] * 2**53)`` —
+        the exact integer form of the host's ``u < af_pop`` float comparison
+        (see ``ops/devicegen.py``). Ref-block sites and AF-filtered sites are
+        compacted out, mirroring :meth:`genotype_blocks`' drop semantics.
+        """
+        all_positions = self._site_positions(contig.start, contig.end)
+        self.plan_sites_scanned = getattr(self, "plan_sites_scanned", 0)
+        for off in range(0, len(all_positions), chunk_sites):
+            positions = all_positions[off : off + chunk_sites]
+            is_ref_block, af, af_pop, _, _ = self._site_fields("", positions)
+            keep = ~is_ref_block
+            if min_allele_frequency is not None:
+                keep &= _af6(af) > float(min_allele_frequency)
+            self.plan_sites_scanned += len(positions)
+            positions = positions[keep]
+            if len(positions) == 0:
+                continue
+            thresholds = np.ceil(af_pop[keep] * (2.0**53)).astype(np.uint64)
+            yield positions, thresholds
+
     def _genotype_alleles(
         self, variant_set_id: str, positions: np.ndarray
     ) -> np.ndarray:
@@ -252,7 +314,7 @@ class SyntheticGenomicsSource(GenomicsSource):
             is_ref_block, af, _, _, _ = self._site_fields(variant_set_id, positions)
             keep = ~is_ref_block
             if min_allele_frequency is not None:
-                keep &= af.astype(np.float32) > np.float32(min_allele_frequency)
+                keep &= _af6(af) > float(min_allele_frequency)
             positions = positions[keep]
             af = af[keep]
             if len(positions) == 0:
@@ -288,7 +350,7 @@ class SyntheticGenomicsSource(GenomicsSource):
             record["end"] = int(pos) + 1
             record["referenceBases"] = _BASES[int(ref_idx[0])]
             record["alternateBases"] = [_BASES[int(alt_idx[0])]]
-            record["info"] = {"AF": [f"{float(af[0]):.6f}"]}
+            record["info"] = {"AF": [f"{float(_af6(af)[0]):.6f}"]}
             genotypes = self._genotype_alleles(variant_set_id, positions)
         record["calls"] = [
             {

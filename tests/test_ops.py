@@ -183,3 +183,42 @@ def test_subspace_pca_matches_eigh():
     np.testing.assert_allclose(
         np.asarray(approx_vals), np.asarray(exact_vals), rtol=1e-3
     )
+
+
+def test_f32_accumulator_auto_switches_to_exact_int(monkeypatch):
+    """Past the (patched) 2^24 projected-count limit the f32 accumulator
+    converts losslessly to int32 and keeps exact counts."""
+    import jax.numpy as jnp
+    from spark_examples_tpu.ops import gramian as gr
+
+    monkeypatch.setattr(gr, "EXACT_F32_LIMIT", 300)
+    acc = GramianAccumulator(num_samples=6, block_size=64, exact_int=False)
+    rows = np.ones((500, 6), dtype=np.uint8)
+    acc.add_rows(rows)
+    assert acc.accum_dtype == jnp.int32  # switched mid-stream
+    np.testing.assert_array_equal(acc.finalize(), np.full((6, 6), 500))
+
+
+def test_sharded_accumulator_auto_switches_to_exact_int(monkeypatch):
+    import jax.numpy as jnp
+    from spark_examples_tpu.ops import gramian as gr
+
+    monkeypatch.setattr(gr, "EXACT_F32_LIMIT", 200)
+    mesh = make_mesh({DATA_AXIS: 2, SAMPLES_AXIS: 2})
+    acc = ShardedGramianAccumulator(
+        num_samples=8, mesh=mesh, block_size=32, exact_int=False
+    )
+    rows = np.ones((400, 8), dtype=np.uint8)
+    acc.add_rows(rows)
+    assert acc.accum_dtype == jnp.int32
+    np.testing.assert_array_equal(acc.finalize(), np.full((8, 8), 400))
+
+
+def test_count_valued_rows_accumulate_multiplicity():
+    """k duplicate occurrences contribute k² (the reference's pair loop over
+    a call list with repeats, VariantsPca.scala:224-229)."""
+    rows = np.array([[2, 1, 0], [0, 3, 1]], dtype=np.uint8)
+    acc = GramianAccumulator(num_samples=3, block_size=4)
+    acc.add_rows(rows)
+    expected = rows.astype(np.int64).T @ rows.astype(np.int64)
+    np.testing.assert_array_equal(acc.finalize(), expected)

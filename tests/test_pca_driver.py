@@ -229,3 +229,87 @@ def test_packed_run_matches_wire_run(tmp_path):
     S = driver.get_similarity_matrix(calls)
     slow = driver.emit_result(driver.compute_pca(S))
     assert fast == slow
+
+
+def test_device_ingest_similarity_matches_wire_similarity():
+    """The fused device generation path produces the identical Gramian to the
+    wire-record path, single dataset."""
+    import jax
+
+    conf = _conf(ingest="device")
+    driver = VariantsPcaDriver(conf, _source(conf))
+    contigs = conf.get_contigs(driver.source, conf.variant_set_id)
+    S_dev = np.asarray(jax.device_get(driver.get_similarity_device_gen(contigs)))
+
+    conf2 = _conf()
+    driver2 = VariantsPcaDriver(conf2, _source(conf2))
+    calls = list(driver2.iter_calls(driver2.get_data()))
+    S_wire = np.asarray(jax.device_get(driver2.get_similarity_matrix(calls)))
+    np.testing.assert_array_equal(S_dev, S_wire)
+
+
+@pytest.mark.parametrize("n_sets", [2, 3])
+def test_device_ingest_matches_wire_multiset(n_sets):
+    """2-set join and 3-set merge-intersect collapse to column concatenation
+    on the device path — must equal the wire join/merge Gramian exactly."""
+    import jax
+
+    sets = ["vs-a", "vs-b", "vs-c"][:n_sets]
+    conf = _conf(variant_set_id=sets, references="17:0:12000", ingest="device")
+    driver = VariantsPcaDriver(conf, _source(conf))
+    contigs = conf.get_contigs(driver.source, conf.variant_set_id)
+    S_dev = np.asarray(jax.device_get(driver.get_similarity_device_gen(contigs)))
+
+    conf2 = _conf(variant_set_id=sets, references="17:0:12000")
+    driver2 = VariantsPcaDriver(conf2, _source(conf2))
+    calls = list(driver2.iter_calls(driver2.get_data()))
+    S_wire = np.asarray(jax.device_get(driver2.get_similarity_matrix(calls)))
+    np.testing.assert_array_equal(S_dev, S_wire)
+
+
+def test_device_run_entrypoint_matches_wire(tmp_path, capsys):
+    argv = [
+        "--references", "17:0:20000",
+        "--variant-set-id", "vs-a",
+        "--num-samples", "12",
+        "--seed", "5",
+        "--bases-per-partition", "5000",
+    ]
+    device_lines = pca_driver.run(argv + ["--ingest", "device"])
+    wire_lines = pca_driver.run(argv + ["--ingest", "wire"])
+    assert device_lines == wire_lines
+    captured = capsys.readouterr().out
+    assert "Variants API stats:" in captured
+
+
+def test_same_set_join_accumulates_multiplicity():
+    """Joining a variant set with itself: duplicate callset columns must
+    contribute k² per entry (reference pair-loop semantics), on both the host
+    oracle and the TPU path."""
+    conf = _conf(variant_set_id=["vs-a", "vs-a"], references="17:0:8000")
+    driver = VariantsPcaDriver(conf, _source(conf))
+    assert len(driver.indexes) == 30  # duplicate ids collapse columns
+    calls = list(driver.iter_calls(driver.get_data()))
+    assert any(len(row) != len(set(row)) for row in calls)
+    S_tpu = np.asarray(driver.get_similarity_matrix(iter(calls)))
+
+    conf_host = _conf(variant_set_id=["vs-a", "vs-a"], references="17:0:8000",
+                      pca_backend="host")
+    driver_host = VariantsPcaDriver(conf_host, _source(conf_host))
+    S_host = driver_host.get_similarity_matrix(iter(calls))
+    np.testing.assert_array_equal(S_tpu, S_host)
+    # k duplicates ⇒ diagonal gets k² > k somewhere.
+    row = next(r for r in calls if len(r) != len(set(r)))
+    assert S_host.max() >= 4 or len(calls) < 5
+
+
+def test_ingest_flag_guards():
+    with pytest.raises(ValueError, match="ingest device"):
+        pca_driver.run(["--ingest", "device", "--source", "rest",
+                        "--references", "17:0:1000"])
+    with pytest.raises(ValueError, match="ingest packed"):
+        pca_driver.run(["--ingest", "packed", "--pca-backend", "host",
+                        "--references", "17:0:1000"])
+    with pytest.raises(ValueError, match="single variant set"):
+        pca_driver.run(["--ingest", "packed", "--variant-set-id", "a,b",
+                        "--references", "17:0:1000", "--num-samples", "8"])
