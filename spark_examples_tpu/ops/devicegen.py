@@ -1,38 +1,42 @@
-"""On-device synthetic genotype generation fused with Gramian accumulation.
+"""On-device synthetic ingest: site metadata + genotype generation fused
+with Gramian accumulation.
 
 The reference's runtime is dominated by ingest: executors stream variant
 pages from the Genomics API and the similarity pass consumes them
 (``VariantsRDD.scala:198-225`` feeding ``VariantsPca.scala:222-231``). The
-synthetic source stands in for that ingest, and its data plane is a
-counter-based hash (splitmix64 finalizer, ``sources/synthetic.py``) — which
-is trivially jittable. This module moves the genotype data plane onto the
-TPU:
+synthetic source stands in for that ingest, and its entire data plane is
+counter-based u64 hashing (splitmix64) plus fixed-point arithmetic
+(``sources/synthetic.py``) — all trivially jittable. This module moves the
+whole ingest onto the TPU:
 
-- the host computes only per-*site* metadata (allele frequencies, ref-block
-  flags, per-population comparison thresholds) — a few hundred bytes per
-  variant, the moral equivalent of the reference's variant metadata;
-- the device generates the (block, samples) genotype matrix with the exact
-  same splitmix64 draws as the host source (bitwise-identical, tested) and
-  feeds it straight into the MXU Gramian update, fused in one XLA program;
-- many blocks are processed per dispatch via ``lax.scan``, so the
-  host→device round-trip count stays in the hundreds for a whole-genome run.
-  (On remote-attached backends, per-dispatch overhead is ~7 ms and the final
-  result fetch pays O(prior dispatches) — measured; fusing is what makes the
-  end-to-end number honest rather than a projection.)
+- per dispatch the host sends TWO SCALARS (a site-grid offset and a valid
+  count); the device reconstructs positions, recomputes the per-site
+  metadata (ref-block drops, Q32 allele frequencies, per-population
+  genotype thresholds, the ``--min-allele-frequency`` filter) bit-identically
+  to the host source, generates the (block, samples) genotype matrix with
+  the exact same splitmix64 draws, and accumulates ``G += XᵀX`` on the MXU —
+  one scanned XLA program per dispatch group;
+- there is no per-site host→device traffic at all, so throughput is pure
+  device compute, independent of interconnect bandwidth (on remote-attached
+  backends the per-site threshold transfer of an earlier design was the
+  bottleneck, and the final fetch pays O(prior dispatches) — fused scanning
+  keeps dispatches in the hundreds for a whole-genome run).
 
-Exactness of the comparison: the host draws ``u = (h >> 11) * 2**-53`` and
-keeps an allele when ``u < p`` (``sources/synthetic.py:_u01``). Because
-``m = h >> 11`` is a 53-bit integer, ``m * 2**-53 < p  ⟺  m < ceil(p * 2**53)``
-(for real ``p``; when ``p * 2**53`` is an integer, strictness matches because
-``m`` is an integer). ``p < 1`` has a 53-bit mantissa so ``p * 2**53`` is an
-exact float64 and its ``ceil`` converts to uint64 exactly — the device never
-touches float64, it compares 64-bit integers.
+Exactness of the float↔integer correspondence: the host draws
+``u = (h >> 11) * 2**-53`` and keeps an allele when ``u < p`` where every
+``p`` is an exact dyadic rational ``k·2⁻³²`` (``sources/synthetic.py``
+fixed-point site fields). Because ``m = h >> 11`` is a 53-bit integer,
+``m · 2⁻⁵³ < k · 2⁻³²  ⟺  m < k · 2²¹`` — the device compares 64-bit
+integers and never touches floating point. The AF filter compares
+micro-units (``round(af·1e6)``, half-even) against ``floor(threshold·1e6)``
+(exact via Fraction) — the same rule every host path uses
+(``sources/synthetic.py:af_passes``).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Iterator, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -48,7 +52,11 @@ _P4 = 0xD6E8FEB86659FD93
 _M1 = 0xBF58476D1CE4E5B9
 _M2 = 0x94D049BB133111EB
 _MASK64 = (1 << 64) - 1
-_S_GENOTYPE = 100  # sources/synthetic.py draw-stream tag
+# Draw-stream tags (sources/synthetic.py).
+_S_REF_BLOCK = 1
+_S_AF = 2
+_S_POP_BASE = 3
+_S_GENOTYPE = 100
 
 
 def _c64(value: int) -> jax.Array:
@@ -66,21 +74,85 @@ def mix64(x: jax.Array) -> jax.Array:
     return (x ^ (x >> jnp.uint64(31))).astype(jnp.uint64)
 
 
+def _u64_stream(key: jax.Array, pos_term: jax.Array, stream: int) -> jax.Array:
+    """``sources/synthetic.py:_u64(key, pos, stream)`` with default
+    sample/allele — four chained mixes (the zero sample/allele terms still
+    mix)."""
+    h = mix64(key ^ pos_term)
+    h = mix64(h ^ _c64(stream * _P3))
+    h = mix64(h)  # sample = 0
+    return mix64(h)  # allele = 0
+
+
+def site_thresholds_on_device(
+    site_key: jax.Array,  # scalar uint64
+    positions: jax.Array,  # (B,) int64
+    valid: jax.Array,  # (B,) bool
+    n_pops: int,
+    ref_block_fraction: float,
+    min_af_micro: Optional[int],
+) -> jax.Array:
+    """(B, P) uint64 genotype thresholds (``af_pop_q32 << 21``), zeroed for
+    ref-block sites, AF-filtered sites, and invalid (padding) rows —
+    bit-identical to the host's ``_site_fields_q`` metadata
+    (``sources/synthetic.py``)."""
+    from spark_examples_tpu.sources.synthetic import (
+        _AF_BASE_Q32,
+        _AF_SPAN_Q16,
+        _POP_BASE_Q16,
+        _POP_HI_Q32,
+        _POP_LO_Q32,
+        _POP_SPAN_Q17,
+    )
+    import math
+
+    pos_term = positions.astype(jnp.uint64) * _c64(_P2)
+    ref_thresh = math.ceil(ref_block_fraction * 2.0**53)
+    is_ref = (
+        _u64_stream(site_key, pos_term, _S_REF_BLOCK) >> jnp.uint64(11)
+    ) < _c64(ref_thresh)
+    u_af = _u64_stream(site_key, pos_term, _S_AF) >> jnp.uint64(48)  # Q16
+    af_q32 = _c64(_AF_BASE_Q32) + ((u_af * u_af * _c64(_AF_SPAN_Q16)) >> jnp.uint64(16))
+    keep = valid & ~is_ref
+    if min_af_micro is not None:
+        # round-half-even(af_q32 · 1e6 / 2^32) > floor(threshold · 1e6):
+        # the canonical micro-unit AF rule (sources/synthetic.py:af_passes).
+        x = af_q32 * _c64(1_000_000)
+        q = x >> jnp.uint64(32)
+        frac = x & _c64((1 << 32) - 1)
+        half = _c64(1 << 31)
+        r = q + ((frac > half) | ((frac == half) & ((q & jnp.uint64(1)) == 1))).astype(jnp.uint64)
+        keep = keep & (r > _c64(min_af_micro))
+    pops = []
+    for p in range(n_pops):
+        u_p = _u64_stream(site_key, pos_term, _S_POP_BASE + p) >> jnp.uint64(48)
+        factor = _c64(_POP_BASE_Q16) + ((u_p * _c64(_POP_SPAN_Q17)) >> jnp.uint64(16))
+        af_pop = jnp.clip(
+            (af_q32 * factor) >> jnp.uint64(16),
+            _c64(_POP_LO_Q32),
+            _c64(_POP_HI_Q32),
+        )
+        pops.append(af_pop << jnp.uint64(21))  # Q32 → Q53 threshold
+    T = jnp.stack(pops, axis=1)  # (B, P)
+    return jnp.where(keep[:, None], T, jnp.uint64(0))
+
+
 def generate_has_variation(
     positions: jax.Array,  # (B,) int64
-    thresholds: jax.Array,  # (B, P) uint64: ceil(af_pop * 2^53), 0 = dropped
+    thresholds: jax.Array,  # (B, P) uint64 Q53 thresholds, 0 = dropped
     vs_keys: jax.Array,  # (S,) uint64: per-variant-set genotype stream keys
     pops: jax.Array,  # (N,) int32: sample → population
 ) -> jax.Array:
     """(B, S*N) {0,1} has-variation rows, bitwise-equal to the host packed
-    path (``sources/synthetic.py:genotype_blocks``) for kept sites; rows whose
-    thresholds are zeroed come out all-zero (contribute nothing to XᵀX).
+    path (``sources/synthetic.py:genotype_blocks``) for kept sites; rows
+    whose thresholds are zeroed come out all-zero (contribute nothing to
+    XᵀX).
 
     Multi-dataset: synthetic variant sets share the site grid (site identity
-    is keyed by position only — ``sources/synthetic.py:_site_fields``), so the
-    reference's 2-set join and ≥3-set merge-intersect (``VariantsPca.scala:
-    155-188``) both reduce to column concatenation of per-set genotype
-    matrices; ``vs_keys`` carries one genotype stream per set.
+    is keyed by position only — ``sources/synthetic.py:_site_fields``), so
+    the reference's 2-set join and ≥3-set merge-intersect
+    (``VariantsPca.scala:155-188``) both reduce to column concatenation of
+    per-set genotype matrices; ``vs_keys`` carries one stream per set.
     """
     n = pops.shape[0]
     samples = (jnp.arange(n, dtype=jnp.uint64) * _c64(_P4))[None, :]
@@ -97,15 +169,87 @@ def generate_has_variation(
     return jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
 
 
-class DeviceGenGramianAccumulator:
-    """Fused generate→accumulate pipeline for the synthetic data plane.
+@functools.lru_cache(maxsize=32)
+def _fused_update(
+    vs_keys: Tuple[int, ...],
+    pops_bytes: bytes,
+    site_key: int,
+    spacing: int,
+    ref_block_fraction: float,
+    min_af_micro: Optional[int],
+    block_size: int,
+    blocks_per_dispatch: int,
+    operand_name: str,
+    accum_name: str,
+):
+    """Build (and memoize) the scanned generate→accumulate program for one
+    static configuration. Memoizing at module level means every accumulator
+    with the same configuration — e.g. a warmup instance and a measured
+    instance — shares one traced/compiled program instead of re-tracing per
+    instance."""
+    operand_dtype = np.dtype(operand_name)
+    accum_dtype = np.dtype(accum_name)
+    n_pops = int(np.frombuffer(pops_bytes, dtype=np.int32).max()) + 1
+    K, B = blocks_per_dispatch, block_size
 
-    Carries the Gramian and a variant-row counter through chained scanned
-    dispatches; nothing is fetched from the device until
-    :meth:`finalize_device`'s result is consumed downstream. ``exact_int``
-    accumulates int8×int8→int32 on the MXU (always exact; whole-genome
-    diagonal counts ~12M would sit uncomfortably close to f32's 2^24 integer
-    limit — SURVEY §7 hard-part 3).
+    with jax.enable_x64(True):
+        vs_keys_arr = jnp.asarray(
+            np.array([k & _MASK64 for k in vs_keys], dtype=np.uint64)
+        )
+        pops_arr = jnp.asarray(np.frombuffer(pops_bytes, dtype=np.int32))
+        site_key_arr = _c64(site_key)
+
+        @jax.jit
+        def update(G, rows_count, kept_count, grid_offset, n_valid):
+            block_idx = jnp.arange(K * B, dtype=jnp.int64).reshape(K, B)
+
+            def body(carry, idx):
+                G, rows_count, kept_count = carry
+                index = grid_offset + idx  # (B,) grid indices
+                positions = index * spacing
+                valid = idx < n_valid
+                T = site_thresholds_on_device(
+                    site_key_arr,
+                    positions,
+                    valid,
+                    n_pops,
+                    ref_block_fraction,
+                    min_af_micro,
+                )
+                kept_count += jnp.sum(jnp.any(T > 0, axis=1)).astype(
+                    kept_count.dtype
+                )
+                hv = generate_has_variation(positions, T, vs_keys_arr, pops_arr)
+                per_set = hv.reshape(hv.shape[0], rows_count.shape[0], -1)
+                rows_count += jnp.sum(jnp.any(per_set, axis=2), axis=0).astype(
+                    rows_count.dtype
+                )
+                X = hv.astype(operand_dtype)
+                G = G + jnp.einsum(
+                    "bn,bm->nm", X, X, preferred_element_type=accum_dtype
+                )
+                return (G, rows_count, kept_count), None
+
+            (G, rows_count, kept_count), _ = lax.scan(
+                body, (G, rows_count, kept_count), block_idx
+            )
+            return G, rows_count, kept_count
+
+        return update
+
+
+class DeviceGenGramianAccumulator:
+    """Fully fused on-device ingest+similarity for the synthetic source.
+
+    The host walks the site grid in fixed-size dispatch groups and sends only
+    ``(grid_offset, valid_count)`` scalars; the device reconstructs
+    positions (``index · spacing``), recomputes site metadata, generates
+    genotypes, and accumulates. Carries the Gramian, a kept-site counter,
+    and per-set variant-row counters through chained scanned dispatches;
+    nothing is fetched until finalize. ``exact_int`` accumulates
+    int8×int8→int32 on the MXU (always exact; whole-genome diagonal counts
+    ~12M would sit uncomfortably close to f32's 2^24 integer limit — SURVEY
+    §7 hard-part 3).
     """
 
     def __init__(
@@ -113,17 +257,23 @@ class DeviceGenGramianAccumulator:
         num_samples: int,
         vs_keys: Sequence[int],
         pops: np.ndarray,
+        site_key: int,
+        spacing: int,
+        ref_block_fraction: float,
+        min_af_micro: Optional[int] = None,
         block_size: int = 2048,
         blocks_per_dispatch: int = 32,
         exact_int: bool = True,
     ):
+        from spark_examples_tpu.ops.gramian import _operand_dtypes
+
         self.num_samples = int(num_samples)
         self.n_sets = len(vs_keys)
         self.total_columns = self.num_samples * self.n_sets
         self.block_size = int(block_size)
         self.blocks_per_dispatch = int(blocks_per_dispatch)
-        from spark_examples_tpu.ops.gramian import _operand_dtypes
-
+        self.sites_per_dispatch = self.block_size * self.blocks_per_dispatch
+        self.spacing = int(spacing)
         # Shared dtype policy: int8→int32 when exact, bf16 on TPU / f32 on
         # CPU otherwise (the CPU thunk runtime lacks some bf16 dot shapes).
         operand_dtype, accum_dtype = _operand_dtypes(exact_int)
@@ -131,58 +281,65 @@ class DeviceGenGramianAccumulator:
         self.dispatches = 0
 
         with jax.enable_x64(True):
-            self._vs_keys = jnp.asarray(
-                np.array([k & _MASK64 for k in vs_keys], dtype=np.uint64)
-            )
-            self._pops = jnp.asarray(np.asarray(pops, dtype=np.int32))
             self.G = jnp.zeros(
                 (self.total_columns, self.total_columns), accum_dtype
             )
             # Per-set counts of rows with variation in that set's columns —
             # matches the wire path's per-dataset record accounting.
             self.variant_rows = jnp.zeros((self.n_sets,), jnp.int64)
+            self.kept_sites = jnp.zeros((), jnp.int64)
 
-            vs_keys_arr, pops_arr = self._vs_keys, self._pops
+        self._update = _fused_update(
+            tuple(int(k) for k in vs_keys),
+            np.asarray(pops, dtype=np.int32).tobytes(),
+            int(site_key),
+            self.spacing,
+            float(ref_block_fraction),
+            min_af_micro,
+            self.block_size,
+            self.blocks_per_dispatch,
+            np.dtype(operand_dtype).name,
+            np.dtype(accum_dtype).name,
+        )
 
-            @jax.jit
-            def update(G, count, positions, thresholds):
-                def body(carry, xs):
-                    G, count = carry
-                    pos, thr = xs
-                    hv = generate_has_variation(
-                        pos, thr, vs_keys_arr, pops_arr
-                    )
-                    per_set = hv.reshape(hv.shape[0], count.shape[0], -1)
-                    count += jnp.sum(jnp.any(per_set, axis=2), axis=0).astype(
-                        count.dtype
-                    )
-                    X = hv.astype(operand_dtype)
-                    G = G + jnp.einsum(
-                        "bn,bm->nm", X, X, preferred_element_type=accum_dtype
-                    )
-                    return (G, count), None
-
-                (G, count), _ = lax.scan(body, (G, count), (positions, thresholds))
-                return G, count
-
-            self._update = update
-
-    def add_plan(self, positions: np.ndarray, thresholds: np.ndarray) -> None:
-        """Dispatch one scanned group: ``positions`` (K, B) int64,
-        ``thresholds`` (K, B, P) uint64 (zero rows = dropped/padding)."""
-        if positions.shape != (self.blocks_per_dispatch, self.block_size):
+    def add_range(self, grid_offset: int, n_valid: int) -> None:
+        """Dispatch one group covering grid indices
+        ``[grid_offset, grid_offset + n_valid)`` (positions ``index ·
+        spacing``); indices past ``n_valid`` are padding."""
+        if not 0 < n_valid <= self.sites_per_dispatch:
             raise ValueError(
-                f"expected ({self.blocks_per_dispatch}, {self.block_size}) "
-                f"positions, got {positions.shape}"
+                f"n_valid must be in (0, {self.sites_per_dispatch}], got {n_valid}"
             )
         with jax.enable_x64(True):
-            self.G, self.variant_rows = self._update(
+            self.G, self.variant_rows, self.kept_sites = self._update(
                 self.G,
                 self.variant_rows,
-                jnp.asarray(positions),
-                jnp.asarray(thresholds),
+                self.kept_sites,
+                jnp.asarray(np.int64(grid_offset)),
+                jnp.asarray(np.int64(n_valid)),
             )
         self.dispatches += 1
+
+    def add_grid(self, first_index: int, last_index: int) -> None:
+        """Dispatch all groups for a contiguous grid index range
+        ``[first_index, last_index)``."""
+        for off in range(first_index, last_index, self.sites_per_dispatch):
+            n_valid = min(self.sites_per_dispatch, last_index - off)
+            self.add_range(off, n_valid)
+            if self.dispatches == 1:
+                self.poke()
+
+    def poke(self) -> None:
+        """Force the backend into eager execution with one tiny sync fetch.
+
+        The remote-attached (tunneled) PJRT backend defers execution of
+        queued dispatches until the first synchronous transfer — host work
+        and device work would otherwise run strictly serially (measured:
+        total = host + execute). One scalar fetch after the first dispatch
+        flips it to eager for the rest of the stream.
+        """
+        with jax.enable_x64(True):
+            jax.device_get(self.kept_sites)
 
     def finalize_device(self) -> jax.Array:
         """The accumulated Gramian, still on device (single data slice, so no
@@ -195,47 +352,9 @@ class DeviceGenGramianAccumulator:
             return np.asarray(jax.device_get(self.G)).astype(np.float64)
 
 
-def plan_blocks(
-    plan_iter: Iterator[Tuple[np.ndarray, np.ndarray]],
-    block_size: int,
-    blocks_per_dispatch: int,
-    n_pops: int,
-) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-    """Re-chunk a stream of (positions, thresholds) site batches into fixed
-    (K, B) dispatch groups, zero-padding the final group (zero thresholds
-    generate all-zero rows, which contribute nothing to XᵀX)."""
-    cap = block_size * blocks_per_dispatch
-    pos_buf = np.zeros(cap, dtype=np.int64)
-    thr_buf = np.zeros((cap, n_pops), dtype=np.uint64)
-    fill = 0
-    for positions, thresholds in plan_iter:
-        offset = 0
-        while offset < len(positions):
-            take = min(cap - fill, len(positions) - offset)
-            pos_buf[fill : fill + take] = positions[offset : offset + take]
-            thr_buf[fill : fill + take] = thresholds[offset : offset + take]
-            fill += take
-            offset += take
-            if fill == cap:
-                yield (
-                    pos_buf.reshape(blocks_per_dispatch, block_size).copy(),
-                    thr_buf.reshape(
-                        blocks_per_dispatch, block_size, n_pops
-                    ).copy(),
-                )
-                fill = 0
-    if fill:
-        pos_buf[fill:] = 0
-        thr_buf[fill:] = 0
-        yield (
-            pos_buf.reshape(blocks_per_dispatch, block_size).copy(),
-            thr_buf.reshape(blocks_per_dispatch, block_size, n_pops).copy(),
-        )
-
-
 __all__ = [
     "DeviceGenGramianAccumulator",
     "generate_has_variation",
     "mix64",
-    "plan_blocks",
+    "site_thresholds_on_device",
 ]

@@ -125,12 +125,24 @@ class VariantsPcaDriver:
     def filter_variant(self, variant: Variant) -> bool:
         """``--min-allele-frequency`` on the AF info field
         (``VariantsPca.scala:136-148``): strictly greater, first AF value,
-        variants without AF dropped."""
+        variants without AF dropped.
+
+        For the synthetic source the comparison uses the canonical micro-unit
+        rule (``utils/af.py``) so the wire path agrees bit-for-bit with the
+        packed and device ingest paths (whose AF lives on the 6-decimal
+        grid); generic sources keep the reference's plain float comparison.
+        """
         if self.conf.min_allele_frequency is None:
             return True
         af = variant.info.get("AF")
         if not af:
             return False
+        if isinstance(self.source, SyntheticGenomicsSource):
+            from spark_examples_tpu.utils.af import af_passes
+
+            return bool(
+                af_passes(float(af[0]), self.conf.min_allele_frequency)
+            )
         return float(af[0]) > self.conf.min_allele_frequency
 
     # ----------------------------------------------------------------- calls
@@ -262,18 +274,23 @@ class VariantsPcaDriver:
                 n, mesh, block_size=self.conf.block_size, exact_int=exact
             )
         staging: List[List[int]] = []
+        # Duplicate callset indices only arise when a variant set is joined
+        # with itself (duplicate ids collapse the column index); only then is
+        # the slower unbuffered accumulation needed to reproduce the
+        # reference's pair-loop multiplicity, where k duplicates contribute
+        # k² per entry (``VariantsPca.scala:224-229``).
+        ids = self.conf.variant_set_id
+        dup_sets = len(set(ids)) != len(ids)
 
         def flush():
             if not staging:
                 return
             rows = np.zeros((len(staging), n), dtype=np.uint8)
             for i, row in enumerate(staging):
-                # np.add.at accumulates duplicate indices: a callset column
-                # appearing k times in a row contributes k² per entry, the
-                # reference's pair-loop multiplicity (``VariantsPca.scala:
-                # 224-229``) — matters when a variant set is joined with
-                # itself.
-                np.add.at(rows[i], np.asarray(row, dtype=np.int64), 1)
+                if dup_sets:
+                    np.add.at(rows[i], np.asarray(row, dtype=np.int64), 1)
+                else:
+                    rows[i, row] = 1
             acc.add_rows(rows)
             staging.clear()
 
@@ -322,10 +339,8 @@ class VariantsPcaDriver:
         column concatenation of per-set genotype matrices — verified against
         the wire path in tests.
         """
-        from spark_examples_tpu.ops.devicegen import (
-            DeviceGenGramianAccumulator,
-            plan_blocks,
-        )
+        from spark_examples_tpu.ops.devicegen import DeviceGenGramianAccumulator
+        from spark_examples_tpu.sources.synthetic import af_filter_micro
 
         source: SyntheticGenomicsSource = self.source  # type: ignore[assignment]
         conf = self.conf
@@ -335,38 +350,34 @@ class VariantsPcaDriver:
                 source.genotype_stream_key(v) for v in conf.variant_set_id
             ],
             pops=source.populations,
+            site_key=source.site_key,
+            spacing=source.variant_spacing,
+            ref_block_fraction=source.ref_block_fraction,
+            min_af_micro=af_filter_micro(conf.min_allele_frequency),
             block_size=conf.block_size,
             blocks_per_dispatch=conf.blocks_per_dispatch,
             exact_int=True,
         )
 
-        def plans():
-            page_size = 1024  # synthetic wire path's variants page size
-            for contig in contigs:
-                scanned_before = getattr(source, "plan_sites_scanned", 0)
-                for batch in source.site_threshold_plan(
-                    contig, min_allele_frequency=conf.min_allele_frequency
-                ):
-                    yield batch
-                if self.io_stats is not None:
-                    # Page accounting mirrors the wire path: one request per
-                    # page of scanned sites, at least one per partition, each
-                    # partition traversed once per variant set.
-                    scanned = source.plan_sites_scanned - scanned_before
-                    for shard in contig.get_shards(conf.bases_per_partition):
-                        for _ in conf.variant_set_id:
-                            self.io_stats.add_partition(shard.range)
-                    n_shards = max(
-                        1, len(contig.get_shards(conf.bases_per_partition))
-                    )
-                    self.io_stats.requests += max(
-                        n_shards, -(-scanned // page_size)
-                    ) * len(conf.variant_set_id)
-
-        for pos, thr in plan_blocks(
-            plans(), conf.block_size, conf.blocks_per_dispatch, source.n_pops
-        ):
-            acc.add_plan(pos, thr)
+        page_size = 1024  # synthetic wire path's variants page size
+        self._device_gen_scanned = 0
+        for contig in contigs:
+            k0, k1 = source.site_grid_range(contig)
+            if k1 > k0:
+                acc.add_grid(k0, k1)
+            scanned = k1 - k0
+            self._device_gen_scanned += scanned
+            if self.io_stats is not None:
+                # Page accounting mirrors the wire path: one request per page
+                # of scanned sites, at least one per partition, each
+                # partition traversed once per variant set.
+                shards = contig.get_shards(conf.bases_per_partition)
+                for _ in conf.variant_set_id:
+                    for shard in shards:
+                        self.io_stats.add_partition(shard.range)
+                self.io_stats.requests += max(
+                    max(1, len(shards)), -(-scanned // page_size)
+                ) * len(conf.variant_set_id)
         self._device_gen_acc = acc
         return acc.finalize_device()
 

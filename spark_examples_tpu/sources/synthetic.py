@@ -69,8 +69,50 @@ def _af6(af: np.ndarray) -> np.ndarray:
     round-trip, so the packed/device paths (which compare ``_af6(af)``
     directly) and the wire path (which compares the parsed string) apply
     ``--min-allele-frequency`` identically on threshold-adjacent sites.
+    (For Q32 allele frequencies ``k·2⁻³²``, ``af·1e6 = k·1e6·2⁻³² < 2⁵²`` is
+    exact in float64, so NumPy's round-half-even here equals the integer
+    rounding the device kernel uses.)
     """
     return np.round(np.asarray(af) * 1e6) / 1e6
+
+
+# Fixed-point site-field constants (Q16/Q32/Q53). All site metadata is
+# derived with u64-only arithmetic so the device ingest kernel
+# (``ops/devicegen.py``) can recompute it bit-identically from positions
+# alone — no per-site host→device traffic. The float forms used by the wire
+# path are exact dyadic rationals (k·2⁻³²), so float comparisons elsewhere
+# (``u < af_pop`` in :meth:`_genotype_alleles`) remain bitwise-equal to the
+# integer threshold compares on device.
+_AF_BASE_Q32 = round(0.01 * 2**32)  # af = 0.01 + u²·0.49
+_AF_SPAN_Q16 = round(0.49 * 2**16)
+_POP_BASE_Q16 = round(0.25 * 2**16)  # af_pop = af·(0.25 + 1.5·u_p), clipped
+_POP_SPAN_Q17 = round(1.5 * 2**16)
+_POP_LO_Q32 = round(0.002 * 2**32)
+_POP_HI_Q32 = round(0.95 * 2**32)
+
+
+# Canonical AF-filter rule shared with the driver and device kernel.
+from spark_examples_tpu.utils.af import af_filter_micro, af_passes  # noqa: E402
+
+
+def _site_fields_q(site_key: np.uint64, positions: np.ndarray, ref_block_fraction: float, n_pops: int):
+    """Integer site metadata: (is_ref_block, af_q32 (B,), af_pop_q32 (B, P)).
+
+    Every operation is a u64 shift/multiply/add with no intermediate over
+    2⁶⁴, mirrored exactly by the jitted kernel in ``ops/devicegen.py``.
+    """
+    ref_thresh = _U64(math.ceil(ref_block_fraction * 2.0**53))
+    is_ref_block = (_u64(site_key, positions, _S_REF_BLOCK) >> _U64(11)) < ref_thresh
+    u_af = _u64(site_key, positions, _S_AF) >> _U64(48)  # Q16
+    u2 = u_af * u_af  # Q32, fits 32 bits
+    af_q32 = _U64(_AF_BASE_Q32) + ((u2 * _U64(_AF_SPAN_Q16)) >> _U64(16))
+    pops = []
+    for p in range(n_pops):
+        u_p = _u64(site_key, positions, _S_POP_BASE + p) >> _U64(48)  # Q16
+        factor_q16 = _U64(_POP_BASE_Q16) + ((u_p * _U64(_POP_SPAN_Q17)) >> _U64(16))
+        af_pop = (af_q32 * factor_q16) >> _U64(16)
+        pops.append(np.clip(af_pop, _U64(_POP_LO_Q32), _U64(_POP_HI_Q32)))
+    return is_ref_block, af_q32, np.stack(pops, axis=1)
 
 
 def _mix(x: np.ndarray) -> np.ndarray:
@@ -223,20 +265,24 @@ class SyntheticGenomicsSource(GenomicsSource):
         (``VariantsPca.scala:155-188``).
         """
         site_key = _mix(_U64(self.seed))
-        is_ref_block = _u01(site_key, positions, _S_REF_BLOCK) < self.ref_block_fraction
-        u_af = _u01(site_key, positions, _S_AF)
-        af = 0.01 + (u_af**2) * 0.49
-        af_pop = np.stack(
-            [
-                np.clip(af * (0.25 + 1.5 * _u01(site_key, positions, _S_POP_BASE + p)), 0.002, 0.95)
-                for p in range(self.n_pops)
-            ],
-            axis=1,
+        is_ref_block, af_q32, af_pop_q32 = _site_fields_q(
+            site_key, positions, self.ref_block_fraction, self.n_pops
         )
+        # Exact dyadic floats (k·2⁻³²): float comparisons downstream equal
+        # the device kernel's integer compares bit for bit.
+        af = af_q32.astype(np.float64) * 2.0**-32
+        af_pop = af_pop_q32.astype(np.float64) * 2.0**-32
         ref_idx = (_u64(site_key, positions, _S_REF_BASE) % _U64(4)).astype(np.int64)
         alt_off = (_u64(site_key, positions, _S_ALT_BASE) % _U64(3)).astype(np.int64)
         alt_idx = (ref_idx + 1 + alt_off) % 4
         return is_ref_block, af, af_pop, ref_idx, alt_idx
+
+    @property
+    def site_key(self) -> int:
+        """The uint64 key of the variant-set-independent site-metadata
+        streams (``_site_fields``) — with :meth:`genotype_stream_key` and
+        the grid, everything the device ingest kernel needs."""
+        return int(_mix(_U64(self.seed)))
 
     def genotype_stream_key(self, variant_set_id: str) -> int:
         """The per-variant-set uint64 key of the genotype draw stream — the
@@ -248,6 +294,16 @@ class SyntheticGenomicsSource(GenomicsSource):
     def populations(self) -> np.ndarray:
         """Sample → population index (``(N,)`` int64)."""
         return self._pops
+
+    def site_grid_range(self, contig: Contig) -> Tuple[int, int]:
+        """The contig's candidate-site grid as index range ``[k0, k1)`` with
+        position ``k · variant_spacing`` — the only ingest metadata the
+        device generation path needs (``ops/devicegen.py`` recomputes
+        everything else on device)."""
+        spacing = self.variant_spacing
+        k0 = -(-max(contig.start, 0) // spacing)
+        k1 = -(-contig.end // spacing)
+        return k0, max(k0, k1)
 
     def site_threshold_plan(
         self,
@@ -271,7 +327,7 @@ class SyntheticGenomicsSource(GenomicsSource):
             is_ref_block, af, af_pop, _, _ = self._site_fields("", positions)
             keep = ~is_ref_block
             if min_allele_frequency is not None:
-                keep &= _af6(af) > float(min_allele_frequency)
+                keep &= af_passes(af, min_allele_frequency)
             self.plan_sites_scanned += len(positions)
             positions = positions[keep]
             if len(positions) == 0:
@@ -314,7 +370,7 @@ class SyntheticGenomicsSource(GenomicsSource):
             is_ref_block, af, _, _, _ = self._site_fields(variant_set_id, positions)
             keep = ~is_ref_block
             if min_allele_frequency is not None:
-                keep &= _af6(af) > float(min_allele_frequency)
+                keep &= af_passes(af, min_allele_frequency)
             positions = positions[keep]
             af = af[keep]
             if len(positions) == 0:

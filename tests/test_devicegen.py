@@ -1,9 +1,9 @@
-"""Device-side genotype generation vs the host synthetic source.
+"""Device-side ingest vs the host synthetic source.
 
 The device data plane (``ops/devicegen.py``) must be bitwise-identical to the
 host packed path (``sources/synthetic.py:genotype_blocks``) — same splitmix64
-draws, same keep semantics — or the benchmark would be running a different
-cohort than the wire path serves.
+draws, same fixed-point site metadata, same keep semantics — or the benchmark
+would be running a different cohort than the wire path serves.
 """
 
 import numpy as np
@@ -15,11 +15,15 @@ from spark_examples_tpu.ops.devicegen import (
     DeviceGenGramianAccumulator,
     generate_has_variation,
     mix64,
-    plan_blocks,
+    site_thresholds_on_device,
 )
 from spark_examples_tpu.ops.gramian import gramian_reference
 from spark_examples_tpu.sharding.contig import Contig
-from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource, _mix
+from spark_examples_tpu.sources.synthetic import (
+    SyntheticGenomicsSource,
+    _mix,
+    af_filter_micro,
+)
 
 
 def test_mix64_matches_host():
@@ -37,23 +41,58 @@ def _host_blocks(source, vsid, contig, **kw):
 
 
 @pytest.mark.parametrize("min_af", [None, 0.1])
-def test_device_rows_bitwise_match_host_packed_path(min_af):
+def test_device_thresholds_bitwise_match_host_plan(min_af):
+    """On-device site metadata == the host's compacted threshold plan."""
     source = SyntheticGenomicsSource(num_samples=40, seed=7)
     contig = Contig("17", 41_196_311, 41_277_499)  # BRCA1
+    plan = list(source.site_threshold_plan(contig, min_allele_frequency=min_af))
+    host_pos = np.concatenate([p for p, _ in plan])
+    host_thr = np.concatenate([t for _, t in plan])
+
+    k0, k1 = source.site_grid_range(contig)
+    grid_pos = np.arange(k0, k1, dtype=np.int64) * source.variant_spacing
+    with jax.enable_x64(True):
+        T = np.asarray(
+            jax.device_get(
+                site_thresholds_on_device(
+                    jax.numpy.asarray(np.uint64(source.site_key)),
+                    jax.numpy.asarray(grid_pos),
+                    jax.numpy.asarray(np.ones(len(grid_pos), dtype=bool)),
+                    source.n_pops,
+                    source.ref_block_fraction,
+                    af_filter_micro(min_af),
+                )
+            )
+        )
+    keep = np.isin(grid_pos, host_pos)
+    np.testing.assert_array_equal(T[~keep], 0)
+    np.testing.assert_array_equal(T[keep], host_thr)
+
+
+def test_device_rows_bitwise_match_host_packed_path():
+    source = SyntheticGenomicsSource(num_samples=40, seed=7)
+    contig = Contig("17", 41_196_311, 41_277_499)
     vsid = "10473108253681171589"
-    host = _host_blocks(source, vsid, contig, min_allele_frequency=min_af)
+    host = _host_blocks(source, vsid, contig)
     host_rows = np.concatenate([b["has_variation"] for b in host])
     host_pos = np.concatenate([b["positions"] for b in host])
 
-    plan = list(source.site_threshold_plan(contig, min_allele_frequency=min_af))
-    positions = np.concatenate([p for p, _ in plan])
-    thresholds = np.concatenate([t for _, t in plan])
+    k0, k1 = source.site_grid_range(contig)
+    grid_pos = np.arange(k0, k1, dtype=np.int64) * source.variant_spacing
     with jax.enable_x64(True):
+        T = site_thresholds_on_device(
+            jax.numpy.asarray(np.uint64(source.site_key)),
+            jax.numpy.asarray(grid_pos),
+            jax.numpy.asarray(np.ones(len(grid_pos), dtype=bool)),
+            source.n_pops,
+            source.ref_block_fraction,
+            None,
+        )
         rows = np.asarray(
             jax.device_get(
                 generate_has_variation(
-                    jax.numpy.asarray(positions),
-                    jax.numpy.asarray(thresholds),
+                    jax.numpy.asarray(grid_pos),
+                    T,
                     jax.numpy.asarray(
                         np.array(
                             [source.genotype_stream_key(vsid)], dtype=np.uint64
@@ -66,45 +105,9 @@ def test_device_rows_bitwise_match_host_packed_path(min_af):
     # The host path additionally drops all-zero-variation rows; align on
     # positions and compare those rows bitwise, and check dropped rows are
     # exactly the all-zero ones.
-    keep = np.isin(positions, host_pos)
+    keep = np.isin(grid_pos, host_pos)
     np.testing.assert_array_equal(rows[~keep], 0)
     np.testing.assert_array_equal(rows[keep], host_rows)
-
-
-def test_device_multiset_concatenates_per_set_genotypes():
-    source = SyntheticGenomicsSource(num_samples=12, seed=3)
-    contig = Contig("20", 100_000, 140_000)
-    set_a, set_b = "setA", "setB"
-    plan = list(source.site_threshold_plan(contig))
-    positions = np.concatenate([p for p, _ in plan])
-    thresholds = np.concatenate([t for _, t in plan])
-    with jax.enable_x64(True):
-        rows = np.asarray(
-            jax.device_get(
-                generate_has_variation(
-                    jax.numpy.asarray(positions),
-                    jax.numpy.asarray(thresholds),
-                    jax.numpy.asarray(
-                        np.array(
-                            [
-                                source.genotype_stream_key(set_a),
-                                source.genotype_stream_key(set_b),
-                            ],
-                            dtype=np.uint64,
-                        )
-                    ),
-                    jax.numpy.asarray(source.populations.astype(np.int32)),
-                )
-            )
-        ).astype(np.uint8)
-    for col_off, vsid in ((0, set_a), (12, set_b)):
-        host = _host_blocks(source, vsid, contig)
-        host_rows = np.concatenate([b["has_variation"] for b in host])
-        host_pos = np.concatenate([b["positions"] for b in host])
-        keep = np.isin(positions, host_pos)
-        np.testing.assert_array_equal(
-            rows[keep, col_off : col_off + 12], host_rows
-        )
 
 
 @pytest.mark.parametrize("exact_int", [True, False])
@@ -119,31 +122,107 @@ def test_fused_accumulator_matches_reference_gramian(exact_int):
         num_samples=24,
         vs_keys=[source.genotype_stream_key(vsid)],
         pops=source.populations,
+        site_key=source.site_key,
+        spacing=source.variant_spacing,
+        ref_block_fraction=source.ref_block_fraction,
         block_size=64,
         blocks_per_dispatch=4,
         exact_int=exact_int,
     )
-    for pos, thr in plan_blocks(
-        source.site_threshold_plan(contig), 64, 4, source.n_pops
-    ):
-        acc.add_plan(pos, thr)
+    k0, k1 = source.site_grid_range(contig)
+    acc.add_grid(k0, k1)
     got = acc.finalize()
     np.testing.assert_array_equal(got, gramian_reference(host_rows))
     with jax.enable_x64(True):
-        variant_rows = int(jax.device_get(acc.variant_rows))
-    assert variant_rows == host_rows.shape[0]
+        variant_rows = np.asarray(jax.device_get(acc.variant_rows))
+        kept = int(jax.device_get(acc.kept_sites))
+    assert variant_rows.tolist() == [host_rows.shape[0]]
+    # kept_sites counts AF/ref-kept sites BEFORE the all-zero-variation drop
+    # — the compacted host threshold plan's site count.
+    plan_sites = sum(
+        len(p) for p, _ in source.site_threshold_plan(contig)
+    )
+    assert kept == plan_sites
 
 
-def test_plan_blocks_pads_final_group():
-    batches = [
-        (np.arange(5, dtype=np.int64), np.ones((5, 2), dtype=np.uint64)),
-        (np.arange(5, 8, dtype=np.int64), np.ones((3, 2), dtype=np.uint64)),
-    ]
-    groups = list(plan_blocks(iter(batches), block_size=3, blocks_per_dispatch=2, n_pops=2))
-    assert len(groups) == 2
-    pos0, thr0 = groups[0]
-    assert pos0.shape == (2, 3) and thr0.shape == (2, 3, 2)
-    np.testing.assert_array_equal(pos0.ravel(), np.arange(6))
-    pos1, thr1 = groups[1]
-    np.testing.assert_array_equal(pos1.ravel(), [6, 7, 0, 0, 0, 0])
-    np.testing.assert_array_equal(thr1.reshape(-1, 2)[2:], 0)
+def test_fused_accumulator_min_af_matches_host():
+    source = SyntheticGenomicsSource(num_samples=16, seed=3)
+    contig = Contig("2", 10_000, 90_000)
+    vsid = "vs"
+    host = _host_blocks(source, vsid, contig, min_allele_frequency=0.15)
+    host_rows = np.concatenate([b["has_variation"] for b in host])
+
+    acc = DeviceGenGramianAccumulator(
+        num_samples=16,
+        vs_keys=[source.genotype_stream_key(vsid)],
+        pops=source.populations,
+        site_key=source.site_key,
+        spacing=source.variant_spacing,
+        ref_block_fraction=source.ref_block_fraction,
+        min_af_micro=af_filter_micro(0.15),
+        block_size=32,
+        blocks_per_dispatch=2,
+    )
+    k0, k1 = source.site_grid_range(contig)
+    acc.add_grid(k0, k1)
+    np.testing.assert_array_equal(acc.finalize(), gramian_reference(host_rows))
+
+
+def test_device_multiset_concatenates_per_set_genotypes():
+    source = SyntheticGenomicsSource(num_samples=12, seed=3)
+    contig = Contig("20", 100_000, 140_000)
+    set_a, set_b = "setA", "setB"
+    acc = DeviceGenGramianAccumulator(
+        num_samples=12,
+        vs_keys=[
+            source.genotype_stream_key(set_a),
+            source.genotype_stream_key(set_b),
+        ],
+        pops=source.populations,
+        site_key=source.site_key,
+        spacing=source.variant_spacing,
+        ref_block_fraction=source.ref_block_fraction,
+        block_size=32,
+        blocks_per_dispatch=2,
+    )
+    k0, k1 = source.site_grid_range(contig)
+    acc.add_grid(k0, k1)
+    got = acc.finalize()
+
+    rows_a = np.concatenate(
+        [b["has_variation"] for b in _host_blocks(source, set_a, contig)]
+    )
+    pos_a = np.concatenate(
+        [b["positions"] for b in _host_blocks(source, set_a, contig)]
+    )
+    rows_b = np.concatenate(
+        [b["has_variation"] for b in _host_blocks(source, set_b, contig)]
+    )
+    pos_b = np.concatenate(
+        [b["positions"] for b in _host_blocks(source, set_b, contig)]
+    )
+    # Build the joint matrix on the shared kept-site grid (drops differ only
+    # by all-zero rows, which don't affect the Gramian).
+    all_pos = np.union1d(pos_a, pos_b)
+    joint = np.zeros((len(all_pos), 24), dtype=np.int64)
+    joint[np.searchsorted(all_pos, pos_a), :12] = rows_a
+    joint[np.searchsorted(all_pos, pos_b), 12:] = rows_b
+    np.testing.assert_array_equal(got, joint.T @ joint)
+
+
+def test_add_range_validates():
+    source = SyntheticGenomicsSource(num_samples=8, seed=1)
+    acc = DeviceGenGramianAccumulator(
+        num_samples=8,
+        vs_keys=[source.genotype_stream_key("v")],
+        pops=source.populations,
+        site_key=source.site_key,
+        spacing=source.variant_spacing,
+        ref_block_fraction=source.ref_block_fraction,
+        block_size=8,
+        blocks_per_dispatch=2,
+    )
+    with pytest.raises(ValueError):
+        acc.add_range(0, 0)
+    with pytest.raises(ValueError):
+        acc.add_range(0, 17)
