@@ -19,7 +19,7 @@ Package layout:
 - ``models``    — serializable Variant/Call/Read data models + builders
 - ``sharding``  — contig windows, split policies, partitioners
 - ``sources``   — genomics backends (synthetic, REST) + client counters
-- ``parallel``  — device mesh, collectives, ring sharded Gramian
+- ``parallel``  — device mesh construction and the Spark-shuffle → XLA-collective mapping
 - ``ops``       — device compute: gramian, centering, pca, read depth
 - ``pipeline``  — datasets, stats, PCA driver, checkpointing
 - ``analyses``  — the seven reference example analyses

@@ -27,17 +27,35 @@ def gower_center(S: jax.Array) -> jax.Array:
     return S - row_mean - col_mean + total_mean
 
 
-def gower_center_sharded(S: jax.Array, mesh: Mesh) -> jax.Array:
+def gower_center_sharded(
+    S: jax.Array, mesh: Mesh, n_true: int | None = None
+) -> jax.Array:
     """Centering for a row-sharded Gramian (``samples`` axis): row means are
-    local, column/matrix means are one ``psum`` over the row tiles."""
+    local, column/matrix means are one ``psum`` over the row tiles.
+
+    ``n_true`` handles cohort padding (``ShardedGramianAccumulator`` pads N
+    to a multiple of the samples axis with all-zero rows/columns): means are
+    taken over the true cohort size and padded rows/columns are re-zeroed
+    after centering, so the padded result is exactly the dense result
+    embedded in a zero block — eigenvectors and eigenvalues are unchanged.
+    """
+    n_padded = S.shape[0]
+    n = n_padded if n_true is None else int(n_true)
 
     def per_tile(S_local):
-        n_total = S_local.shape[1]
-        row_mean = jnp.mean(S_local, axis=1, keepdims=True)
+        n_local = S_local.shape[0]
+        row_start = jax.lax.axis_index(SAMPLES_AXIS) * n_local
+        # Padded entries of S are zero by construction, so sums over the
+        # padded extent equal sums over the true extent; only the divisor
+        # and the output mask need the true size.
+        row_mean = jnp.sum(S_local, axis=1, keepdims=True) / n
         col_sum = jax.lax.psum(jnp.sum(S_local, axis=0, keepdims=True), SAMPLES_AXIS)
-        col_mean = col_sum / n_total
-        total_mean = jnp.sum(col_sum) / (n_total * n_total)
-        return S_local - row_mean - col_mean + total_mean
+        col_mean = col_sum / n
+        total_mean = jnp.sum(col_sum) / (n * n)
+        out = S_local - row_mean - col_mean + total_mean
+        row_mask = (row_start + jnp.arange(n_local)) < n
+        col_mask = jnp.arange(S_local.shape[1]) < n
+        return jnp.where(row_mask[:, None] & col_mask[None, :], out, 0.0)
 
     fn = shard_map(
         per_tile,

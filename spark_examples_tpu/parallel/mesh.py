@@ -17,6 +17,27 @@ The TPU equivalent is a named-axis device mesh with XLA collectives over ICI
 The reference's ``--num-reduce-partitions`` ("set it to a number greater than
 the number of cores", ``GenomicsConf.scala:35-38``) maps onto the data-axis
 size, per the BASELINE.json north star.
+
+Every Spark shuffle/broadcast/reduce in the reference's call stacks
+(SURVEY.md §3) maps onto an XLA collective over this mesh, used directly by
+the ops layer inside ``shard_map`` (named-axis primitives are already the
+right API — no wrapper layer):
+
+- ``reduceByKey`` partial-Gramian merge (``VariantsPca.scala:230``) →
+  ``psum`` over ``data`` (``ops/gramian.py``: finalize reduction);
+- ``sc.broadcast`` (``VariantsPca.scala:195,249``) → replication
+  (jit constants / replicated shardings);
+- ``collect`` to driver (``VariantsPca.scala:246``) → one ``device_get``
+  after on-device reduction (``pipeline/pca_driver.py:compute_pca``);
+- streaming pair-emission shuffle (``VariantsPca.scala:302-319``) →
+  ``ppermute`` ring exchange of sample-column tiles
+  (``ops/gramian.py:_ring_tiles``);
+- row-sums collect + re-broadcast for centering (``VariantsPca.scala:
+  246-249``) → ``psum`` of column sums (``ops/centering.py:
+  gower_center_sharded``);
+- driver-side eigendecomposition (``VariantsPca.scala:264-266``) →
+  ``all_gather`` of the skinny subspace iterate
+  (``ops/pca.py:principal_components_subspace_sharded``).
 """
 
 from __future__ import annotations

@@ -265,10 +265,12 @@ class VariantsPcaDriver:
             return self._host_similarity(calls)
         mesh = self._make_mesh()
         exact = getattr(self.conf, "exact_similarity", False)
+        self._similarity_sharded_mesh = None
         if self._resolve_sharded(sharded, mesh):
             acc: object = ShardedGramianAccumulator(
                 n, mesh, block_size=self.conf.block_size, exact_int=exact
             )
+            self._similarity_sharded_mesh = mesh
         else:
             acc = GramianAccumulator(
                 n, mesh, block_size=self.conf.block_size, exact_int=exact
@@ -299,24 +301,36 @@ class VariantsPcaDriver:
             if len(staging) >= self.conf.block_size:
                 flush()
         flush()
+        # Stay on device either way: centering/PCA consume this directly;
+        # fetching the N×N matrix to host is pointless and degrades
+        # remote-attached backends (see ops/gramian.py). The sharded result
+        # remains row-tile-sharded (padded) for the sharded PCA stage.
         if isinstance(acc, GramianAccumulator):
-            # Stay on device: centering/PCA consume this directly; fetching
-            # the N×N matrix to host is pointless and degrades remote-attached
-            # backends (see ops/gramian.py).
             return acc.finalize_device()
-        return acc.finalize()
+        return acc.finalize_sharded()
 
     def get_similarity_rows(
         self, blocks: Iterable[np.ndarray], sharded: Optional[bool] = None
     ) -> np.ndarray:
         """Packed fast path: feed dense uint8 row blocks directly."""
         n = len(self.indexes)
+        if self.conf.pca_backend == "host":
+            # Host oracle on the packed rows (same result surface as
+            # _host_similarity): keeps compute_pca's host branch centered
+            # over the true N.
+            matrix = np.zeros((n, n), dtype=np.int64)
+            for block in blocks:
+                X = np.asarray(block, dtype=np.int64)
+                matrix += X.T @ X
+            return matrix.astype(np.float64)
         mesh = self._make_mesh()
         exact = getattr(self.conf, "exact_similarity", False)
+        self._similarity_sharded_mesh = None
         if self._resolve_sharded(sharded, mesh):
             acc: object = ShardedGramianAccumulator(
                 n, mesh, block_size=self.conf.block_size, exact_int=exact
             )
+            self._similarity_sharded_mesh = mesh
         else:
             acc = GramianAccumulator(
                 n, mesh, block_size=self.conf.block_size, exact_int=exact
@@ -325,7 +339,7 @@ class VariantsPcaDriver:
             acc.add_rows(block)
         if isinstance(acc, GramianAccumulator):
             return acc.finalize_device()
-        return acc.finalize()
+        return acc.finalize_sharded()
 
     def get_similarity_device_gen(self, contigs) -> "object":
         """Fully fused TPU ingest+similarity for the synthetic source: the
@@ -344,6 +358,7 @@ class VariantsPcaDriver:
 
         source: SyntheticGenomicsSource = self.source  # type: ignore[assignment]
         conf = self.conf
+        self._similarity_sharded_mesh = None  # this path is dense-only
         acc = DeviceGenGramianAccumulator(
             num_samples=source.num_samples,
             vs_keys=[
@@ -421,12 +436,34 @@ class VariantsPcaDriver:
         import jax.numpy as jnp
 
         n = len(self.indexes)
+        sharded_mesh = getattr(self, "_similarity_sharded_mesh", None)
         if self.conf.pca_backend == "host":
             similarity = np.asarray(similarity)
             nonzero = int((similarity.sum(axis=1) > 0).sum())
             print(f"Non zero rows in matrix: {nonzero} / {n}.")
             centered = self._host_center(similarity)
             components, _ = mllib_reference_pca(centered, self.conf.num_pc)
+        elif sharded_mesh is not None and hasattr(similarity, "sharding"):
+            # Sharded strategy end to end: the (padded) Gramian stays
+            # row-tile-sharded through centering AND the eigensolve — no
+            # device ever holds the full N×N (the large-N completion of
+            # ``VariantsPca.scala:288-319``'s memory-bounded path).
+            from spark_examples_tpu.ops.centering import gower_center_sharded
+            from spark_examples_tpu.ops.pca import (
+                principal_components_subspace_sharded,
+            )
+
+            centered = gower_center_sharded(similarity, sharded_mesh, n_true=n)
+            device_components, _ = principal_components_subspace_sharded(
+                centered, sharded_mesh, self.conf.num_pc, n_true=n
+            )
+            nonzero = int(
+                jax.device_get((similarity.sum(axis=1) > 0).sum())
+            )
+            print(f"Non zero rows in matrix: {nonzero} / {n}.")
+            components = np.asarray(
+                jax.device_get(device_components), dtype=np.float64
+            )[:n]
         else:
             # Subspace iteration, not full eigh: num_pc is tiny and XLA's TPU
             # eigh is pathologically slow at cohort sizes (see ops/pca.py).

@@ -222,3 +222,57 @@ def test_count_valued_rows_accumulate_multiplicity():
     acc.add_rows(rows)
     expected = rows.astype(np.int64).T @ rows.astype(np.int64)
     np.testing.assert_array_equal(acc.finalize(), expected)
+
+
+def test_gower_center_sharded_padded_n():
+    """Non-divisible cohort: padded rows/cols must come out zero and the
+    true block must match the dense centering."""
+    mesh = make_mesh({SAMPLES_AXIS: 8})
+    rng = np.random.default_rng(10)
+    n, n_pad = 21, 24
+    S = rng.integers(0, 30, size=(n, n)).astype(np.float32)
+    S = S + S.T
+    S_pad = np.zeros((n_pad, n_pad), dtype=np.float32)
+    S_pad[:n, :n] = S
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    Sd = jax.device_put(jnp.asarray(S_pad), NamedSharding(mesh, P(SAMPLES_AXIS, None)))
+    out = np.asarray(jax.device_get(gower_center_sharded(Sd, mesh, n_true=n)))
+    np.testing.assert_allclose(out[:n, :n], np.asarray(gower_center(S)), atol=1e-3)
+    np.testing.assert_array_equal(out[n:], 0)
+    np.testing.assert_array_equal(out[:, n:], 0)
+
+
+def test_subspace_sharded_matches_dense_padded():
+    from spark_examples_tpu.ops.pca import (
+        principal_components_subspace,
+        principal_components_subspace_sharded,
+    )
+
+    mesh = make_mesh({SAMPLES_AXIS: 8})
+    rng = np.random.default_rng(11)
+    n, n_pad = 21, 24
+    rows = _random_rows(rng, 600, n)
+    S = gramian_reference(rows).astype(np.float32)
+    B = np.asarray(gower_center(S))
+    B_pad = np.zeros((n_pad, n_pad), dtype=np.float32)
+    B_pad[:n, :n] = B
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    Bd = jax.device_put(jnp.asarray(B_pad), NamedSharding(mesh, P(SAMPLES_AXIS, None)))
+    c_sharded, e_sharded = principal_components_subspace_sharded(
+        Bd, mesh, 2, n_true=n
+    )
+    c_sharded = np.asarray(jax.device_get(c_sharded))
+    c_dense, e_dense = principal_components_subspace(jnp.asarray(B), 2)
+    c_dense = np.asarray(jax.device_get(c_dense))
+    np.testing.assert_array_equal(c_sharded[n:], 0)
+    np.testing.assert_allclose(
+        np.asarray(e_sharded), np.asarray(e_dense), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        _align_signs(c_dense, c_sharded[:n]), c_dense, atol=1e-3
+    )
