@@ -1,0 +1,153 @@
+"""Composable public API: the PCoA pipeline as library functions.
+
+Mirrors the reference's Python decomposition
+(``src/main/python/variants_pca.py:19-152``) — ``prepare_call_data`` →
+``calculate_similarity_matrix`` → ``center_matrix`` → ``perform_pca`` — with
+the PySpark/py4j machinery replaced by jit-composable device stages. The
+full flag-driven driver remains available as :func:`pca` (the counterpart of
+``variants_pca.py:pca``, ``:154-201``).
+
+Example (synthetic cohort, BRCA1 region)::
+
+    >>> from spark_examples_tpu import api
+    >>> from spark_examples_tpu.sharding.contig import Contig
+    >>> from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource
+    >>> source = SyntheticGenomicsSource(num_samples=12, seed=5)
+    >>> callsets = source.search_callsets(["vs"])
+    >>> id_to_index = {c["id"]: i for i, c in enumerate(callsets)}
+    >>> variants = (
+    ...     record
+    ...     for record in source.client().search_variants(
+    ...         {"variantSetIds": ["vs"], "referenceName": "17",
+    ...          "start": 41196311, "end": 41216311}
+    ...     )
+    ... )
+    >>> calls = api.prepare_call_data(variants, id_to_index)
+    >>> S = api.calculate_similarity_matrix(calls, len(id_to_index))
+    >>> B = api.center_matrix(S)
+    >>> components = api.perform_pca(B, num_pc=2)
+    >>> components.shape
+    (12, 2)
+
+Each stage accepts and returns device arrays where possible, so stages fuse
+under an enclosing ``jax.jit`` and nothing round-trips through the host
+until :func:`perform_pca` returns the (N, num_pc) result.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Iterator, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from spark_examples_tpu.ops.centering import gower_center
+from spark_examples_tpu.ops.gramian import GramianAccumulator
+from spark_examples_tpu.ops.pca import principal_components_subspace
+
+
+def prepare_call_data(
+    variants: Iterable[Mapping],
+    id_to_index: Dict[str, int],
+    use_names: bool = True,
+) -> Iterator[List[int]]:
+    """Wire variant records → per-variant lists of varying column indices.
+
+    The counterpart of ``variants_pca.py:prepare_call_data`` (``:19-52``):
+    keep calls with any non-zero genotype, drop empty rows, map callset
+    names (or ids, ``use_names=False``) to matrix columns.
+    """
+    key = "callSetName" if use_names else "callSetId"
+    for record in variants:
+        calls = record.get("calls", []) if isinstance(record, Mapping) else [
+            {
+                "callSetName": c.callset_name,
+                "callSetId": c.callset_id,
+                "genotype": c.genotype,
+            }
+            for c in (record.calls or [])
+        ]
+        row = [
+            id_to_index[c[key]]
+            for c in calls
+            # Variation means a strictly positive allele (Call.has_variation,
+            # ``VariantsPca.scala:67``) — no-call encodings like -1 don't count.
+            if any(g > 0 for g in c["genotype"]) and c[key] in id_to_index
+        ]
+        if row:
+            yield row
+
+
+def calculate_similarity_matrix(
+    call_rows: Iterable[Sequence[int]],
+    matrix_size: int,
+    block_size: int = 1024,
+    mesh=None,
+    exact_int: bool = False,
+):
+    """Per-variant index rows → similarity counts ``G = XᵀX`` on device.
+
+    The counterpart of ``variants_pca.py:calculate_similarity_matrix``
+    (``:54-82``), with the per-partition NumPy Gramian + ``reduceByKey``
+    replaced by blockwise MXU accumulation (``ops/gramian.py``). Returns the
+    device-resident (N, N) matrix.
+    """
+    acc = GramianAccumulator(
+        matrix_size, mesh=mesh, block_size=block_size, exact_int=exact_int
+    )
+    staging: List[Sequence[int]] = []
+
+    def flush():
+        if not staging:
+            return
+        rows = np.zeros((len(staging), matrix_size), dtype=np.uint8)
+        for i, row in enumerate(staging):
+            rows[i, list(row)] = 1
+        acc.add_rows(rows)
+        staging.clear()
+
+    for row in call_rows:
+        staging.append(row)
+        if len(staging) >= block_size:
+            flush()
+    flush()
+    return acc.finalize_device()
+
+
+def center_matrix(similarity):
+    """Gower double-centering on device, the counterpart of
+    ``variants_pca.py:center_matrix`` (``:84-121``) — the row-sums collect,
+    broadcast, and per-row centering collapse into one fused kernel
+    (``ops/centering.py``)."""
+    import jax.numpy as jnp
+
+    return gower_center(jnp.asarray(similarity, dtype=jnp.float32))
+
+
+def perform_pca(centered, num_pc: int = 2) -> np.ndarray:
+    """Top principal components of the centered similarity matrix, the
+    counterpart of ``variants_pca.py:perform_pca`` (``:123-152``): MLlib's
+    ``RowMatrix.computePrincipalComponents`` becomes on-device subspace
+    iteration (``ops/pca.py``); only the (N, num_pc) result lands on host.
+    """
+    import jax
+
+    components, _ = principal_components_subspace(centered, num_pc)
+    return np.asarray(jax.device_get(components), dtype=np.float64)
+
+
+def pca(argv: Optional[Sequence[str]] = None) -> List[str]:
+    """The full flag-driven pipeline (``variants_pca.py:pca``, ``:154-201``):
+    parses the reference's flag grammar, runs the driver end to end, returns
+    the emitted TSV lines."""
+    from spark_examples_tpu.pipeline.pca_driver import run
+
+    return run(list(argv) if argv is not None else [])
+
+
+__all__ = [
+    "prepare_call_data",
+    "calculate_similarity_matrix",
+    "center_matrix",
+    "perform_pca",
+    "pca",
+]

@@ -84,10 +84,17 @@ def principal_components_subspace(
         return Q
 
     V = jax.lax.fori_loop(0, iterations, body, V)
-    T = V.T @ (B @ V)
-    evals, W = jnp.linalg.eigh((T + T.T) * 0.5)
+    return _rayleigh_ritz(V, B @ V, num_pc)
+
+
+def _rayleigh_ritz(V, W, num_pc: int):
+    """Rayleigh–Ritz extraction shared by the dense and sharded solvers:
+    project (T = VᵀW where W = BV), eigh the small k×k, order by |λ|, and fix
+    the deterministic sign convention (largest-|component| entry positive)."""
+    T = V.T @ W
+    evals, Wk = jnp.linalg.eigh((T + T.T) * 0.5)
     order = jnp.argsort(-jnp.abs(evals))[:num_pc]
-    top = V @ W[:, order]
+    top = V @ Wk[:, order]
     idx = jnp.argmax(jnp.abs(top), axis=0)
     signs = jnp.sign(top[idx, jnp.arange(num_pc)])
     signs = jnp.where(signs == 0, 1.0, signs)
@@ -115,7 +122,7 @@ def principal_components_subspace_sharded(
     nothing and the returned components simply carry zero rows for padding.
     """
     from jax import shard_map
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from spark_examples_tpu.parallel.mesh import SAMPLES_AXIS
 
@@ -138,15 +145,7 @@ def principal_components_subspace_sharded(
 
         V, _ = jnp.linalg.qr(V)
         V = jax.lax.fori_loop(0, iterations, body, V)
-        W = gathered_bv(V)
-        T = V.T @ W
-        evals, Wk = jnp.linalg.eigh((T + T.T) * 0.5)
-        order = jnp.argsort(-jnp.abs(evals))[:num_pc]
-        top = V @ Wk[:, order]
-        idx = jnp.argmax(jnp.abs(top), axis=0)
-        signs = jnp.sign(top[idx, jnp.arange(num_pc)])
-        signs = jnp.where(signs == 0, 1.0, signs)
-        return top * signs, evals[order]
+        return _rayleigh_ritz(V, gathered_bv(V), num_pc)
 
     # check_vma=False: the iterate alternates device-varying (B_local @ V)
     # and replicated (all_gather → identical QR on every device) forms, which

@@ -71,6 +71,25 @@ def extract_call_info(variant: Variant, mapping: Dict[str, int]) -> List[CallDat
     ]
 
 
+def _samples_sharded_mesh(similarity):
+    """The mesh of a samples-axis row-sharded similarity matrix, or ``None``.
+
+    Shardedness travels WITH the matrix (its ``NamedSharding``), not via
+    driver state: ``compute_pca`` routes to the sharded centering/eigensolve
+    exactly when the rows are actually partitioned over ``samples``.
+    """
+    sharding = getattr(similarity, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if (
+        spec is not None
+        and len(spec) > 0
+        and spec[0] == SAMPLES_AXIS
+        and sharding.mesh.shape.get(SAMPLES_AXIS, 1) > 1
+    ):
+        return sharding.mesh
+    return None
+
+
 def make_source(conf: PcaConf) -> GenomicsSource:
     if conf.source == "synthetic":
         return SyntheticGenomicsSource(num_samples=conf.num_samples, seed=conf.seed)
@@ -265,12 +284,10 @@ class VariantsPcaDriver:
             return self._host_similarity(calls)
         mesh = self._make_mesh()
         exact = getattr(self.conf, "exact_similarity", False)
-        self._similarity_sharded_mesh = None
         if self._resolve_sharded(sharded, mesh):
             acc: object = ShardedGramianAccumulator(
                 n, mesh, block_size=self.conf.block_size, exact_int=exact
             )
-            self._similarity_sharded_mesh = mesh
         else:
             acc = GramianAccumulator(
                 n, mesh, block_size=self.conf.block_size, exact_int=exact
@@ -325,12 +342,10 @@ class VariantsPcaDriver:
             return matrix.astype(np.float64)
         mesh = self._make_mesh()
         exact = getattr(self.conf, "exact_similarity", False)
-        self._similarity_sharded_mesh = None
         if self._resolve_sharded(sharded, mesh):
             acc: object = ShardedGramianAccumulator(
                 n, mesh, block_size=self.conf.block_size, exact_int=exact
             )
-            self._similarity_sharded_mesh = mesh
         else:
             acc = GramianAccumulator(
                 n, mesh, block_size=self.conf.block_size, exact_int=exact
@@ -358,7 +373,6 @@ class VariantsPcaDriver:
 
         source: SyntheticGenomicsSource = self.source  # type: ignore[assignment]
         conf = self.conf
-        self._similarity_sharded_mesh = None  # this path is dense-only
         acc = DeviceGenGramianAccumulator(
             num_samples=source.num_samples,
             vs_keys=[
@@ -436,14 +450,14 @@ class VariantsPcaDriver:
         import jax.numpy as jnp
 
         n = len(self.indexes)
-        sharded_mesh = getattr(self, "_similarity_sharded_mesh", None)
+        sharded_mesh = _samples_sharded_mesh(similarity)
         if self.conf.pca_backend == "host":
             similarity = np.asarray(similarity)
             nonzero = int((similarity.sum(axis=1) > 0).sum())
             print(f"Non zero rows in matrix: {nonzero} / {n}.")
             centered = self._host_center(similarity)
             components, _ = mllib_reference_pca(centered, self.conf.num_pc)
-        elif sharded_mesh is not None and hasattr(similarity, "sharding"):
+        elif sharded_mesh is not None:
             # Sharded strategy end to end: the (padded) Gramian stays
             # row-tile-sharded through centering AND the eigensolve — no
             # device ever holds the full N×N (the large-N completion of

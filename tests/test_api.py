@@ -1,0 +1,69 @@
+"""Public composable API (the variants_pca.py:19-152 decomposition)."""
+
+import numpy as np
+
+from spark_examples_tpu import api
+from spark_examples_tpu.config import PcaConf
+from spark_examples_tpu.pipeline.pca_driver import VariantsPcaDriver
+from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource
+
+
+def _request(start, end):
+    return {
+        "variantSetIds": ["vs"],
+        "referenceName": "17",
+        "start": start,
+        "end": end,
+    }
+
+
+def test_api_doctest_example():
+    import doctest
+
+    results = doctest.testmod(api)
+    assert results.failed == 0
+
+
+def test_api_stages_match_driver():
+    """prepare → similarity → center → pca equals the driver pipeline."""
+    source = SyntheticGenomicsSource(num_samples=15, seed=9)
+    callsets = source.search_callsets(["vs"])
+    id_to_index = {c["id"]: i for i, c in enumerate(callsets)}
+
+    variants = list(source.client().search_variants(_request(0, 30000)))
+    calls = list(
+        api.prepare_call_data(iter(variants), id_to_index, use_names=False)
+    )
+    assert calls
+    S = api.calculate_similarity_matrix(iter(calls), 15, block_size=32)
+    B = api.center_matrix(S)
+    components = api.perform_pca(B, num_pc=2)
+    assert components.shape == (15, 2)
+
+    conf = PcaConf()
+    conf.references = "17:0:30000"
+    conf.variant_set_id = ["vs"]
+    conf.num_samples = 15
+    conf.seed = 9
+    conf.block_size = 32
+    driver = VariantsPcaDriver(conf, source)
+    S_driver = driver.get_similarity_matrix(driver.iter_calls(driver.get_data()))
+    np.testing.assert_array_equal(np.asarray(S), np.asarray(S_driver))
+    result = driver.compute_pca(S_driver)
+    driver_components = np.array([pcs for _, pcs in result])
+    signs = np.sign((components * driver_components).sum(axis=0))
+    signs[signs == 0] = 1
+    np.testing.assert_allclose(components, driver_components * signs, atol=5e-3)
+
+
+def test_api_pca_entrypoint():
+    lines = api.pca(
+        [
+            "--references", "17:0:20000",
+            "--variant-set-id", "vs",
+            "--num-samples", "10",
+            "--seed", "3",
+            "--block-size", "32",
+        ]
+    )
+    assert len(lines) == 10
