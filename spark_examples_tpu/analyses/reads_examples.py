@@ -36,7 +36,11 @@ from spark_examples_tpu.sharding.partitioners import (
 )
 from spark_examples_tpu.sources.base import GenomicsSource
 
-_MAX_READ_LENGTH = 256
+def _pad_read_length(max_len: int) -> int:
+    """Round a shard's max read length up to a multiple of 64: the scatter
+    kernels take it as a static shape, so bucketing bounds recompiles while
+    never truncating long reads (reads of any length are fully counted)."""
+    return max(64, -(-int(max_len) // 64) * 64)
 
 
 def _write_part_file(out_dir: str, lines: Sequence[str]) -> None:
@@ -144,39 +148,43 @@ def run_example3(
         ),
     )
     lines: List[str] = []
-    carry = np.zeros(_MAX_READ_LENGTH, dtype=np.int64)
+    carry = np.zeros(0, dtype=np.int64)
     carry_start = None
     for part, shard in dataset.iter_shards():
-        span = part.end - part.start
-        window = int(span + _MAX_READ_LENGTH)
-        counts = np.zeros(window, dtype=np.int64)
+        span = int(part.end - part.start)
+        positions = lengths = None
+        read_pad = 64
         if shard:
             positions, lengths = _shard_reads_arrays(shard)
+            read_pad = _pad_read_length(int(lengths.max()))
+        # The window covers the shard span plus the longest read's overhang
+        # (and any carry from the previous shard) — no truncation cap.
+        overhang = carry_start + len(carry) - part.start if carry_start is not None else 0
+        window = max(span + read_pad, int(overhang))
+        counts = np.zeros(window, dtype=np.int64)
+        if shard:
             counts += np.asarray(
                 depth_counts(
                     jnp.asarray(positions),
                     jnp.asarray(lengths),
                     jnp.int32(part.start),
                     window,
-                    _MAX_READ_LENGTH,
+                    read_pad,
                 ),
                 dtype=np.int64,
             )
-        if carry_start is not None:
-            offset = carry_start - part.start
-            for i, c in enumerate(carry):
-                j = offset + i
-                if 0 <= j < window:
-                    counts[j] += c
-        for i in range(int(span)):
-            if counts[i] > 0:
-                lines.append(f"({part.start + i},{counts[i]})")
+        if carry_start is not None and len(carry):
+            off = carry_start - part.start
+            lo, hi = max(0, off), min(window, off + len(carry))
+            if hi > lo:
+                counts[lo:hi] += carry[lo - off : hi - off]
+        covered = np.nonzero(counts[:span] > 0)[0]
+        lines.extend(f"({part.start + i},{counts[i]})" for i in covered)
         carry = counts[span:].copy()
         carry_start = part.end
     if carry_start is not None:
-        for i, c in enumerate(carry):
-            if c > 0:
-                lines.append(f"({carry_start + i},{c})")
+        for i in np.nonzero(carry > 0)[0]:
+            lines.append(f"({carry_start + i},{carry[i]})")
     _write_part_file(os.path.join(out_path, f"coverage_{sequence}"), lines)
     return lines
 
@@ -195,13 +203,19 @@ def _base_frequencies(
     with boundary carry."""
     dataset = ReadsDataset(source, readsets, partitioner)
     result: Dict[int, np.ndarray] = {}
-    carry = np.zeros((_MAX_READ_LENGTH, len(BASES)), dtype=np.int64)
+    carry = np.zeros((0, len(BASES)), dtype=np.int64)
     carry_start = None
     for part, shard in dataset.iter_shards():
         span = int(part.end - part.start)
-        window = span + _MAX_READ_LENGTH
-        counts = np.zeros((window, len(BASES)), dtype=np.int64)
         kept = [r for _, r in shard if r.mapping_quality >= min_mapping_quality]
+        read_pad = 64
+        if kept:
+            read_pad = _pad_read_length(
+                max(len(r.aligned_sequence) for r in kept)
+            )
+        overhang = carry_start + len(carry) - part.start if carry_start is not None else 0
+        window = max(span + read_pad, int(overhang))
+        counts = np.zeros((window, len(BASES)), dtype=np.int64)
         if kept:
             L = max(len(r.aligned_sequence) for r in kept)
             positions = np.asarray([r.position for r in kept], dtype=np.int32)
@@ -226,21 +240,19 @@ def _base_frequencies(
                 ),
                 dtype=np.int64,
             )
-        if carry_start is not None:
-            offset = carry_start - part.start
-            for i in range(_MAX_READ_LENGTH):
-                j = offset + i
-                if 0 <= j < window:
-                    counts[j] += carry[i]
-        for i in range(span):
-            if counts[i].sum() > 0:
-                result[part.start + i] = counts[i].copy()
+        if carry_start is not None and len(carry):
+            off = carry_start - part.start
+            lo, hi = max(0, off), min(window, off + len(carry))
+            if hi > lo:
+                counts[lo:hi] += carry[lo - off : hi - off]
+        covered = np.nonzero(counts[:span].sum(axis=1) > 0)[0]
+        for i in covered:
+            result[part.start + int(i)] = counts[i].copy()
         carry = counts[span:].copy()
         carry_start = part.end
     if carry_start is not None:
-        for i in range(_MAX_READ_LENGTH):
-            if carry[i].sum() > 0:
-                result[carry_start + i] = carry[i].copy()
+        for i in np.nonzero(carry.sum(axis=1) > 0)[0]:
+            result[carry_start + int(i)] = carry[i].copy()
     return result
 
 

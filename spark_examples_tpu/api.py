@@ -41,7 +41,10 @@ from typing import Dict, Iterable, Iterator, List, Mapping, Optional, Sequence
 import numpy as np
 
 from spark_examples_tpu.ops.centering import gower_center
-from spark_examples_tpu.ops.gramian import GramianAccumulator
+from spark_examples_tpu.ops.gramian import (
+    GramianAccumulator,
+    accumulate_index_rows,
+)
 from spark_examples_tpu.ops.pca import principal_components_subspace
 
 
@@ -94,22 +97,7 @@ def calculate_similarity_matrix(
     acc = GramianAccumulator(
         matrix_size, mesh=mesh, block_size=block_size, exact_int=exact_int
     )
-    staging: List[Sequence[int]] = []
-
-    def flush():
-        if not staging:
-            return
-        rows = np.zeros((len(staging), matrix_size), dtype=np.uint8)
-        for i, row in enumerate(staging):
-            rows[i, list(row)] = 1
-        acc.add_rows(rows)
-        staging.clear()
-
-    for row in call_rows:
-        staging.append(row)
-        if len(staging) >= block_size:
-            flush()
-    flush()
+    accumulate_index_rows(acc, call_rows, matrix_size, block_size)
     return acc.finalize_device()
 
 

@@ -151,6 +151,7 @@ class PcaConf(GenomicsConf):
     exact_similarity: bool = False
     similarity_strategy: str = "auto"
     num_workers: int = 8
+    profile_dir: Optional[str] = None
 
     EXCLUDE_XY = SexChromosomeFilter.EXCLUDE_XY
 
@@ -235,6 +236,15 @@ class PcaConf(GenomicsConf):
             type=int,
             default=8,
             help="Host threads for parallel shard streaming.",
+        )
+        parser.add_argument(
+            "--profile-dir",
+            default=None,
+            help=(
+                "Write a jax.profiler device trace (TensorBoard-loadable) "
+                "here and print per-stage wall-clock timings — the Spark-UI "
+                "stand-in (utils/tracing.py)."
+            ),
         )
         ns = parser.parse_args(list(argv))
         return cls._from_namespace(ns)

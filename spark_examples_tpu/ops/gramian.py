@@ -422,6 +422,42 @@ class ShardedGramianAccumulator:
         )(self.G)
 
 
+def accumulate_index_rows(
+    acc,
+    call_rows,
+    num_columns: int,
+    block_size: int,
+    accumulate_duplicates: bool = False,
+) -> None:
+    """Stage per-variant column-index rows into dense uint8 blocks and feed
+    an accumulator — the one shared row-staging loop (driver and public API).
+
+    ``accumulate_duplicates`` switches to unbuffered accumulation so a column
+    appearing k times contributes k² per entry (the reference's pair-loop
+    multiplicity, ``VariantsPca.scala:224-229`` — needed when a variant set
+    is joined with itself); the default fast path sets membership bits.
+    """
+    staging: list = []
+
+    def flush():
+        if not staging:
+            return
+        rows = np.zeros((len(staging), num_columns), dtype=np.uint8)
+        for i, row in enumerate(staging):
+            if accumulate_duplicates:
+                np.add.at(rows[i], np.asarray(list(row), dtype=np.int64), 1)
+            else:
+                rows[i, list(row)] = 1
+        acc.add_rows(rows)
+        staging.clear()
+
+    for row in call_rows:
+        staging.append(row)
+        if len(staging) >= block_size:
+            flush()
+    flush()
+
+
 def gramian_reference(rows: np.ndarray) -> np.ndarray:
     """Host NumPy oracle: the pair-counting semantics of
     ``VariantsPca.scala:224-229`` (for each variant, +1 for every ordered

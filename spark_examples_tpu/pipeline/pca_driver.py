@@ -32,7 +32,11 @@ import numpy as np
 from spark_examples_tpu.config import PcaConf
 from spark_examples_tpu.models.variant import Variant
 from spark_examples_tpu.ops.centering import gower_center
-from spark_examples_tpu.ops.gramian import GramianAccumulator, ShardedGramianAccumulator
+from spark_examples_tpu.ops.gramian import (
+    GramianAccumulator,
+    ShardedGramianAccumulator,
+    accumulate_index_rows,
+)
 from spark_examples_tpu.ops.pca import (
     mllib_reference_pca,
     principal_components,
@@ -292,32 +296,18 @@ class VariantsPcaDriver:
             acc = GramianAccumulator(
                 n, mesh, block_size=self.conf.block_size, exact_int=exact
             )
-        staging: List[List[int]] = []
         # Duplicate callset indices only arise when a variant set is joined
         # with itself (duplicate ids collapse the column index); only then is
         # the slower unbuffered accumulation needed to reproduce the
-        # reference's pair-loop multiplicity, where k duplicates contribute
-        # k² per entry (``VariantsPca.scala:224-229``).
+        # reference's pair-loop multiplicity (``VariantsPca.scala:224-229``).
         ids = self.conf.variant_set_id
-        dup_sets = len(set(ids)) != len(ids)
-
-        def flush():
-            if not staging:
-                return
-            rows = np.zeros((len(staging), n), dtype=np.uint8)
-            for i, row in enumerate(staging):
-                if dup_sets:
-                    np.add.at(rows[i], np.asarray(row, dtype=np.int64), 1)
-                else:
-                    rows[i, row] = 1
-            acc.add_rows(rows)
-            staging.clear()
-
-        for row in calls:
-            staging.append(row)
-            if len(staging) >= self.conf.block_size:
-                flush()
-        flush()
+        accumulate_index_rows(
+            acc,
+            calls,
+            n,
+            self.conf.block_size,
+            accumulate_duplicates=len(set(ids)) != len(ids),
+        )
         # Stay on device either way: centering/PCA consume this directly;
         # fetching the N×N matrix to host is pointless and degrades
         # remote-attached backends (see ops/gramian.py). The sharded result
@@ -588,10 +578,32 @@ def run(argv: Sequence[str]) -> List[str]:
             "device (distinct sets) or --ingest wire"
         )
     driver = VariantsPcaDriver(conf)
+    from spark_examples_tpu.utils.tracing import StageTimes, device_trace
+
+    times = StageTimes()
+    with device_trace(conf.profile_dir):
+        with times.stage("ingest+similarity"):
+            similarity = _similarity_stage(conf, driver, use_device, use_packed)
+        # compute_pca ends in the synchronous components fetch, so its stage
+        # time is honest even on asynchronous remote-attached backends.
+        with times.stage("center+pca"):
+            result = driver.compute_pca(similarity)
+    lines = driver.emit_result(result)
+    driver.flush_device_ingest_stats()
+    driver.report_io_stats()
+    if conf.profile_dir:
+        print(str(times))
+        print(f"Device trace written to {conf.profile_dir}.")
+    driver.stop()
+    return lines
+
+
+def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
+    """The ingest+similarity stage of :func:`run`, one of the three paths."""
     if use_device:
         contigs = conf.get_contigs(driver.source, conf.variant_set_id)
-        similarity = driver.get_similarity_device_gen(contigs)
-    elif use_packed:
+        return driver.get_similarity_device_gen(contigs)
+    if use_packed:
         # Packed fast path: synthetic blocks straight onto the device.
         source: SyntheticGenomicsSource = driver.source  # type: ignore[assignment]
         contigs = conf.get_contigs(source, conf.variant_set_id)
@@ -621,17 +633,10 @@ def run(argv: Sequence[str]) -> List[str]:
                 for block in blocks:
                     yield block["has_variation"]
 
-        similarity = driver.get_similarity_rows(block_stream())
-    else:
-        data = driver.get_data()
-        calls = driver.iter_calls(data)
-        similarity = driver.get_similarity_matrix(calls)
-    result = driver.compute_pca(similarity)
-    lines = driver.emit_result(result)
-    driver.flush_device_ingest_stats()
-    driver.report_io_stats()
-    driver.stop()
-    return lines
+        return driver.get_similarity_rows(block_stream())
+    data = driver.get_data()
+    calls = driver.iter_calls(data)
+    return driver.get_similarity_matrix(calls)
 
 
 __all__ = ["CallData", "VariantsPcaDriver", "extract_call_info", "make_source", "run"]

@@ -553,8 +553,18 @@ class SyntheticClient(GenomicsClient):
         contig_name = request["referenceName"]
         start, end = int(request["start"]), int(request["end"])
         emitted = 0
+        # STRICT: only reads STARTING in [start, end) — each read belongs to
+        # exactly one shard. OVERLAPS: also reads starting before the range
+        # whose alignment extends into it (the API's overlap semantics).
+        scan_start = (
+            start
+            if boundary is ShardBoundary.STRICT
+            else max(0, start - src.read_length)
+        )
         for read_group_set_id in request["readGroupSetIds"]:
-            for pos, tile in src.read_starts(start, end):
+            for pos, tile in src.read_starts(scan_start, end):
+                if boundary is ShardBoundary.OVERLAPS and pos + src.read_length <= start:
+                    continue
                 if emitted % page_size == 0:
                     self.counters.initialized_requests += 1
                 emitted += 1

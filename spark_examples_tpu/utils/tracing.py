@@ -1,0 +1,74 @@
+"""Tracing / profiling: the Spark-web-UI stand-in (SURVEY.md §5).
+
+The reference delegated observability to the Spark UI (stage timelines on
+ports 8080/4040, ``README.md:148-178``) and log4j. The TPU equivalents:
+
+- :class:`StageTimes` — coarse per-stage wall-clock accounting for the
+  driver pipeline (the moral equivalent of the Spark stage timeline),
+  printed after the I/O stats report;
+- :func:`device_trace` — a ``jax.profiler`` trace context producing a
+  TensorBoard-loadable profile of the XLA ops (the fine-grained equivalent
+  of drilling into a Spark stage), enabled by ``--profile-dir``.
+
+Honest-timing note (remote-attached backends): dispatch is asynchronous and
+``block_until_ready`` can ACK before execution completes, so a stage's wall
+time is only meaningful when the stage ends in a synchronous fetch (the
+driver's PCA stage does) or when ``sync=`` passes a device value to fetch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class StageTimes:
+    """Ordered per-stage wall-clock accounting."""
+
+    def __init__(self) -> None:
+        self.stages: List[Tuple[str, float]] = []
+
+    @contextlib.contextmanager
+    def stage(self, name: str, sync: Optional[Callable[[], object]] = None):
+        """Time a stage; ``sync`` (if given) is called before closing the
+        measurement to force outstanding device work to completion — pass a
+        tiny fetch, e.g. ``lambda: jax.device_get(counter)``."""
+        start = time.perf_counter()
+        try:
+            yield self
+        finally:
+            if sync is not None:
+                sync()
+            self.stages.append((name, time.perf_counter() - start))
+
+    def as_dict(self) -> Dict[str, float]:
+        return dict(self.stages)
+
+    def __str__(self) -> str:
+        lines = ["Stage timings:", "-------------------------------"]
+        total = 0.0
+        for name, seconds in self.stages:
+            lines.append(f"{name}: {seconds:.3f} s")
+            total += seconds
+        lines.append(f"total: {total:.3f} s")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def device_trace(profile_dir: Optional[str]):
+    """``jax.profiler.trace`` when a directory is given, no-op otherwise.
+
+    The resulting trace loads in TensorBoard's profile plugin (or
+    ``xprof``) and shows per-op device timelines — ingest kernels, MXU
+    Gramian updates, collectives, and the eigensolve."""
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(profile_dir):
+        yield
+
+
+__all__ = ["StageTimes", "device_trace"]

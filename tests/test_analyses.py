@@ -1,5 +1,7 @@
 """The seven example analyses against naive host recomputations."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -166,3 +168,76 @@ def test_cli_dispatch(capsys, tmp_path):
     assert rc == 0
     out = capsys.readouterr().out
     assert "Matrix size: 8." in out
+
+
+def test_example3_depth_long_reads(conf):
+    """Reads longer than the old 256-bp cap are fully counted (no silent
+    truncation): depth from a 400-bp-read source matches the naive oracle."""
+    from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource
+
+    long_source = SyntheticGenomicsSource(
+        num_samples=4, seed=3, read_length=400, read_depth=2
+    )
+    region = (1_000, 6_000)
+    lines = reads_examples.run_example3(conf, long_source, region=region)
+    got = {}
+    for line in lines:
+        pos, depth = line.strip("()").split(",")
+        got[int(pos)] = int(depth)
+    max_pos = max(got)
+    naive = _naive_depth(
+        long_source, Examples.GOOGLE_EXAMPLE_READSET, "21", *region
+    )
+    naive = {p: d for p, d in naive.items() if p <= max_pos}
+    assert got == naive
+    # A 400-bp tiling really produces depths past position+256.
+    assert any(p - 1_000 > 256 for p in got)
+
+
+def test_reads_overlaps_boundary():
+    """OVERLAPS returns reads that start before the range but extend into
+    it; STRICT returns only reads starting inside (exactly-one-shard)."""
+    from spark_examples_tpu.sources.base import ShardBoundary
+    from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource
+
+    source = SyntheticGenomicsSource(num_samples=4, seed=3)
+    client = source.client()
+    request = {
+        "readGroupSetIds": ["rgs"],
+        "referenceName": "21",
+        "start": 5_000,
+        "end": 5_200,
+    }
+    strict = list(client.search_reads(request, ShardBoundary.STRICT))
+    overlaps = list(client.search_reads(request, ShardBoundary.OVERLAPS))
+    strict_ids = {r["id"] for r in strict}
+    overlap_ids = {r["id"] for r in overlaps}
+    assert strict_ids < overlap_ids  # strictly more reads under OVERLAPS
+    for r in overlaps:
+        pos = r["alignment"]["position"]["position"]
+        L = len(r["alignedSequence"])
+        assert pos + L > 5_000 and pos < 5_200  # genuinely overlapping
+    extra = overlap_ids - strict_ids
+    for r in overlaps:
+        if r["id"] in extra:
+            assert r["alignment"]["position"]["position"] < 5_000
+
+
+def test_profile_dir_stage_timings(tmp_path, capsys):
+    """--profile-dir writes a device trace and prints stage timings."""
+    from spark_examples_tpu.pipeline import pca_driver
+
+    prof = str(tmp_path / "prof")
+    pca_driver.run(
+        [
+            "--references", "17:0:10000",
+            "--variant-set-id", "vs",
+            "--num-samples", "8",
+            "--block-size", "32",
+            "--profile-dir", prof,
+        ]
+    )
+    out = capsys.readouterr().out
+    assert "Stage timings:" in out
+    assert "ingest+similarity:" in out and "center+pca:" in out
+    assert os.path.isdir(prof) and os.listdir(prof)
