@@ -208,16 +208,12 @@ def _base_frequencies(
     for part, shard in dataset.iter_shards():
         span = int(part.end - part.start)
         kept = [r for _, r in shard if r.mapping_quality >= min_mapping_quality]
-        read_pad = 64
-        if kept:
-            read_pad = _pad_read_length(
-                max(len(r.aligned_sequence) for r in kept)
-            )
+        L = max((len(r.aligned_sequence) for r in kept), default=0)
+        read_pad = _pad_read_length(L) if kept else 64
         overhang = carry_start + len(carry) - part.start if carry_start is not None else 0
         window = max(span + read_pad, int(overhang))
         counts = np.zeros((window, len(BASES)), dtype=np.int64)
         if kept:
-            L = max(len(r.aligned_sequence) for r in kept)
             positions = np.asarray([r.position for r in kept], dtype=np.int32)
             codes = np.full((len(kept), L), -1, dtype=np.int8)
             qual_ok = np.zeros((len(kept), L), dtype=bool)

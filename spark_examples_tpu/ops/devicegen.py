@@ -238,6 +238,58 @@ def _fused_update(
         return update
 
 
+@functools.lru_cache(maxsize=32)
+def _fused_update_mesh(
+    vs_keys: Tuple[int, ...],
+    pops_bytes: bytes,
+    site_key: int,
+    spacing: int,
+    ref_block_fraction: float,
+    min_af_micro: Optional[int],
+    block_size: int,
+    blocks_per_dispatch: int,
+    operand_name: str,
+    accum_name: str,
+    mesh,
+):
+    """The data-parallel (shard_map) wrapper of :func:`_fused_update`,
+    memoized on (config, mesh) so warmup and measured accumulators share one
+    traced/compiled program, like the single-slice path."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from spark_examples_tpu.parallel.mesh import DATA_AXIS
+
+    update = _fused_update(
+        vs_keys,
+        pops_bytes,
+        site_key,
+        spacing,
+        ref_block_fraction,
+        min_af_micro,
+        block_size,
+        blocks_per_dispatch,
+        operand_name,
+        accum_name,
+    )
+    g_spec = P(DATA_AXIS, None, None)
+    r_spec = P(DATA_AXIS, None)
+    s_spec = P(DATA_AXIS)
+
+    def per_slice(g, r, k, o, v):
+        g1, r1, k1 = update(g[0], r[0], k[0], o[0], v[0])
+        return g1[None], r1[None], k1[None]
+
+    return jax.jit(
+        shard_map(
+            per_slice,
+            mesh=mesh,
+            in_specs=(g_spec, r_spec, s_spec, s_spec, s_spec),
+            out_specs=(g_spec, r_spec, s_spec),
+        )
+    )
+
+
 class DeviceGenGramianAccumulator:
     """Fully fused on-device ingest+similarity for the synthetic source.
 
@@ -264,8 +316,10 @@ class DeviceGenGramianAccumulator:
         block_size: int = 2048,
         blocks_per_dispatch: int = 32,
         exact_int: bool = True,
+        mesh=None,
     ):
         from spark_examples_tpu.ops.gramian import _operand_dtypes
+        from spark_examples_tpu.parallel.mesh import DATA_AXIS
 
         self.num_samples = int(num_samples)
         self.n_sets = len(vs_keys)
@@ -274,22 +328,17 @@ class DeviceGenGramianAccumulator:
         self.blocks_per_dispatch = int(blocks_per_dispatch)
         self.sites_per_dispatch = self.block_size * self.blocks_per_dispatch
         self.spacing = int(spacing)
+        self.mesh = mesh
+        self.data_parallel = (
+            mesh.shape.get(DATA_AXIS, 1) if mesh is not None else 1
+        )
         # Shared dtype policy: int8→int32 when exact, bf16 on TPU / f32 on
         # CPU otherwise (the CPU thunk runtime lacks some bf16 dot shapes).
-        operand_dtype, accum_dtype = _operand_dtypes(exact_int)
+        operand_dtype, accum_dtype = _operand_dtypes(exact_int, mesh)
         self.accum_dtype = accum_dtype
         self.dispatches = 0
 
-        with jax.enable_x64(True):
-            self.G = jnp.zeros(
-                (self.total_columns, self.total_columns), accum_dtype
-            )
-            # Per-set counts of rows with variation in that set's columns —
-            # matches the wire path's per-dataset record accounting.
-            self.variant_rows = jnp.zeros((self.n_sets,), jnp.int64)
-            self.kept_sites = jnp.zeros((), jnp.int64)
-
-        self._update = _fused_update(
+        update_key = (
             tuple(int(k) for k in vs_keys),
             np.asarray(pops, dtype=np.int32).tobytes(),
             int(site_key),
@@ -302,14 +351,60 @@ class DeviceGenGramianAccumulator:
             np.dtype(accum_dtype).name,
         )
 
+        D = self.data_parallel
+        with jax.enable_x64(True):
+            if D == 1:
+                self.G = jnp.zeros(
+                    (self.total_columns, self.total_columns), accum_dtype
+                )
+                # Per-set counts of rows with variation in that set's columns
+                # — matches the wire path's per-dataset record accounting.
+                self.variant_rows = jnp.zeros((self.n_sets,), jnp.int64)
+                self.kept_sites = jnp.zeros((), jnp.int64)
+                self._update = _fused_update(*update_key)
+                self._scalar_sharding = None
+            else:
+                # Data-parallel ingest: each data slice generates and
+                # accumulates a DIFFERENT span of the site grid (its own
+                # (grid_offset, n_valid) pair) into its own replica of G —
+                # the Spark-executor analog; finalize is the one psum.
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                g_spec = P(DATA_AXIS, None, None)
+                r_spec = P(DATA_AXIS, None)
+                s_spec = P(DATA_AXIS)
+                self._scalar_sharding = NamedSharding(mesh, s_spec)
+                self.G = jax.device_put(
+                    np.zeros(
+                        (D, self.total_columns, self.total_columns),
+                        np.dtype(accum_dtype),
+                    ),
+                    NamedSharding(mesh, g_spec),
+                )
+                self.variant_rows = jax.device_put(
+                    np.zeros((D, self.n_sets), np.int64),
+                    NamedSharding(mesh, r_spec),
+                )
+                self.kept_sites = jax.device_put(
+                    np.zeros((D,), np.int64), NamedSharding(mesh, s_spec)
+                )
+                self._update = _fused_update_mesh(*update_key, mesh)
+
     def add_range(self, grid_offset: int, n_valid: int) -> None:
         """Dispatch one group covering grid indices
         ``[grid_offset, grid_offset + n_valid)`` (positions ``index ·
-        spacing``); indices past ``n_valid`` are padding."""
+        spacing``); indices past ``n_valid`` are padding. Single-slice form;
+        data-parallel accumulators use :meth:`add_ranges`."""
         if not 0 < n_valid <= self.sites_per_dispatch:
             raise ValueError(
                 f"n_valid must be in (0, {self.sites_per_dispatch}], got {n_valid}"
             )
+        if self.data_parallel > 1:
+            offsets = np.zeros(self.data_parallel, dtype=np.int64)
+            valids = np.zeros(self.data_parallel, dtype=np.int64)
+            offsets[0], valids[0] = grid_offset, n_valid
+            self.add_ranges(offsets, valids)
+            return
         with jax.enable_x64(True):
             self.G, self.variant_rows, self.kept_sites = self._update(
                 self.G,
@@ -320,12 +415,50 @@ class DeviceGenGramianAccumulator:
             )
         self.dispatches += 1
 
+    def add_ranges(self, grid_offsets: np.ndarray, n_valids: np.ndarray) -> None:
+        """Data-parallel dispatch: slice d processes grid indices
+        ``[grid_offsets[d], grid_offsets[d] + n_valids[d])`` (``n_valids[d]
+        == 0`` means an idle slice this round)."""
+        D = self.data_parallel
+        grid_offsets = np.asarray(grid_offsets, dtype=np.int64)
+        n_valids = np.asarray(n_valids, dtype=np.int64)
+        if grid_offsets.shape != (D,) or n_valids.shape != (D,):
+            raise ValueError(f"expected ({D},) offsets/valids")
+        if n_valids.max(initial=0) > self.sites_per_dispatch:
+            raise ValueError(
+                f"n_valid must be <= {self.sites_per_dispatch}"
+            )
+        with jax.enable_x64(True):
+            self.G, self.variant_rows, self.kept_sites = self._update(
+                self.G,
+                self.variant_rows,
+                self.kept_sites,
+                jax.device_put(grid_offsets, self._scalar_sharding),
+                jax.device_put(n_valids, self._scalar_sharding),
+            )
+        self.dispatches += 1
+
     def add_grid(self, first_index: int, last_index: int) -> None:
         """Dispatch all groups for a contiguous grid index range
-        ``[first_index, last_index)``."""
-        for off in range(first_index, last_index, self.sites_per_dispatch):
-            n_valid = min(self.sites_per_dispatch, last_index - off)
-            self.add_range(off, n_valid)
+        ``[first_index, last_index)``, round-robining groups over the data
+        axis when the accumulator is data-parallel."""
+        step = self.sites_per_dispatch
+        starts = list(range(first_index, last_index, step))
+        if self.data_parallel == 1:
+            for off in starts:
+                self.add_range(off, min(step, last_index - off))
+                if self.dispatches == 1:
+                    self.poke()
+            return
+        D = self.data_parallel
+        for i in range(0, len(starts), D):
+            batch = starts[i : i + D]
+            offsets = np.zeros(D, dtype=np.int64)
+            valids = np.zeros(D, dtype=np.int64)
+            for d, off in enumerate(batch):
+                offsets[d] = off
+                valids[d] = min(step, last_index - off)
+            self.add_ranges(offsets, valids)
             if self.dispatches == 1:
                 self.poke()
 
@@ -342,14 +475,19 @@ class DeviceGenGramianAccumulator:
             jax.device_get(self.kept_sites)
 
     def finalize_device(self) -> jax.Array:
-        """The accumulated Gramian, still on device (single data slice, so no
-        cross-device reduce is needed here; multi-slice accumulation reduces
-        via the mesh paths in ``ops/gramian.py``)."""
+        """The accumulated Gramian, still on device; for data-parallel
+        accumulators this is the one cross-slice reduce (the Spark
+        ``reduceByKey`` shuffle become a single ``psum`` over ICI,
+        ``VariantsPca.scala:230``)."""
+        if self.data_parallel > 1:
+            return jnp.sum(self.G, axis=0)
         return self.G
 
     def finalize(self) -> np.ndarray:
         with jax.enable_x64(True):
-            return np.asarray(jax.device_get(self.G)).astype(np.float64)
+            return np.asarray(jax.device_get(self.finalize_device())).astype(
+                np.float64
+            )
 
 
 __all__ = [

@@ -376,6 +376,7 @@ class VariantsPcaDriver:
             block_size=conf.block_size,
             blocks_per_dispatch=conf.blocks_per_dispatch,
             exact_int=True,
+            mesh=self._make_mesh(),
         )
 
         page_size = 1024  # synthetic wire path's variants page size
