@@ -86,6 +86,14 @@ def _build_base_parser(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
     parser.add_argument(
         "--seed", type=int, default=42, help="Synthetic-source base seed."
     )
+    # Multi-host initialization (jax.distributed) — the analog of pointing
+    # the reference at a Spark cluster master (GenomicsConf.scala:50-57).
+    # With these set, jax.devices() spans all hosts and the device mesh
+    # (and therefore data-parallel ingest + the finalize psum) runs
+    # multi-controller SPMD over ICI/DCN.
+    parser.add_argument("--coordinator-address", default=None)
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
     return parser
 
 
@@ -106,12 +114,26 @@ class GenomicsConf:
     source: str = "synthetic"
     num_samples: int = 2504
     seed: int = 42
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
 
     @classmethod
     def parse(cls, argv: Sequence[str]) -> "GenomicsConf":
         parser = _build_base_parser(argparse.ArgumentParser())
         ns = parser.parse_args(list(argv))
         return cls._from_namespace(ns)
+
+    def init_distributed(self) -> None:
+        """Initialize multi-host JAX when the cluster flags are set (no-op
+        otherwise) — call before any device use."""
+        from spark_examples_tpu.parallel.mesh import distributed_init
+
+        distributed_init(
+            coordinator_address=self.coordinator_address,
+            num_processes=self.num_processes,
+            process_id=self.process_id,
+        )
 
     @classmethod
     def _from_namespace(cls, ns: argparse.Namespace) -> "GenomicsConf":

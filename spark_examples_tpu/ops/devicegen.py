@@ -399,6 +399,8 @@ class DeviceGenGramianAccumulator:
             raise ValueError(
                 f"n_valid must be in (0, {self.sites_per_dispatch}], got {n_valid}"
             )
+        if grid_offset < 0:
+            raise ValueError("grid_offset must be non-negative")
         if self.data_parallel > 1:
             offsets = np.zeros(self.data_parallel, dtype=np.int64)
             valids = np.zeros(self.data_parallel, dtype=np.int64)
@@ -424,10 +426,14 @@ class DeviceGenGramianAccumulator:
         n_valids = np.asarray(n_valids, dtype=np.int64)
         if grid_offsets.shape != (D,) or n_valids.shape != (D,):
             raise ValueError(f"expected ({D},) offsets/valids")
-        if n_valids.max(initial=0) > self.sites_per_dispatch:
+        if n_valids.min(initial=0) < 0 or n_valids.max(initial=0) > self.sites_per_dispatch:
             raise ValueError(
-                f"n_valid must be <= {self.sites_per_dispatch}"
+                f"n_valids must be in [0, {self.sites_per_dispatch}]"
             )
+        if (grid_offsets < 0).any():
+            # Negative grid indices would wrap to garbage uint64 positions on
+            # device and silently corrupt the Gramian.
+            raise ValueError("grid_offsets must be non-negative")
         with jax.enable_x64(True):
             self.G, self.variant_rows, self.kept_sites = self._update(
                 self.G,

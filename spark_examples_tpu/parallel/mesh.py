@@ -65,8 +65,17 @@ def distributed_init(
     this wrapper only exists so the driver has one seam for it (the analog of
     ``conf.newSparkContext``, ``GenomicsConf.scala:50-57``).
     """
-    if coordinator_address is None and num_processes is None:
+    given = (coordinator_address, num_processes, process_id)
+    if all(v is None for v in given):
         return
+    if coordinator_address is None or num_processes is None:
+        # A partially-specified cluster launch must not silently fall back
+        # to a single-process run over 1/N of the fleet.
+        raise ValueError(
+            "multi-host init needs --coordinator-address and --num-processes "
+            f"(got coordinator_address={coordinator_address!r}, "
+            f"num_processes={num_processes!r}, process_id={process_id!r})"
+        )
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

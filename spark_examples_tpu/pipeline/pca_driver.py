@@ -379,24 +379,21 @@ class VariantsPcaDriver:
             mesh=self._make_mesh(),
         )
 
-        page_size = 1024  # synthetic wire path's variants page size
         self._device_gen_scanned = 0
         for contig in contigs:
             k0, k1 = source.site_grid_range(contig)
             if k1 > k0:
                 acc.add_grid(k0, k1)
-            scanned = k1 - k0
-            self._device_gen_scanned += scanned
+            self._device_gen_scanned += k1 - k0
             if self.io_stats is not None:
-                # Page accounting mirrors the wire path: one request per page
-                # of scanned sites, at least one per partition, each
-                # partition traversed once per variant set.
+                # Wire-equivalent accounting: per shard, per variant set
+                # (``SyntheticGenomicsSource.page_requests``).
                 shards = contig.get_shards(conf.bases_per_partition)
                 for _ in conf.variant_set_id:
                     for shard in shards:
                         self.io_stats.add_partition(shard.range)
-                self.io_stats.requests += max(
-                    max(1, len(shards)), -(-scanned // page_size)
+                self.io_stats.requests += source.page_requests(
+                    contig, conf.bases_per_partition
                 ) * len(conf.variant_set_id)
         self._device_gen_acc = acc
         return acc.finalize_device()
@@ -539,6 +536,7 @@ class VariantsPcaDriver:
 def run(argv: Sequence[str]) -> List[str]:
     """``VariantsPcaDriver.main`` (``VariantsPca.scala:47-59``)."""
     conf = PcaConf.parse(argv)
+    conf.init_distributed()
     synthetic_tpu = (
         conf.source == "synthetic"
         and not conf.input_path
@@ -624,6 +622,10 @@ def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
                 driver.io_stats.add_partition(part.range)
                 driver.io_stats.add_variants(
                     sum(len(b["positions"]) for b in blocks)
+                )
+                # Wire-equivalent page accounting (shared helper).
+                driver.io_stats.requests += source.page_requests(
+                    part.contig, conf.bases_per_partition
                 )
             return blocks
 

@@ -59,6 +59,11 @@ _S_GERMLINE_BASE = 204
 
 _BASES = "ACGT"
 
+#: SearchVariants page size of the synthetic wire path — request accounting
+#: in the packed/device ingest paths mirrors it (one request per page per
+#: shard, at least one per shard).
+VARIANTS_PAGE_SIZE = 1024
+
 
 def _af6(af: np.ndarray) -> np.ndarray:
     """Canonical 6-decimal AF, shared by every path.
@@ -295,6 +300,17 @@ class SyntheticGenomicsSource(GenomicsSource):
         """Sample → population index (``(N,)`` int64)."""
         return self._pops
 
+    def page_requests(self, contig: Contig, bases_per_partition: int) -> int:
+        """Wire-equivalent request count for scanning ``contig`` in
+        ``bases_per_partition`` windows: one request per
+        ``VARIANTS_PAGE_SIZE``-site page per shard, at least one per shard —
+        the same accounting ``SyntheticClient.search_variants`` performs."""
+        total = 0
+        for shard in contig.get_shards(bases_per_partition):
+            k0, k1 = self.site_grid_range(shard)
+            total += max(1, -(-(k1 - k0) // VARIANTS_PAGE_SIZE))
+        return total
+
     def site_grid_range(self, contig: Contig) -> Tuple[int, int]:
         """The contig's candidate-site grid as index range ``[k0, k1)`` with
         position ``k · variant_spacing`` — the only ingest metadata the
@@ -516,7 +532,7 @@ class SyntheticClient(GenomicsClient):
         self,
         request: Mapping,
         boundary: ShardBoundary = ShardBoundary.STRICT,
-        page_size: int = 1024,
+        page_size: int = VARIANTS_PAGE_SIZE,
     ) -> Iterator[Dict]:
         src = self.source
         variant_set_id = request["variantSetIds"][0]

@@ -131,3 +131,16 @@ def test_read_builder_no_mate():
     _, read = ReadBuilder.build(wire)
     assert read.mate_position is None
     assert read.cigar == ""
+
+
+def test_distributed_flags_parse_and_noop():
+    from spark_examples_tpu.config import GenomicsConf
+
+    conf = GenomicsConf.parse(
+        ["--coordinator-address", "host:1234", "--num-processes", "2",
+         "--process-id", "0"]
+    )
+    assert conf.coordinator_address == "host:1234"
+    assert conf.num_processes == 2 and conf.process_id == 0
+    # Default (no flags): init is a no-op.
+    GenomicsConf.parse([]).init_distributed()

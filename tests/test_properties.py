@@ -1,0 +1,131 @@
+"""Property-based invariants (hypothesis) for the determinism-critical core.
+
+These are the synthetic analog of race-detection (SURVEY.md §5): partition
+invariance and chunking invariance are what make results independent of
+shard layout, worker count, and device count.
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from spark_examples_tpu.ops.gramian import GramianAccumulator, gramian_reference
+from spark_examples_tpu.sharding.contig import Contig
+from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource
+from spark_examples_tpu.utils.af import af_filter_micro, af_passes
+
+_SOURCE = SyntheticGenomicsSource(num_samples=7, seed=13)
+
+
+@given(
+    start=st.integers(min_value=0, max_value=50_000),
+    width=st.integers(min_value=1, max_value=12_000),
+    split=st.floats(min_value=0.0, max_value=1.0),
+)
+@settings(max_examples=15, deadline=None)
+def test_genotype_blocks_partition_invariant(start, width, split):
+    """STRICT shard semantics: splitting a window anywhere yields exactly
+    the concatenation — byte-identical rows, no duplicates, no gaps."""
+    end = start + width
+    mid = start + int(width * split)
+
+    def rows(a, b):
+        blocks = list(_SOURCE.genotype_blocks("vs", Contig("9", a, b), block_size=64))
+        if not blocks:
+            return np.zeros((0, 7), np.uint8), np.zeros(0, np.int64)
+        return (
+            np.concatenate([x["has_variation"] for x in blocks]),
+            np.concatenate([x["positions"] for x in blocks]),
+        )
+
+    whole_rows, whole_pos = rows(start, end)
+    left_rows, left_pos = rows(start, mid)
+    right_rows, right_pos = rows(mid, end)
+    np.testing.assert_array_equal(
+        whole_pos, np.concatenate([left_pos, right_pos])
+    )
+    np.testing.assert_array_equal(
+        whole_rows, np.concatenate([left_rows, right_rows])
+    )
+
+
+@given(
+    start=st.integers(min_value=0, max_value=10**9),
+    width=st.integers(min_value=0, max_value=10**7),
+    shard=st.integers(min_value=1, max_value=10**6),
+)
+@settings(max_examples=50, deadline=None)
+def test_contig_shards_cover_exactly(start, width, shard):
+    """Windows tile [start, end) with no gaps or overlaps, in order."""
+    contig = Contig("x", start, start + width)
+    shards = contig.get_shards(shard)
+    pos = start
+    for s in shards:
+        assert s.start == pos
+        assert s.end > s.start
+        assert s.end - s.start <= shard
+        pos = s.end
+    assert pos == start + width or (width == 0 and not shards)
+
+
+@given(
+    start=st.integers(min_value=0, max_value=10**8),
+    width=st.integers(min_value=0, max_value=10**6),
+)
+@settings(max_examples=50, deadline=None)
+def test_site_grid_range_matches_site_positions(start, width):
+    k0, k1 = _SOURCE.site_grid_range(Contig("z", start, start + width))
+    grid = np.arange(k0, k1, dtype=np.int64) * _SOURCE.variant_spacing
+    np.testing.assert_array_equal(
+        grid, _SOURCE._site_positions(start, start + width)
+    )
+
+
+@given(
+    chunks=st.lists(
+        st.integers(min_value=1, max_value=40), min_size=1, max_size=6
+    ),
+    seed=st.integers(min_value=0, max_value=2**31),
+)
+@settings(max_examples=8, deadline=None)
+def test_gramian_chunking_invariant(chunks, seed):
+    """Feeding rows in any chunking yields the identical matrix."""
+    rng = np.random.default_rng(seed)
+    rows = (rng.random((sum(chunks), 9)) < 0.4).astype(np.uint8)
+    acc = GramianAccumulator(num_samples=9, block_size=16)
+    offset = 0
+    for c in chunks:
+        acc.add_rows(rows[offset : offset + c])
+        offset += c
+    np.testing.assert_array_equal(acc.finalize(), gramian_reference(rows))
+
+
+@given(
+    k=st.integers(min_value=0, max_value=2**32 - 1),
+    thr_micro=st.integers(min_value=0, max_value=1_000_000),
+)
+@settings(max_examples=200, deadline=None)
+def test_af_filter_wire_roundtrip_agrees(k, thr_micro):
+    """The canonical micro-unit AF rule survives the 6-decimal wire format:
+    filtering the parsed string equals filtering the Q32 dyadic value."""
+    threshold = thr_micro / 1e6
+    af = np.float64(k) * 2.0**-32
+    direct = bool(af_passes(af, threshold))
+    wire = float(f"{float(np.round(af * 1e6) / 1e6):.6f}")
+    via_wire = bool(af_passes(wire, threshold))
+    assert direct == via_wire
+    # floor over the EXACT binary value of the threshold: off-grid floats
+    # (e.g. float(1e-6) < 1/10⁶) may floor one below their decimal.
+    assert af_filter_micro(threshold) in (thr_micro, thr_micro - 1)
+
+
+@given(
+    name=st.from_regex(r"(chr)?(X|Y|MT|[0-9]{1,2})", fullmatch=True),
+)
+@settings(max_examples=100, deadline=None)
+def test_normalize_strips_chr_and_is_idempotent(name):
+    from spark_examples_tpu.models.variant import VariantsBuilder
+
+    normalized = VariantsBuilder.normalize(name)
+    if normalized is not None:
+        assert VariantsBuilder.normalize(normalized) == normalized
+        assert not normalized.startswith("chr")
