@@ -28,7 +28,6 @@ Prints exactly one JSON line (driver stage prints are redirected to stderr).
 import argparse
 import contextlib
 import json
-import os
 import sys
 import time
 
@@ -188,12 +187,10 @@ def main() -> None:
 
     import jax
 
-    # Persistent compilation cache outside the repo.
-    cache_dir = os.path.join(
-        os.path.expanduser("~/.cache"), "spark_examples_tpu", "jax_cache"
-    )
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from spark_examples_tpu.utils.cache import enable_persistent_compile_cache
+
+    # Persistent compilation cache outside the repo (shared with the CLI).
+    enable_persistent_compile_cache()
     device = jax.devices()[0]
 
     with contextlib.redirect_stdout(sys.stderr):

@@ -23,10 +23,12 @@ Package layout:
 - ``ops``       — device compute: gramian, centering, pca, read depth
 - ``pipeline``  — datasets, stats, PCA driver, checkpointing
 - ``analyses``  — the seven reference example analyses
-- ``utils``     — murmur3 hashing, TSV emit
+- ``utils``     — murmur3 hashing, AF-filter arithmetic, tracing
+- ``api``       — the composable public pipeline (prepare → similarity →
+  center → pca), mirroring ``src/main/python/variants_pca.py:19-152``
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from spark_examples_tpu.models.variant import Call, Variant, VariantKey, VariantsBuilder
 from spark_examples_tpu.models.read import Read, ReadKey, ReadBuilder

@@ -1,0 +1,28 @@
+"""Shared persistent XLA compile cache configuration.
+
+First TPU compile of a shape costs tens of seconds; the CLI and the
+benchmark reuse one cache location (outside the repo, so compile artifacts
+never enter git — a 152 MB lesson from round 1).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_persistent_compile_cache() -> None:
+    try:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(
+                os.path.expanduser("~/.cache"), "spark_examples_tpu", "jax_cache"
+            ),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # never block the caller on cache configuration
+
+
+__all__ = ["enable_persistent_compile_cache"]
