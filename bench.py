@@ -40,7 +40,8 @@ BLOCK = 2048
 BLOCKS_PER_DISPATCH = 64
 WARMUP_BASES = VARIANT_SPACING * BLOCK * BLOCKS_PER_DISPATCH  # one dispatch
 
-# The five BASELINE.json benchmark configs. Only whole-genome has a published
+# The BASELINE.json benchmark configs (plus a beyond-reference large-cohort
+# demo). Only whole-genome has a published
 # reference number (7200 s); the others report wall-clock with
 # vs_baseline=null.
 CONFIGS = {
@@ -66,6 +67,18 @@ CONFIGS = {
         "metric": "Platinum-style deep-call variantset PCoA wall-clock",
         "args": ["--all-references"],
         "sets": ["bench-platinum"],
+        "baseline_seconds": None,
+    },
+    "large-cohort": {
+        # Beyond-reference scale demo: a 25,000-sample cohort (10x 1KG) —
+        # the regime the reference's in-memory strategy guidance warns about
+        # (~50K samples ~ 20 GB, VariantsPca.scala:216-217) — still fits one
+        # chip's HBM with the dense int32 Gramian (2.5 GB) and runs the full
+        # pipeline on device.
+        "metric": "large-cohort (25,000 samples) chr17 PCoA wall-clock",
+        "args": ["--references", "17:0:81195210"],
+        "sets": ["bench-1kg"],
+        "num_samples": 25_000,
         "baseline_seconds": None,
     },
     "merged": {
@@ -104,15 +117,18 @@ def _run_config(name: str, device) -> dict:
 
     config = CONFIGS[name]
     n_sets = len(config["sets"])
+    n_samples = config.get("num_samples", N_SAMPLES)
     base_args = [
         "--variant-set-id", ",".join(config["sets"]),
         "--ingest", "device",
         "--block-size", str(BLOCK),
         "--blocks-per-dispatch", str(BLOCKS_PER_DISPATCH),
         "--num-pc", "2",
+        "--num-samples", str(n_samples),
+        "--similarity-strategy", "dense",
     ]
     source = SyntheticGenomicsSource(
-        num_samples=N_SAMPLES, seed=42, variant_spacing=VARIANT_SPACING
+        num_samples=n_samples, seed=42, variant_spacing=VARIANT_SPACING
     )
 
     # Warmup: identical shapes (one dispatch group + full-cohort finalize),
@@ -142,7 +158,7 @@ def _run_config(name: str, device) -> dict:
     driver.flush_device_ingest_stats()
     acc = driver._device_gen_acc
     sites_scanned = int(driver._device_gen_scanned)
-    assert len(result) == N_SAMPLES * n_sets
+    assert len(result) == n_samples * n_sets
     assert all(len(pcs) == 2 for _, pcs in result)
 
     # Device ingest data-parallelizes over the mesh data axis when more than
@@ -152,7 +168,7 @@ def _run_config(name: str, device) -> dict:
     return {
         "metric": (
             f"{config['metric']} (end-to-end incl. ingest; "
-            f"{N_SAMPLES * n_sets} columns, {sites_scanned} sites)"
+            f"{n_samples * n_sets} columns, {sites_scanned} sites)"
         ),
         "value": round(wall, 3),
         "unit": "s",

@@ -11,7 +11,6 @@ with the reference flag grammar (``GenomicsConf.scala:29-98``):
 
 from __future__ import annotations
 
-import os
 import sys
 from typing import List, Optional, Sequence
 
@@ -59,14 +58,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if command not in COMMANDS:
         print(f"unknown command: {command}", file=sys.stderr)
         return 2
-    if os.environ.get("SPARK_EXAMPLES_TPU_NO_CACHE") != "1":
-        # After the help/unknown early-outs: only real commands pay (and
-        # benefit from) the process-global persistent-cache configuration.
-        from spark_examples_tpu.utils.cache import (
-            enable_persistent_compile_cache,
-        )
+    # After the help/unknown early-outs: only real commands pay (and benefit
+    # from) the process-global persistent-cache configuration.
+    from spark_examples_tpu.utils.cache import enable_persistent_compile_cache
 
-        enable_persistent_compile_cache()
+    enable_persistent_compile_cache()
     COMMANDS[command](rest)
     return 0
 

@@ -11,6 +11,10 @@ import os
 
 
 def enable_persistent_compile_cache() -> None:
+    """No-op when SPARK_EXAMPLES_TPU_NO_CACHE=1 (test/CI hygiene: no writes
+    outside the working tree); never raises."""
+    if os.environ.get("SPARK_EXAMPLES_TPU_NO_CACHE") == "1":
+        return
     try:
         import jax
 
@@ -21,8 +25,13 @@ def enable_persistent_compile_cache() -> None:
             ),
         )
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass  # never block the caller on cache configuration
+    except Exception as e:  # never block the caller on cache configuration
+        import sys
+
+        print(
+            f"warning: persistent compile cache disabled ({e})",
+            file=sys.stderr,
+        )
 
 
 __all__ = ["enable_persistent_compile_cache"]

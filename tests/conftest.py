@@ -16,6 +16,9 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
+# CLI invocations inside tests must not flip on the user-level persistent
+# compile cache (writes outside tmp_path).
+os.environ["SPARK_EXAMPLES_TPU_NO_CACHE"] = "1"
 
 import jax  # noqa: E402
 

@@ -129,40 +129,3 @@ def test_normalize_strips_chr_and_is_idempotent(name):
     if normalized is not None:
         assert VariantsBuilder.normalize(normalized) == normalized
         assert not normalized.startswith("chr")
-
-
-@given(
-    seed=st.integers(min_value=0, max_value=2**31),
-    n=st.integers(min_value=2, max_value=12),
-    start=st.integers(min_value=0, max_value=200_000),
-    width=st.integers(min_value=200, max_value=4_000),
-)
-@settings(max_examples=10, deadline=None)
-def test_device_ingest_bitwise_matches_host_fuzz(seed, n, start, width):
-    """Fuzz the device ingest kernel against the host packed path: any
-    cohort/seed/region must produce the identical Gramian."""
-    from spark_examples_tpu.ops.devicegen import DeviceGenGramianAccumulator
-    from spark_examples_tpu.ops.gramian import gramian_reference
-
-    source = SyntheticGenomicsSource(num_samples=n, seed=seed)
-    contig = Contig("7", start, start + width)
-    blocks = list(source.genotype_blocks("vs", contig, block_size=128))
-    rows = (
-        np.concatenate([b["has_variation"] for b in blocks])
-        if blocks
-        else np.zeros((0, n), np.uint8)
-    )
-    acc = DeviceGenGramianAccumulator(
-        num_samples=n,
-        vs_keys=[source.genotype_stream_key("vs")],
-        pops=source.populations,
-        site_key=source.site_key,
-        spacing=source.variant_spacing,
-        ref_block_fraction=source.ref_block_fraction,
-        block_size=16,
-        blocks_per_dispatch=2,
-    )
-    k0, k1 = source.site_grid_range(contig)
-    if k1 > k0:
-        acc.add_grid(k0, k1)
-    np.testing.assert_array_equal(acc.finalize(), gramian_reference(rows))
