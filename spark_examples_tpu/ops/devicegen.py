@@ -169,6 +169,33 @@ def generate_has_variation(
     return jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
 
 
+def generate_column_block(
+    positions: jax.Array,  # (B,) int64
+    thresholds: jax.Array,  # (B, P) uint64 Q53 thresholds, 0 = dropped
+    vs_key: jax.Array,  # scalar uint64 genotype stream key (one set)
+    pops_local: jax.Array,  # (N_local,) int32: this slice's sample pops
+    col_start: jax.Array,  # scalar int: first GLOBAL sample index
+    num_samples: int,
+) -> jax.Array:
+    """(B, N_local) {0,1} has-variation for one SAMPLE-COLUMN slice: the
+    genotype draw is keyed by the global sample index, so a slice can
+    generate exactly its own columns of the cohort matrix (bitwise-equal to
+    the corresponding columns of :func:`generate_has_variation`); padded
+    columns past ``num_samples`` come out all-zero."""
+    n_local = pops_local.shape[0]
+    cols = col_start + jnp.arange(n_local, dtype=jnp.int64)
+    samples = (cols.astype(jnp.uint64) * _c64(_P4))[None, :]
+    pos_term = positions.astype(jnp.uint64) * _c64(_P2)
+    t_full = jnp.take(thresholds, pops_local, axis=1)  # (B, N_local)
+    t_full = jnp.where((cols < num_samples)[None, :], t_full, jnp.uint64(0))
+    h1 = mix64(vs_key ^ pos_term)  # (B,)
+    h2 = mix64(h1 ^ _c64(_S_GENOTYPE * _P3))
+    h3 = mix64(h2[:, None] ^ samples)  # (B, N_local)
+    m1 = mix64(h3 ^ _c64(1 * _P1)) >> jnp.uint64(11)
+    m2 = mix64(h3 ^ _c64(2 * _P1)) >> jnp.uint64(11)
+    return (m1 < t_full) | (m2 < t_full)
+
+
 @functools.lru_cache(maxsize=32)
 def _fused_update(
     vs_keys: Tuple[int, ...],
@@ -290,7 +317,72 @@ def _fused_update_mesh(
     )
 
 
-class DeviceGenGramianAccumulator:
+class _GridDispatchAccumulator:
+    """Shared dispatch machinery for the device-generation accumulators:
+    validated (grid_offset, n_valid) group dispatch, data-axis round-robin,
+    and the eager-mode poke. Subclasses provide ``_update`` with signature
+    ``(G, variant_rows, kept_sites, offsets, valids)`` plus the
+    ``data_parallel`` / ``sites_per_dispatch`` / ``_scalar_sharding``
+    attributes."""
+
+    def add_ranges(self, grid_offsets: np.ndarray, n_valids: np.ndarray) -> None:
+        """Data-parallel dispatch: slice d processes grid indices
+        ``[grid_offsets[d], grid_offsets[d] + n_valids[d])`` (``n_valids[d]
+        == 0`` means an idle slice this round)."""
+        D = self.data_parallel
+        grid_offsets = np.asarray(grid_offsets, dtype=np.int64)
+        n_valids = np.asarray(n_valids, dtype=np.int64)
+        if grid_offsets.shape != (D,) or n_valids.shape != (D,):
+            raise ValueError(f"expected ({D},) offsets/valids")
+        if n_valids.min(initial=0) < 0 or n_valids.max(initial=0) > self.sites_per_dispatch:
+            raise ValueError(
+                f"n_valids must be in [0, {self.sites_per_dispatch}]"
+            )
+        if (grid_offsets < 0).any():
+            # Negative grid indices would wrap to garbage uint64 positions on
+            # device and silently corrupt the Gramian.
+            raise ValueError("grid_offsets must be non-negative")
+        with jax.enable_x64(True):
+            self.G, self.variant_rows, self.kept_sites = self._update(
+                self.G,
+                self.variant_rows,
+                self.kept_sites,
+                jax.device_put(grid_offsets, self._scalar_sharding),
+                jax.device_put(n_valids, self._scalar_sharding),
+            )
+        self.dispatches += 1
+
+    def add_grid(self, first_index: int, last_index: int) -> None:
+        """Dispatch all groups for a contiguous grid index range
+        ``[first_index, last_index)``, round-robining groups over the data
+        axis."""
+        step = self.sites_per_dispatch
+        starts = list(range(first_index, last_index, step))
+        D = self.data_parallel
+        for i in range(0, len(starts), D):
+            offsets = np.zeros(D, dtype=np.int64)
+            valids = np.zeros(D, dtype=np.int64)
+            for d, off in enumerate(starts[i : i + D]):
+                offsets[d] = off
+                valids[d] = min(step, last_index - off)
+            self.add_ranges(offsets, valids)
+            if self.dispatches == 1:
+                self.poke()
+
+    def poke(self) -> None:
+        """Force the backend into eager execution with one tiny sync fetch.
+
+        The remote-attached (tunneled) PJRT backend defers execution of
+        queued dispatches until the first synchronous transfer — host work
+        and device work would otherwise run strictly serially (measured:
+        total = host + execute). One scalar fetch after the first dispatch
+        flips it to eager for the rest of the stream.
+        """
+        with jax.enable_x64(True):
+            jax.device_get(self.kept_sites)
+
+
+class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
     """Fully fused on-device ingest+similarity for the synthetic source.
 
     The host walks the site grid in fixed-size dispatch groups and sends only
@@ -417,68 +509,17 @@ class DeviceGenGramianAccumulator:
             )
         self.dispatches += 1
 
-    def add_ranges(self, grid_offsets: np.ndarray, n_valids: np.ndarray) -> None:
-        """Data-parallel dispatch: slice d processes grid indices
-        ``[grid_offsets[d], grid_offsets[d] + n_valids[d])`` (``n_valids[d]
-        == 0`` means an idle slice this round)."""
-        D = self.data_parallel
-        grid_offsets = np.asarray(grid_offsets, dtype=np.int64)
-        n_valids = np.asarray(n_valids, dtype=np.int64)
-        if grid_offsets.shape != (D,) or n_valids.shape != (D,):
-            raise ValueError(f"expected ({D},) offsets/valids")
-        if n_valids.min(initial=0) < 0 or n_valids.max(initial=0) > self.sites_per_dispatch:
-            raise ValueError(
-                f"n_valids must be in [0, {self.sites_per_dispatch}]"
-            )
-        if (grid_offsets < 0).any():
-            # Negative grid indices would wrap to garbage uint64 positions on
-            # device and silently corrupt the Gramian.
-            raise ValueError("grid_offsets must be non-negative")
-        with jax.enable_x64(True):
-            self.G, self.variant_rows, self.kept_sites = self._update(
-                self.G,
-                self.variant_rows,
-                self.kept_sites,
-                jax.device_put(grid_offsets, self._scalar_sharding),
-                jax.device_put(n_valids, self._scalar_sharding),
-            )
-        self.dispatches += 1
-
     def add_grid(self, first_index: int, last_index: int) -> None:
-        """Dispatch all groups for a contiguous grid index range
-        ``[first_index, last_index)``, round-robining groups over the data
-        axis when the accumulator is data-parallel."""
-        step = self.sites_per_dispatch
-        starts = list(range(first_index, last_index, step))
-        if self.data_parallel == 1:
-            for off in starts:
-                self.add_range(off, min(step, last_index - off))
-                if self.dispatches == 1:
-                    self.poke()
+        """Single-slice fast path keeps scalar dispatches; data-parallel
+        instances use the shared round-robin."""
+        if self.data_parallel > 1:
+            super().add_grid(first_index, last_index)
             return
-        D = self.data_parallel
-        for i in range(0, len(starts), D):
-            batch = starts[i : i + D]
-            offsets = np.zeros(D, dtype=np.int64)
-            valids = np.zeros(D, dtype=np.int64)
-            for d, off in enumerate(batch):
-                offsets[d] = off
-                valids[d] = min(step, last_index - off)
-            self.add_ranges(offsets, valids)
+        step = self.sites_per_dispatch
+        for off in range(first_index, last_index, step):
+            self.add_range(off, min(step, last_index - off))
             if self.dispatches == 1:
                 self.poke()
-
-    def poke(self) -> None:
-        """Force the backend into eager execution with one tiny sync fetch.
-
-        The remote-attached (tunneled) PJRT backend defers execution of
-        queued dispatches until the first synchronous transfer — host work
-        and device work would otherwise run strictly serially (measured:
-        total = host + execute). One scalar fetch after the first dispatch
-        flips it to eager for the rest of the stream.
-        """
-        with jax.enable_x64(True):
-            jax.device_get(self.kept_sites)
 
     def finalize_device(self) -> jax.Array:
         """The accumulated Gramian, still on device; for data-parallel
@@ -496,8 +537,203 @@ class DeviceGenGramianAccumulator:
             )
 
 
+@functools.lru_cache(maxsize=32)
+def _ring_update(
+    vs_key: int,
+    pops_bytes: bytes,
+    site_key: int,
+    spacing: int,
+    ref_block_fraction: float,
+    min_af_micro: Optional[int],
+    block_size: int,
+    blocks_per_dispatch: int,
+    operand_name: str,
+    num_samples: int,
+    padded: int,
+    mesh,
+):
+    """Memoized scanned generate→ring-accumulate program for one static
+    configuration (warmup and measured accumulators share one compiled
+    program, like :func:`_fused_update`). Signature of the returned jit:
+    ``(G, variant_rows, kept_sites, offsets, valids)``."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from spark_examples_tpu.ops.gramian import _ring_tiles
+    from spark_examples_tpu.parallel.mesh import DATA_AXIS, SAMPLES_AXIS
+
+    operand_dtype = np.dtype(operand_name)
+    pops_padded = np.frombuffer(pops_bytes, dtype=np.int32)
+    n_pops = int(pops_padded.max()) + 1
+    n_local = padded // mesh.shape[SAMPLES_AXIS]
+    K, B = blocks_per_dispatch, block_size
+    data_axis = DATA_AXIS if DATA_AXIS in mesh.shape else None
+    g_spec = P(data_axis, SAMPLES_AXIS, None)
+    s_spec = P(data_axis)
+
+    with jax.enable_x64(True):
+        vs_key_arr = _c64(vs_key)
+        site_key_arr = _c64(site_key)
+        pops_all = jnp.asarray(pops_padded)
+
+        def per_device(g, rows, kept, offset, n_valid):
+            # g: (1, n_local, padded); offset/n_valid/kept/rows: (1,)
+            s_idx = jax.lax.axis_index(SAMPLES_AXIS)
+            col_start = (s_idx * n_local).astype(jnp.int64)
+            pops_local = jax.lax.dynamic_slice(
+                pops_all, (s_idx * n_local,), (n_local,)
+            )
+            block_idx = jnp.arange(K * B, dtype=jnp.int64).reshape(K, B)
+
+            def body(carry, idx):
+                g_l, rows_l, kept_l = carry
+                positions = (offset[0] + idx) * spacing
+                valid = idx < n_valid[0]
+                T = site_thresholds_on_device(
+                    site_key_arr,
+                    positions,
+                    valid,
+                    n_pops,
+                    ref_block_fraction,
+                    min_af_micro,
+                )
+                kept_l += jnp.sum(jnp.any(T > 0, axis=1)).astype(kept_l.dtype)
+                hv = generate_column_block(
+                    positions, T, vs_key_arr, pops_local, col_start, num_samples
+                )
+                # A row "has variation" if ANY slice's columns do.
+                local_any = jnp.any(hv, axis=1).astype(jnp.int32)
+                total_any = jax.lax.psum(local_any, SAMPLES_AXIS)
+                rows_l += jnp.sum(total_any > 0).astype(rows_l.dtype)
+                g_l = _ring_tiles(
+                    g_l, hv.astype(operand_dtype), SAMPLES_AXIS, operand_dtype
+                )
+                return (g_l, rows_l, kept_l), None
+
+            (g_l, rows_l, kept_l), _ = jax.lax.scan(
+                body, (g[0], rows[0], kept[0]), block_idx
+            )
+            return g_l[None], rows_l[None], kept_l[None]
+
+        return jax.jit(
+            shard_map(
+                per_device,
+                mesh=mesh,
+                in_specs=(g_spec, s_spec, s_spec, s_spec, s_spec),
+                out_specs=(g_spec, s_spec, s_spec),
+                # kept/rows are samples-replicated by construction
+                # (identical metadata / psum'd flags on every slice).
+                check_vma=False,
+            )
+        )
+
+
+class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
+    """Sharded large-N device ingest: the composition of on-device
+    generation with the ring-exchange Gramian.
+
+    Each ``samples``-axis slice generates ONLY its own sample-column block
+    of the cohort matrix (``generate_column_block``) and the ring exchange
+    (``ops/gramian.py:_ring_tiles``) accumulates row tiles — so for a 50K+
+    cohort (the reference's ~20 GB in-memory warning,
+    ``VariantsPca.scala:216-217``) no device ever materializes the full
+    N×N, no host→device data traffic exists at all, and the optional
+    ``data`` axis adds Spark-executor-style grid parallelism on top.
+    Single variant set (the large-cohort use case).
+    """
+
+    def __init__(
+        self,
+        num_samples: int,
+        vs_key: int,
+        pops: np.ndarray,
+        site_key: int,
+        spacing: int,
+        ref_block_fraction: float,
+        mesh,
+        min_af_micro: Optional[int] = None,
+        block_size: int = 1024,
+        blocks_per_dispatch: int = 8,
+        exact_int: bool = True,
+    ):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from spark_examples_tpu.ops.gramian import _operand_dtypes
+        from spark_examples_tpu.parallel.mesh import DATA_AXIS, SAMPLES_AXIS
+
+        if SAMPLES_AXIS not in mesh.shape or mesh.shape[SAMPLES_AXIS] < 2:
+            raise ValueError("ring device ingest needs a samples axis >= 2")
+        self.mesh = mesh
+        self.num_samples = int(num_samples)
+        self.samples_parallel = mesh.shape[SAMPLES_AXIS]
+        self.data_parallel = mesh.shape.get(DATA_AXIS, 1)
+        self.padded = (
+            -(-self.num_samples // self.samples_parallel) * self.samples_parallel
+        )
+        self.n_local = self.padded // self.samples_parallel
+        self.block_size = int(block_size)
+        self.blocks_per_dispatch = int(blocks_per_dispatch)
+        self.sites_per_dispatch = self.block_size * self.blocks_per_dispatch
+        self.spacing = int(spacing)
+        self.dispatches = 0
+        operand_dtype, accum_dtype = _operand_dtypes(exact_int, mesh)
+        self.accum_dtype = accum_dtype
+
+        D = self.data_parallel
+        pops_padded = np.zeros(self.padded, dtype=np.int32)
+        pops_padded[: self.num_samples] = np.asarray(pops, dtype=np.int32)
+        data_axis = DATA_AXIS if DATA_AXIS in mesh.shape else None
+        g_spec = P(data_axis, SAMPLES_AXIS, None)
+        self._scalar_sharding = NamedSharding(mesh, P(data_axis))
+
+        with jax.enable_x64(True):
+            self.G = jax.device_put(
+                np.zeros((D, self.padded, self.padded), np.dtype(accum_dtype)),
+                NamedSharding(mesh, g_spec),
+            )
+            self.kept_sites = jax.device_put(
+                np.zeros((D,), np.int64), self._scalar_sharding
+            )
+            self.variant_rows = jax.device_put(
+                np.zeros((D,), np.int64), self._scalar_sharding
+            )
+        self._update = _ring_update(
+            int(vs_key),
+            pops_padded.tobytes(),
+            int(site_key),
+            self.spacing,
+            float(ref_block_fraction),
+            min_af_micro,
+            self.block_size,
+            self.blocks_per_dispatch,
+            np.dtype(operand_dtype).name,
+            self.num_samples,
+            self.padded,
+            mesh,
+        )
+
+    def finalize_sharded(self) -> jax.Array:
+        """(padded, padded) Gramian, row-sharded over ``samples`` — feeds
+        the sharded centering/eigensolve without ever gathering N×N."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from spark_examples_tpu.parallel.mesh import SAMPLES_AXIS
+
+        return jax.jit(
+            lambda G: jnp.sum(G, axis=0),
+            out_shardings=NamedSharding(self.mesh, P(SAMPLES_AXIS, None)),
+        )(self.G)
+
+    def finalize(self) -> np.ndarray:
+        with jax.enable_x64(True):
+            full = np.asarray(jax.device_get(self.finalize_sharded()))
+        return full[: self.num_samples, : self.num_samples].astype(np.float64)
+
+
 __all__ = [
     "DeviceGenGramianAccumulator",
+    "DeviceGenRingGramianAccumulator",
+    "generate_column_block",
     "generate_has_variation",
     "mix64",
     "site_thresholds_on_device",

@@ -269,10 +269,14 @@ def _ring_tiles(G_local, X_cols, samples_axis: str, operand_dtype):
         tile = jnp.matmul(
             x_mine_t, cur.astype(operand_dtype), preferred_element_type=G.dtype
         )  # (N_local, N_local)
+        # Explicit int32 indices: under enable_x64 the literal 0 would
+        # otherwise promote to int64 and mismatch the axis-index dtype.
+        col = (j * n_local).astype(jnp.int32)
+        zero = jnp.int32(0)
         G = lax.dynamic_update_slice(
             G,
-            lax.dynamic_slice(G, (0, j * n_local), (n_local, n_local)) + tile,
-            (0, j * n_local),
+            lax.dynamic_slice(G, (zero, col), (n_local, n_local)) + tile,
+            (zero, col),
         )
         cur = lax.ppermute(
             cur, samples_axis, [((p + 1) % D, p) for p in range(D)]

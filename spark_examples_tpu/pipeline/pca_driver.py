@@ -358,26 +358,52 @@ class VariantsPcaDriver:
         column concatenation of per-set genotype matrices — verified against
         the wire path in tests.
         """
-        from spark_examples_tpu.ops.devicegen import DeviceGenGramianAccumulator
+        from spark_examples_tpu.ops.devicegen import (
+            DeviceGenGramianAccumulator,
+            DeviceGenRingGramianAccumulator,
+        )
         from spark_examples_tpu.sources.synthetic import af_filter_micro
 
         source: SyntheticGenomicsSource = self.source  # type: ignore[assignment]
         conf = self.conf
-        acc = DeviceGenGramianAccumulator(
-            num_samples=source.num_samples,
-            vs_keys=[
-                source.genotype_stream_key(v) for v in conf.variant_set_id
-            ],
-            pops=source.populations,
-            site_key=source.site_key,
-            spacing=source.variant_spacing,
-            ref_block_fraction=source.ref_block_fraction,
-            min_af_micro=af_filter_micro(conf.min_allele_frequency),
-            block_size=conf.block_size,
-            blocks_per_dispatch=conf.blocks_per_dispatch,
-            exact_int=True,
-            mesh=self._make_mesh(),
+        mesh = self._make_mesh()
+        use_ring = (
+            len(conf.variant_set_id) == 1
+            and self._resolve_sharded(None, mesh)
         )
+        if use_ring:
+            # Sharded strategy, fully on device: each samples-slice
+            # generates its own column block and ring-exchanges tiles — the
+            # large-cohort (~50K samples) regime with zero host traffic.
+            acc: object = DeviceGenRingGramianAccumulator(
+                num_samples=source.num_samples,
+                vs_key=source.genotype_stream_key(conf.variant_set_id[0]),
+                pops=source.populations,
+                site_key=source.site_key,
+                spacing=source.variant_spacing,
+                ref_block_fraction=source.ref_block_fraction,
+                mesh=mesh,
+                min_af_micro=af_filter_micro(conf.min_allele_frequency),
+                block_size=conf.block_size,
+                blocks_per_dispatch=conf.blocks_per_dispatch,
+                exact_int=True,
+            )
+        else:
+            acc = DeviceGenGramianAccumulator(
+                num_samples=source.num_samples,
+                vs_keys=[
+                    source.genotype_stream_key(v) for v in conf.variant_set_id
+                ],
+                pops=source.populations,
+                site_key=source.site_key,
+                spacing=source.variant_spacing,
+                ref_block_fraction=source.ref_block_fraction,
+                min_af_micro=af_filter_micro(conf.min_allele_frequency),
+                block_size=conf.block_size,
+                blocks_per_dispatch=conf.blocks_per_dispatch,
+                exact_int=True,
+                mesh=mesh,
+            )
 
         self._device_gen_scanned = 0
         for contig in contigs:
@@ -396,6 +422,10 @@ class VariantsPcaDriver:
                     contig, conf.bases_per_partition
                 ) * len(conf.variant_set_id)
         self._device_gen_acc = acc
+        if use_ring:
+            # Row-sharded (padded) result; compute_pca routes to the sharded
+            # centering/eigensolve from its NamedSharding.
+            return acc.finalize_sharded()
         return acc.finalize_device()
 
     def flush_device_ingest_stats(self) -> None:
@@ -459,8 +489,10 @@ class VariantsPcaDriver:
             device_components, _ = principal_components_subspace_sharded(
                 centered, sharded_mesh, self.conf.num_pc, n_true=n
             )
+            # any() rather than sum() > 0: entries are non-negative counts,
+            # and int32 row sums would overflow at whole-genome scale.
             nonzero = int(
-                jax.device_get((similarity.sum(axis=1) > 0).sum())
+                jax.device_get(jnp.any(similarity != 0, axis=1).sum())
             )
             print(f"Non zero rows in matrix: {nonzero} / {n}.")
             components = np.asarray(
@@ -544,28 +576,27 @@ def run(argv: Sequence[str]) -> List[str]:
     )
     # Device generation needs distinct variant sets (duplicate ids collapse
     # the column index, a same-set join the wire path handles via count
-    # multiplicity) and the dense accumulator (it owns its fused update).
+    # multiplicity); multi-set configurations additionally need the dense
+    # accumulator (the ring/sharded device path is single-set).
     unique_sets = len(set(conf.variant_set_id)) == len(conf.variant_set_id)
     dense_ok = conf.similarity_strategy != "sharded" and (
         conf.similarity_strategy == "dense"
         or len(conf.variant_set_id) * conf.num_samples < 16384
     )
+    device_ok = unique_sets and (
+        dense_ok or len(conf.variant_set_id) == 1
+    )
     use_device = conf.ingest == "device" or (
-        conf.ingest == "auto" and synthetic_tpu and unique_sets and dense_ok
+        conf.ingest == "auto" and synthetic_tpu and device_ok
     )
-    # Packed ingest supports both accumulator strategies, so it remains the
-    # auto choice for single-set sharded/large-cohort runs where device
-    # ingest (dense-only) doesn't apply.
-    use_packed = conf.ingest == "packed" or (
-        conf.ingest == "auto"
-        and not use_device
-        and synthetic_tpu
-        and len(conf.variant_set_id) == 1
-    )
-    if use_device and not (synthetic_tpu and unique_sets and dense_ok):
+    # Every auto-eligible synthetic single-set config now takes the device
+    # path (dense or ring); packed ingest remains available explicitly.
+    use_packed = conf.ingest == "packed"
+    if use_device and not (synthetic_tpu and device_ok):
         raise ValueError(
             "--ingest device requires --source synthetic, --pca-backend tpu, "
-            "distinct variant-set ids, and the dense similarity strategy"
+            "distinct variant-set ids, and (for multi-set configs) the dense "
+            "similarity strategy"
         )
     if use_packed and not synthetic_tpu:
         raise ValueError(

@@ -345,3 +345,28 @@ def test_sharded_strategy_guard_without_mesh():
         conf = _conf(similarity_strategy="sharded", mesh_shape="8,1")
         driver = VariantsPcaDriver(conf, _source(conf))
         driver.get_similarity_matrix(iter([[0, 1]]))
+
+
+def test_sharded_device_ingest_run_matches_dense_run():
+    """Single-set sharded strategy now stays on the device ingest path
+    (ring accumulator) end to end; result equals the dense device run."""
+    argv = [
+        "--references", "17:0:30000",
+        "--variant-set-id", "vs-a",
+        "--num-samples", "21",
+        "--seed", "5",
+        "--bases-per-partition", "10000",
+        "--block-size", "32",
+    ]
+    dense = pca_driver.run(argv + ["--similarity-strategy", "dense"])
+    sharded = pca_driver.run(
+        argv + ["--similarity-strategy", "sharded", "--mesh-shape", "1,8"]
+    )
+
+    def parse(lines):
+        return np.array([[float(x) for x in l.split("\t")[2:]] for l in lines])
+
+    A, B = parse(dense), parse(sharded)
+    signs = np.sign((A * B).sum(axis=0))
+    signs[signs == 0] = 1
+    np.testing.assert_allclose(A, B * signs, atol=5e-3)
