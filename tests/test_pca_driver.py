@@ -370,3 +370,37 @@ def test_sharded_device_ingest_run_matches_dense_run():
     signs = np.sign((A * B).sum(axis=0))
     signs[signs == 0] = 1
     np.testing.assert_allclose(A, B * signs, atol=5e-3)
+
+
+def test_io_stats_parity_across_ingest_paths(capsys):
+    """partitions / requests / variants agree between the device, packed and
+    wire ingest paths for the same single-set configuration."""
+    argv = [
+        "--references", "17:0:20000",
+        "--variant-set-id", "vs-a",
+        "--num-samples", "12",
+        "--seed", "5",
+        "--bases-per-partition", "5000",
+        "--block-size", "32",
+    ]
+
+    def stats_of(ingest):
+        pca_driver.run(argv + ["--ingest", ingest])
+        out = capsys.readouterr().out
+        fields = {}
+        for line in out.splitlines():
+            if line.startswith("# of"):
+                key, value = line.split(": ")
+                fields[key] = int(value)
+        return fields
+
+    device = stats_of("device")
+    packed = stats_of("packed")
+    wire = stats_of("wire")
+    for key in ("# of partitions", "# of bases requested", "# of API requests"):
+        assert device[key] == packed[key] == wire[key], (key, device, packed, wire)
+    # Variants: device/packed count kept rows after the nonzero drop; wire
+    # counts every record built (ref blocks included) — a documented
+    # divergence, but device and packed must agree exactly.
+    assert device["# of variants read"] == packed["# of variants read"]
+    assert wire["# of variants read"] >= device["# of variants read"]
