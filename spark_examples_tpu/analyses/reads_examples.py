@@ -14,7 +14,7 @@ streaming equivalent, exact for shards processed in coordinate order.
 from __future__ import annotations
 
 import os
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -35,6 +35,7 @@ from spark_examples_tpu.sharding.partitioners import (
     TargetSizeSplits,
 )
 from spark_examples_tpu.sources.base import GenomicsSource
+
 
 def _pad_read_length(max_len: int) -> int:
     """Round a shard's max read length up to a multiple of 64: the scatter

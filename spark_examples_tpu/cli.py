@@ -12,10 +12,10 @@ with the reference flag grammar (``GenomicsConf.scala:29-98``):
 from __future__ import annotations
 
 import sys
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 from spark_examples_tpu.analyses import reads_examples, variants_examples
-from spark_examples_tpu.config import GenomicsConf, PcaConf
+from spark_examples_tpu.config import GenomicsConf
 from spark_examples_tpu.pipeline import pca_driver
 
 

@@ -42,7 +42,6 @@ right API — no wrapper layer):
 
 from __future__ import annotations
 
-import os
 from typing import Dict, Optional, Sequence
 
 import jax

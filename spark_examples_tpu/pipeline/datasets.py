@@ -17,8 +17,7 @@ normalization drop), stats accounting, and a prefetching parallel iterator.
 from __future__ import annotations
 
 import concurrent.futures
-import threading
-from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple, TypeVar
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple, TypeVar
 
 from spark_examples_tpu.models.read import Read, ReadBuilder, ReadKey
 from spark_examples_tpu.models.variant import Variant, VariantKey, VariantsBuilder

@@ -39,11 +39,9 @@ from spark_examples_tpu.ops.gramian import (
 )
 from spark_examples_tpu.ops.pca import (
     mllib_reference_pca,
-    principal_components,
     principal_components_subspace,
 )
 from spark_examples_tpu.parallel.mesh import (
-    DATA_AXIS,
     SAMPLES_AXIS,
     default_mesh,
     make_mesh,

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass
-from typing import Iterable, List, Tuple
+from typing import Iterable, List
 
 #: Default shard width, matching genomics-utils
 #: ``Contig.DEFAULT_NUMBER_OF_BASES_PER_SHARD`` (used via
