@@ -155,7 +155,6 @@ def _run_config(name: str, device) -> dict:
     result = driver.compute_pca(S)  # fetches the (N, num_pc) components
     wall = time.perf_counter() - start
 
-    driver.flush_device_ingest_stats()
     acc = driver._device_gen_acc
     sites_scanned = int(driver._device_gen_scanned)
     assert len(result) == n_samples * n_sets

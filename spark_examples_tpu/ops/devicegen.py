@@ -376,10 +376,28 @@ class _GridDispatchAccumulator:
         queued dispatches until the first synchronous transfer — host work
         and device work would otherwise run strictly serially (measured:
         total = host + execute). One scalar fetch after the first dispatch
-        flips it to eager for the rest of the stream.
+        flips it to eager for the rest of the stream. Fetches a process-local
+        shard, not the global value: in a multi-controller run the counter
+        spans non-addressable devices and ``device_get`` would raise.
         """
+        from spark_examples_tpu.parallel.mesh import local_shard
+
         with jax.enable_x64(True):
-            jax.device_get(self.kept_sites)
+            local_shard(self.kept_sites)
+
+    def ingest_counters(self) -> Tuple[np.ndarray, int]:
+        """``(per-set variant-row totals, kept-site total)``, synchronously
+        fetched — valid in every process of a multi-controller run
+        (``host_value`` replicates before fetching). Blocks until the whole
+        ingest chain has executed, so calling this at the end of the ingest
+        stage also makes the stage's wall-clock honest on asynchronous
+        backends (``utils/tracing.py``)."""
+        from spark_examples_tpu.parallel.mesh import host_value
+
+        with jax.enable_x64(True):
+            rows = host_value(self.variant_rows)
+            kept = host_value(self.kept_sites)
+        return self._reduce_row_counts(rows), int(np.sum(kept))
 
 
 class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
@@ -482,6 +500,11 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
                 )
                 self._update = _fused_update_mesh(*update_key, mesh)
 
+    def _reduce_row_counts(self, rows: np.ndarray) -> np.ndarray:
+        """(n_sets,) per-set totals: data-parallel slices each hold partial
+        per-set counts (disjoint grid spans) that sum elementwise."""
+        return rows.sum(axis=0) if rows.ndim > 1 else rows
+
     def add_range(self, grid_offset: int, n_valid: int) -> None:
         """Dispatch one group covering grid indices
         ``[grid_offset, grid_offset + n_valid)`` (positions ``index ·
@@ -527,14 +550,23 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
         ``reduceByKey`` shuffle become a single ``psum`` over ICI,
         ``VariantsPca.scala:230``)."""
         if self.data_parallel > 1:
+            if not self.G.is_fully_addressable:
+                # Multi-controller: replicate so every process can fetch (and
+                # so downstream eager stages see a fully-addressable array).
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                return jax.jit(
+                    lambda G: jnp.sum(G, axis=0),
+                    out_shardings=NamedSharding(self.mesh, PartitionSpec()),
+                )(self.G)
             return jnp.sum(self.G, axis=0)
         return self.G
 
     def finalize(self) -> np.ndarray:
+        from spark_examples_tpu.parallel.mesh import host_value
+
         with jax.enable_x64(True):
-            return np.asarray(jax.device_get(self.finalize_device())).astype(
-                np.float64
-            )
+            return host_value(self.finalize_device()).astype(np.float64)
 
 
 @functools.lru_cache(maxsize=32)
@@ -724,9 +756,16 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
             out_shardings=NamedSharding(self.mesh, P(SAMPLES_AXIS, None)),
         )(self.G)
 
+    def _reduce_row_counts(self, rows: np.ndarray) -> np.ndarray:
+        """Single set: per-data-slice row counts (already samples-replicated
+        inside the shard_map) sum to one total."""
+        return np.asarray([rows.sum()])
+
     def finalize(self) -> np.ndarray:
+        from spark_examples_tpu.parallel.mesh import host_value
+
         with jax.enable_x64(True):
-            full = np.asarray(jax.device_get(self.finalize_sharded()))
+            full = host_value(self.finalize_sharded())
         return full[: self.num_samples, : self.num_samples].astype(np.float64)
 
 

@@ -82,6 +82,34 @@ def distributed_init(
     )
 
 
+def host_value(x) -> np.ndarray:
+    """Host copy of a global array, valid in every process.
+
+    Single-process (and fully-addressable) arrays fetch directly; an array
+    that spans non-addressable devices — the multi-controller case, where
+    ``jax.device_get`` raises — is first replicated onto every device with a
+    jitted identity (one ``all_gather`` over DCN), after which each process
+    holds complete addressable replicas.
+    """
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(x))
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = x.sharding.mesh
+    replicated = jax.jit(
+        lambda a: a, out_shardings=NamedSharding(mesh, PartitionSpec())
+    )(x)
+    return np.asarray(jax.device_get(replicated))
+
+
+def local_shard(x) -> np.ndarray:
+    """One addressable shard of a global array — a process-local synchronous
+    fetch that works in single- and multi-controller modes alike (used for
+    the eager-mode poke, where only the sync matters, not the value)."""
+    shards = x.addressable_shards
+    return np.asarray(shards[0].data) if shards else np.asarray(x)
+
+
 def make_mesh(
     shape: Dict[str, int],
     devices: Optional[Sequence[jax.Device]] = None,
@@ -131,6 +159,8 @@ __all__ = [
     "DATA_AXIS",
     "SAMPLES_AXIS",
     "distributed_init",
+    "host_value",
+    "local_shard",
     "make_mesh",
     "default_mesh",
     "parse_mesh_shape",

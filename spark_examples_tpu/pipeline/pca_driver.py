@@ -423,22 +423,20 @@ class VariantsPcaDriver:
         if use_ring:
             # Row-sharded (padded) result; compute_pca routes to the sharded
             # centering/eigensolve from its NamedSharding.
-            return acc.finalize_sharded()
-        return acc.finalize_device()
-
-    def flush_device_ingest_stats(self) -> None:
-        """Record the device-counted variant rows: per variant set, rows with
-        variation in that set's columns — the same count the packed host path
-        reports after its nonzero drop. Called after the pipeline's final
-        fetch so the device_get here is free."""
-        import jax
-
-        acc = getattr(self, "_device_gen_acc", None)
-        if acc is None or self.io_stats is None:
-            return
-        with jax.enable_x64(True):
-            per_set = np.asarray(jax.device_get(acc.variant_rows))
-        self.io_stats.add_variants(int(per_set.sum()))
+            result = acc.finalize_sharded()
+        else:
+            result = acc.finalize_device()
+        # Epilogue: record the device-counted variant rows (per variant set,
+        # rows with variation in that set's columns — the same count the
+        # packed host path reports after its nonzero drop). Doing it here
+        # rather than leaving a flush for callers to remember keeps the
+        # stats-parity invariant even if a later stage raises, and the
+        # synchronous counter fetch makes the ingest stage's wall-clock
+        # honest on asynchronous backends.
+        per_set, _kept = acc.ingest_counters()
+        if self.io_stats is not None:
+            self.io_stats.add_variants(int(per_set.sum()))
+        return result
 
     def _host_similarity(self, calls: Iterable[List[int]]) -> np.ndarray:
         """Literal host replication of ``getSimilarityMatrix``
@@ -610,20 +608,36 @@ def run(argv: Sequence[str]) -> List[str]:
 
     times = StageTimes()
     with device_trace(conf.profile_dir):
+        # The device path already ends in a synchronous counter fetch (the
+        # stats epilogue); packed/wire paths end in a one-scalar fetch so the
+        # stage wall-clock is honest on asynchronous backends rather than
+        # dispatch-time only (utils/tracing.py).
         with times.stage("ingest+similarity"):
             similarity = _similarity_stage(conf, driver, use_device, use_packed)
+            if not use_device:
+                _sync_scalar(similarity)
         # compute_pca ends in the synchronous components fetch, so its stage
         # time is honest even on asynchronous remote-attached backends.
         with times.stage("center+pca"):
             result = driver.compute_pca(similarity)
     lines = driver.emit_result(result)
-    driver.flush_device_ingest_stats()
     driver.report_io_stats()
     if conf.profile_dir:
         print(str(times))
         print(f"Device trace written to {conf.profile_dir}.")
     driver.stop()
     return lines
+
+
+def _sync_scalar(similarity) -> None:
+    """Force outstanding device work to completion with a one-scalar fetch
+    that depends on the full accumulation chain (``block_until_ready`` can
+    ACK early on remote-attached backends; a host array is a no-op)."""
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(similarity, jax.Array):
+        jax.device_get(jnp.any(similarity != 0))
 
 
 def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
