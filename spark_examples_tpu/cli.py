@@ -59,9 +59,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"unknown command: {command}", file=sys.stderr)
         return 2
     # After the help/unknown early-outs: only real commands pay (and benefit
-    # from) the process-global persistent-cache configuration.
+    # from) the process-global platform/cache configuration.
+    from spark_examples_tpu.parallel.mesh import apply_platform_override
     from spark_examples_tpu.utils.cache import enable_persistent_compile_cache
 
+    apply_platform_override()
     enable_persistent_compile_cache()
     COMMANDS[command](rest)
     return 0

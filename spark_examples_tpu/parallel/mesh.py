@@ -42,6 +42,8 @@ right API — no wrapper layer):
 
 from __future__ import annotations
 
+import functools
+import os
 from typing import Dict, Optional, Sequence
 
 import jax
@@ -51,11 +53,31 @@ from jax.sharding import Mesh
 DATA_AXIS = "data"
 SAMPLES_AXIS = "samples"
 
+PLATFORM_ENV = "SPARK_EXAMPLES_TPU_PLATFORM"
+
+
+def apply_platform_override() -> Optional[str]:
+    """Honor ``SPARK_EXAMPLES_TPU_PLATFORM`` (e.g. ``cpu``) before any
+    backend client exists.
+
+    Images that pre-register an accelerator PJRT plugin from a
+    ``sitecustomize`` hook pin the platform at interpreter start, so the
+    standard ``JAX_PLATFORMS`` environment variable set at process launch is
+    silently overridden; ``jax.config`` still wins if applied before the
+    first client creation. This is how the multi-host harness
+    (``parallel/multihost.py``) runs its children on a virtual CPU fleet on
+    a single-TPU host."""
+    platform = os.environ.get(PLATFORM_ENV)
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    return platform or None
+
 
 def distributed_init(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    initialization_timeout: Optional[float] = None,
 ) -> None:
     """Initialize multi-host JAX (``jax.distributed``) when configured.
 
@@ -75,31 +97,52 @@ def distributed_init(
             f"(got coordinator_address={coordinator_address!r}, "
             f"num_processes={num_processes!r}, process_id={process_id!r})"
         )
+    kwargs = {}
+    if initialization_timeout is not None:
+        kwargs["initialization_timeout"] = initialization_timeout
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
+        **kwargs,
     )
+
+
+@functools.lru_cache(maxsize=8)
+def _replicator(mesh: Mesh):
+    """Jitted identity that replicates onto every device of ``mesh`` —
+    memoized per mesh so repeated ``host_value`` calls reuse one compiled
+    program instead of retracing a fresh closure each time."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.jit(lambda a: a, out_shardings=NamedSharding(mesh, PartitionSpec()))
 
 
 def host_value(x) -> np.ndarray:
     """Host copy of a global array, valid in every process.
 
-    Single-process (and fully-addressable) arrays fetch directly; an array
-    that spans non-addressable devices — the multi-controller case, where
-    ``jax.device_get`` raises — is first replicated onto every device with a
-    jitted identity (one ``all_gather`` over DCN), after which each process
-    holds complete addressable replicas.
+    Fully-addressable arrays (always the case single-process) and
+    fully-replicated ones (every process holds a complete copy, even when
+    other processes' replicas are non-addressable) fetch directly. An array
+    sharded across non-addressable devices — the multi-controller case,
+    where ``jax.device_get`` raises — is first replicated onto every device
+    with a jitted identity (one ``all_gather`` over DCN), after which each
+    process fetches its local replica. Verified by the 2-process run in
+    ``parallel/multihost.py`` / ``tests/test_multihost.py``.
     """
-    if getattr(x, "is_fully_addressable", True):
+    if getattr(x, "is_fully_addressable", True) or getattr(
+        x, "is_fully_replicated", False
+    ):
         return np.asarray(jax.device_get(x))
-    from jax.sharding import NamedSharding, PartitionSpec
+    from jax.sharding import NamedSharding
 
-    mesh = x.sharding.mesh
-    replicated = jax.jit(
-        lambda a: a, out_shardings=NamedSharding(mesh, PartitionSpec())
-    )(x)
-    return np.asarray(jax.device_get(replicated))
+    sharding = x.sharding
+    if not isinstance(sharding, NamedSharding):
+        raise TypeError(
+            "host_value needs a NamedSharding to replicate a "
+            f"non-addressable array; got {type(sharding).__name__}"
+        )
+    return np.asarray(jax.device_get(_replicator(sharding.mesh)(x)))
 
 
 def local_shard(x) -> np.ndarray:
@@ -158,6 +201,8 @@ def parse_mesh_shape(spec: str) -> Dict[str, int]:
 __all__ = [
     "DATA_AXIS",
     "SAMPLES_AXIS",
+    "PLATFORM_ENV",
+    "apply_platform_override",
     "distributed_init",
     "host_value",
     "local_shard",
