@@ -17,6 +17,8 @@ via an injectable ``transport`` callable.
 from __future__ import annotations
 
 import json
+import random
+import time
 import urllib.error
 import urllib.request
 from typing import Callable, Dict, Iterator, List, Mapping, Optional, Sequence
@@ -44,6 +46,12 @@ def _urllib_transport(url: str, payload: Mapping, headers: Mapping) -> Dict:
         return json.loads(response.read().decode("utf-8"))
 
 
+def _retryable_http(code: int) -> bool:
+    """5xx and 429 (rate-limit) are transient; other 4xx are caller errors
+    that no retry can fix (a bad variant-set id stays bad)."""
+    return code >= 500 or code == 429
+
+
 class RestClient(GenomicsClient):
     def __init__(
         self,
@@ -51,12 +59,20 @@ class RestClient(GenomicsClient):
         base_url: str = DEFAULT_BASE_URL,
         transport: Transport = _urllib_transport,
         max_retries: int = 3,
+        backoff_base: float = 0.5,
+        backoff_cap: float = 8.0,
+        sleep: Callable[[float], None] = time.sleep,
+        rng: Optional[random.Random] = None,
     ):
         super().__init__()
         self.auth = auth
         self.base_url = base_url.rstrip("/")
         self.transport = transport
         self.max_retries = max_retries
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self._sleep = sleep
+        self._rng = rng if rng is not None else random.Random()
 
     def _headers(self) -> Dict[str, str]:
         if self.auth and self.auth.access_token:
@@ -64,18 +80,31 @@ class RestClient(GenomicsClient):
         return {}
 
     def _post(self, path: str, payload: Mapping) -> Dict:
+        """POST with retries for transient failures only: exponential backoff
+        with full jitter (delay uniform in ``[0, min(cap, base·2^attempt)]``)
+        for 5xx/429/IO errors; non-retryable 4xx raises immediately. Every
+        attempt and failure feeds the reference's accounting counters
+        (``Client.scala:42-54``; report format ``pipeline/stats.py``)."""
         url = f"{self.base_url}/{path}"
         last_error: Optional[Exception] = None
-        for _ in range(self.max_retries):
+        for attempt in range(self.max_retries):
             self.counters.initialized_requests += 1
             try:
                 return self.transport(url, payload, self._headers())
             except urllib.error.HTTPError as e:
                 self.counters.unsuccessful_responses += 1
+                if not _retryable_http(e.code):
+                    raise RuntimeError(
+                        f"request to {url} failed with HTTP {e.code} "
+                        "(not retryable)"
+                    ) from e
                 last_error = e
             except (urllib.error.URLError, OSError) as e:
                 self.counters.io_exceptions += 1
                 last_error = e
+            if attempt + 1 < self.max_retries:
+                ceiling = min(self.backoff_cap, self.backoff_base * (2**attempt))
+                self._sleep(self._rng.uniform(0.0, ceiling))
         raise RuntimeError(f"request to {url} failed after retries") from last_error
 
     def _paginate(

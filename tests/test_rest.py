@@ -73,7 +73,9 @@ def test_retries_count_failures_then_succeed():
             {"variants": [_variant(1)]},
         ]
     )
-    client = RestClient(None, base_url="http://x", transport=transport)
+    client = RestClient(
+        None, base_url="http://x", transport=transport, sleep=lambda s: None
+    )
     got = list(client.search_variants({"start": 0, "end": 10}))
     assert len(got) == 1
     assert client.counters.initialized_requests == 3
@@ -86,11 +88,75 @@ def test_retries_exhausted_raises():
         [urllib.error.URLError("down")] * 3
     )
     client = RestClient(
-        None, base_url="http://x", transport=transport, max_retries=3
+        None,
+        base_url="http://x",
+        transport=transport,
+        max_retries=3,
+        sleep=lambda s: None,
     )
     with pytest.raises(RuntimeError, match="failed after retries"):
         list(client.search_variants({"start": 0, "end": 10}))
     assert client.counters.io_exceptions == 3
+
+
+def test_4xx_is_not_retried():
+    """A caller error (bad request/id/auth scope) raises immediately — no
+    retry can fix it, and hammering the server would be hostile."""
+    transport = FakeTransport(
+        [urllib.error.HTTPError("u", 404, "nope", {}, None)]
+    )
+    slept = []
+    client = RestClient(
+        None, base_url="http://x", transport=transport, sleep=slept.append
+    )
+    with pytest.raises(RuntimeError, match="HTTP 404"):
+        list(client.search_variants({"start": 0, "end": 10}))
+    assert client.counters.initialized_requests == 1
+    assert client.counters.unsuccessful_responses == 1
+    assert slept == []
+
+
+def test_429_is_retried():
+    """Rate-limiting is transient: retried like a 5xx."""
+    transport = FakeTransport(
+        [
+            urllib.error.HTTPError("u", 429, "slow down", {}, None),
+            {"variants": [_variant(1)]},
+        ]
+    )
+    client = RestClient(
+        None, base_url="http://x", transport=transport, sleep=lambda s: None
+    )
+    got = list(client.search_variants({"start": 0, "end": 10}))
+    assert len(got) == 1
+    assert client.counters.initialized_requests == 2
+
+
+def test_backoff_is_exponential_with_full_jitter():
+    """Delays are uniform in [0, min(cap, base·2^attempt)]: bounded by the
+    growing ceiling, and no sleep after the final attempt."""
+    import random
+
+    transport = FakeTransport([urllib.error.URLError("down")] * 4)
+    slept = []
+    client = RestClient(
+        None,
+        base_url="http://x",
+        transport=transport,
+        max_retries=4,
+        backoff_base=1.0,
+        backoff_cap=3.0,
+        sleep=slept.append,
+        rng=random.Random(0),
+    )
+    with pytest.raises(RuntimeError, match="failed after retries"):
+        client._post("variants/search", {})
+    assert len(slept) == 3  # one fewer than attempts
+    # Exactly the seeded jitter draws over the exponential ceilings
+    # (cap kicks in at attempt 3: min(3.0, 1.0·2²) = 3.0) — a regression
+    # to constant or zero backoff cannot reproduce this sequence.
+    mirror = random.Random(0)
+    assert slept == [mirror.uniform(0.0, c) for c in [1.0, 2.0, 3.0]]
 
 
 def test_auth_header_attached():
