@@ -17,14 +17,32 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from spark_examples_tpu.parallel.mesh import SAMPLES_AXIS
 
 
+def _dtypes(in_dtype):
+    """(compute, output) dtypes for centering.
+
+    Every similarity matrix is integer-valued by construction (0/1 operand
+    counts), and the reference centers in Double unconditionally
+    (``VariantsPca.scala:246-263``) — so when x64 is live, centering
+    arithmetic runs in float64 regardless of the carrier dtype (int32 exact
+    Gramians and f32 Gramians holding the same exact integers center
+    bit-identically; whole-genome counts exceed f32's 2^24 exact range).
+    The upcast happens inside the fused reduction/elementwise kernels, so no
+    f64 N×N is ever materialized; the OUTPUT stays in the eigensolve's
+    dtype (f32, or f64 for callers that passed f64 in)."""
+    wide = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    out = jnp.float64 if in_dtype == jnp.float64 else jnp.float32
+    return wide, out
+
+
 @jax.jit
 def gower_center(S: jax.Array) -> jax.Array:
     """B = S − rowMean − colMean + matrixMean (``VariantsPca.scala:252-263``)."""
-    S = S.astype(jnp.float64) if S.dtype == jnp.float64 else S.astype(jnp.float32)
-    row_mean = jnp.mean(S, axis=1, keepdims=True)
-    col_mean = jnp.mean(S, axis=0, keepdims=True)
-    total_mean = jnp.mean(S)
-    return S - row_mean - col_mean + total_mean
+    wide, out = _dtypes(S.dtype)
+    Sw = S.astype(wide)
+    row_mean = jnp.mean(Sw, axis=1, keepdims=True)
+    col_mean = jnp.mean(Sw, axis=0, keepdims=True)
+    total_mean = jnp.mean(Sw)
+    return (Sw - row_mean - col_mean + total_mean).astype(out)
 
 
 def gower_center_sharded(
@@ -38,11 +56,17 @@ def gower_center_sharded(
     taken over the true cohort size and padded rows/columns are re-zeroed
     after centering, so the padded result is exactly the dense result
     embedded in a zero block — eigenvectors and eigenvalues are unchanged.
+
+    Centering arithmetic runs in float64 when x64 is live (see
+    :func:`_dtypes`); the row-tile output is f32 either way — the downstream
+    sharded eigensolve's dtype.
     """
     n_padded = S.shape[0]
     n = n_padded if n_true is None else int(n_true)
+    wide, _ = _dtypes(S.dtype)
 
     def per_tile(S_local):
+        S_local = S_local.astype(wide)
         n_local = S_local.shape[0]
         row_start = jax.lax.axis_index(SAMPLES_AXIS) * n_local
         # Padded entries of S are zero by construction, so sums over the
@@ -55,7 +79,9 @@ def gower_center_sharded(
         out = S_local - row_mean - col_mean + total_mean
         row_mask = (row_start + jnp.arange(n_local)) < n
         col_mask = jnp.arange(S_local.shape[1]) < n
-        return jnp.where(row_mask[:, None] & col_mask[None, :], out, 0.0)
+        return jnp.where(
+            row_mask[:, None] & col_mask[None, :], out, 0.0
+        ).astype(jnp.float32)
 
     fn = shard_map(
         per_tile,
@@ -65,7 +91,7 @@ def gower_center_sharded(
     )
     return jax.jit(
         fn, out_shardings=NamedSharding(mesh, P(SAMPLES_AXIS, None))
-    )(S.astype(jnp.float32))
+    )(S)
 
 
 __all__ = ["gower_center", "gower_center_sharded"]

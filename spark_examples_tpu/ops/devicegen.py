@@ -208,15 +208,21 @@ def _fused_update(
     blocks_per_dispatch: int,
     operand_name: str,
     accum_name: str,
+    n_pops: int,
 ):
     """Build (and memoize) the scanned generate→accumulate program for one
     static configuration. Memoizing at module level means every accumulator
     with the same configuration — e.g. a warmup instance and a measured
     instance — shares one traced/compiled program instead of re-tracing per
-    instance."""
+    instance.
+
+    ``n_pops`` is the SOURCE's population count, passed explicitly rather
+    than inferred as ``pops.max()+1``: for a cohort smaller than the
+    population count the device must still compute every population's
+    threshold stream to stay bit-identical with the host path by
+    construction, not by accident."""
     operand_dtype = np.dtype(operand_name)
     accum_dtype = np.dtype(accum_name)
-    n_pops = int(np.frombuffer(pops_bytes, dtype=np.int32).max()) + 1
     K, B = blocks_per_dispatch, block_size
 
     with jax.enable_x64(True):
@@ -277,6 +283,7 @@ def _fused_update_mesh(
     blocks_per_dispatch: int,
     operand_name: str,
     accum_name: str,
+    n_pops: int,
     mesh,
 ):
     """The data-parallel (shard_map) wrapper of :func:`_fused_update`,
@@ -298,6 +305,7 @@ def _fused_update_mesh(
         blocks_per_dispatch,
         operand_name,
         accum_name,
+        n_pops,
     )
     g_spec = P(DATA_AXIS, None, None)
     r_spec = P(DATA_AXIS, None)
@@ -385,6 +393,17 @@ class _GridDispatchAccumulator:
         with jax.enable_x64(True):
             local_shard(self.kept_sites)
 
+    def sync(self) -> None:
+        """Block until the whole ingest chain has executed: one synchronous
+        fetch of a value that depends on every dispatch (``kept_sites``
+        threads through the scan carry). The cheap alternative to
+        :meth:`ingest_counters` when the counter VALUES aren't needed —
+        stage timing stays honest at half the fetch round-trips."""
+        from spark_examples_tpu.parallel.mesh import host_value
+
+        with jax.enable_x64(True):
+            host_value(self.kept_sites)
+
     def ingest_counters(self) -> Tuple[np.ndarray, int]:
         """``(per-set variant-row totals, kept-site total)``, synchronously
         fetched — valid in every process of a multi-controller run
@@ -427,6 +446,7 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
         blocks_per_dispatch: int = 32,
         exact_int: bool = True,
         mesh=None,
+        n_pops: Optional[int] = None,
     ):
         from spark_examples_tpu.ops.gramian import _operand_dtypes
         from spark_examples_tpu.parallel.mesh import DATA_AXIS
@@ -448,9 +468,10 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
         self.accum_dtype = accum_dtype
         self.dispatches = 0
 
+        pops32 = np.asarray(pops, dtype=np.int32)
         update_key = (
             tuple(int(k) for k in vs_keys),
-            np.asarray(pops, dtype=np.int32).tobytes(),
+            pops32.tobytes(),
             int(site_key),
             self.spacing,
             float(ref_block_fraction),
@@ -459,6 +480,9 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
             self.blocks_per_dispatch,
             np.dtype(operand_dtype).name,
             np.dtype(accum_dtype).name,
+            # Source-authoritative population count (falls back to inference
+            # for callers that predate the parameter).
+            int(n_pops) if n_pops is not None else int(pops32.max()) + 1,
         )
 
         D = self.data_parallel
@@ -551,8 +575,11 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
         ``VariantsPca.scala:230``)."""
         if self.data_parallel > 1:
             if not self.G.is_fully_addressable:
-                # Multi-controller: replicate so every process can fetch (and
-                # so downstream eager stages see a fully-addressable array).
+                # Multi-controller: replicate so every process can fetch.
+                # The result spans other processes' devices (so it is fully
+                # *replicated*, not fully *addressable*); host_value
+                # short-circuits on is_fully_replicated, so downstream
+                # fetches read the local replica without a second gather.
                 from jax.sharding import NamedSharding, PartitionSpec
 
                 return jax.jit(
@@ -582,12 +609,14 @@ def _ring_update(
     operand_name: str,
     num_samples: int,
     padded: int,
+    n_pops: int,
     mesh,
 ):
     """Memoized scanned generate→ring-accumulate program for one static
     configuration (warmup and measured accumulators share one compiled
     program, like :func:`_fused_update`). Signature of the returned jit:
-    ``(G, variant_rows, kept_sites, offsets, valids)``."""
+    ``(G, variant_rows, kept_sites, offsets, valids)``. ``n_pops`` is the
+    source's population count (see :func:`_fused_update`)."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -596,7 +625,6 @@ def _ring_update(
 
     operand_dtype = np.dtype(operand_name)
     pops_padded = np.frombuffer(pops_bytes, dtype=np.int32)
-    n_pops = int(pops_padded.max()) + 1
     n_local = padded // mesh.shape[SAMPLES_AXIS]
     K, B = blocks_per_dispatch, block_size
     data_axis = DATA_AXIS if DATA_AXIS in mesh.shape else None
@@ -687,6 +715,7 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
         block_size: int = 1024,
         blocks_per_dispatch: int = 8,
         exact_int: bool = True,
+        n_pops: Optional[int] = None,
     ):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -741,6 +770,9 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
             np.dtype(operand_dtype).name,
             self.num_samples,
             self.padded,
+            int(n_pops)
+            if n_pops is not None
+            else int(np.asarray(pops, dtype=np.int32).max()) + 1,
             mesh,
         )
 

@@ -385,6 +385,7 @@ class VariantsPcaDriver:
                 block_size=conf.block_size,
                 blocks_per_dispatch=conf.blocks_per_dispatch,
                 exact_int=True,
+                n_pops=source.n_pops,
             )
         else:
             acc = DeviceGenGramianAccumulator(
@@ -401,6 +402,7 @@ class VariantsPcaDriver:
                 blocks_per_dispatch=conf.blocks_per_dispatch,
                 exact_int=True,
                 mesh=mesh,
+                n_pops=source.n_pops,
             )
 
         self._device_gen_scanned = 0
@@ -432,10 +434,13 @@ class VariantsPcaDriver:
         # rather than leaving a flush for callers to remember keeps the
         # stats-parity invariant even if a later stage raises, and the
         # synchronous counter fetch makes the ingest stage's wall-clock
-        # honest on asynchronous backends.
-        per_set, _kept = acc.ingest_counters()
+        # honest on asynchronous backends. With stats disabled only the
+        # honesty sync remains (one fetch instead of two).
         if self.io_stats is not None:
+            per_set, _kept = acc.ingest_counters()
             self.io_stats.add_variants(int(per_set.sum()))
+        else:
+            acc.sync()
         return result
 
     def _host_similarity(self, calls: Iterable[List[int]]) -> np.ndarray:
@@ -481,7 +486,15 @@ class VariantsPcaDriver:
                 principal_components_subspace_sharded,
             )
 
-            centered = gower_center_sharded(similarity, sharded_mesh, n_true=n)
+            # Centering arithmetic in float64 (fused upcast, f32 tiles out):
+            # the reference centers in Double (``VariantsPca.scala:
+            # 246-263``), and whole-genome counts exceed f32's 2^24 exact
+            # range — this is what keeps --exact-similarity exact PAST the
+            # accumulator (ops/centering.py:_dtypes).
+            with jax.enable_x64(True):
+                centered = gower_center_sharded(
+                    similarity, sharded_mesh, n_true=n
+                )
             device_components, _ = principal_components_subspace_sharded(
                 centered, sharded_mesh, self.conf.num_pc, n_true=n
             )
@@ -497,13 +510,23 @@ class VariantsPcaDriver:
         else:
             # Subspace iteration, not full eigh: num_pc is tiny and XLA's TPU
             # eigh is pathologically slow at cohort sizes (see ops/pca.py).
-            S = jnp.asarray(similarity, dtype=jnp.float32)
-            centered = gower_center(S)
+            # f64 centering arithmetic under x64 (the reference's Double
+            # centering) with f32 out for the eigensolve; identical results
+            # for an int32 exact Gramian and an f32 Gramian holding the
+            # same integers (ops/centering.py:_dtypes). The asarray sits
+            # INSIDE the x64 block so a float64 host similarity (exact
+            # counts past 2^24) is not silently truncated to f32 on entry.
+            with jax.enable_x64(True):
+                S = jnp.asarray(similarity)
+                centered = gower_center(S)
+            centered = centered.astype(jnp.float32)
             device_components, _ = principal_components_subspace(
                 centered, self.conf.num_pc
             )
-            # All dispatches issued; fetching results is now safe.
-            nonzero = int(jax.device_get((S.sum(axis=1) > 0).sum()))
+            # All dispatches issued; fetching results is now safe. any()
+            # rather than sum() > 0: int32 row sums would overflow at
+            # whole-genome scale.
+            nonzero = int(jax.device_get(jnp.any(S != 0, axis=1).sum()))
             print(f"Non zero rows in matrix: {nonzero} / {n}.")
             components = np.asarray(
                 jax.device_get(device_components), dtype=np.float64

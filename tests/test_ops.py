@@ -113,6 +113,51 @@ def test_gower_center_semantics():
     np.testing.assert_allclose(B.sum(axis=1), 0, atol=1e-3)
 
 
+def test_centering_is_exact_past_f32_range_under_x64():
+    """The exactness guarantee holds PAST the accumulator: whole-genome
+    int32 counts above f32's 2^24 exact-integer range center in f64 under
+    x64 (the reference's Double centering, ``VariantsPca.scala:246-263``),
+    and an int32 exact Gramian and an f32 Gramian carrying the same
+    integers produce bit-identical f32 output."""
+    rng = np.random.default_rng(3)
+    n = 8
+    # Past f32's exact range (counts ~2^25, the whole-genome regime): only
+    # the int32 carrier exists in practice (the accumulator auto-switches
+    # BEFORE 2^24, ``ops/gramian.py:EXACT_F32_LIMIT``); its f64-centered
+    # result must equal the f64 oracle's rounding exactly.
+    S_big = (1 << 25) + rng.integers(0, 64, size=(n, n)).astype(np.int64)
+    S_big = (S_big + S_big.T) // 2
+    with jax.enable_x64(True):
+        got_big = np.asarray(
+            jax.device_get(gower_center(S_big.astype(np.int32)))
+        )
+    assert got_big.dtype == np.float32
+    np.testing.assert_array_equal(
+        got_big, VariantsPcaHostCenter(S_big).astype(np.float32)
+    )
+
+    # Within f32's exact range, both carrier dtypes (int32 exact / f32
+    # auto path holding the same integers) center bit-identically.
+    S = rng.integers(0, 1 << 20, size=(n, n)).astype(np.int64)
+    S = (S + S.T) // 2
+    with jax.enable_x64(True):
+        got_int = np.asarray(jax.device_get(gower_center(S.astype(np.int32))))
+        got_f32 = np.asarray(
+            jax.device_get(gower_center(S.astype(np.float32)))
+        )
+    np.testing.assert_array_equal(got_int, VariantsPcaHostCenter(S).astype(np.float32))
+    np.testing.assert_array_equal(got_f32, got_int)
+
+
+def VariantsPcaHostCenter(S: np.ndarray) -> np.ndarray:
+    """The reference's Double centering as a NumPy oracle."""
+    S = S.astype(np.float64)
+    n = S.shape[0]
+    row = S.sum(axis=1) / n
+    total = S.sum() / n / n
+    return S - row[:, None] - row[None, :] + total
+
+
 def test_gower_center_sharded_matches_dense():
     mesh = make_mesh({SAMPLES_AXIS: 4})
     rng = np.random.default_rng(8)
