@@ -23,25 +23,48 @@ def _source(conf: GenomicsConf):
     return pca_driver.make_source(conf)  # type: ignore[arg-type]
 
 
+def _readset_kwargs(conf: GenomicsConf, names: Sequence[str]) -> dict:
+    """For ``--source file``, route the file-derived set ids into the reads
+    examples' readset parameters (``names``, in ``--input-files`` order) —
+    the hardcoded Google public readset ids only exist on the sunset API."""
+    if conf.source != "file":
+        return {}
+    from spark_examples_tpu.sources.files import file_set_ids
+
+    ids = file_set_ids(conf.input_files or [])
+    if len(ids) < len(names):
+        raise ValueError(
+            f"this analysis needs {len(names)} --input-files "
+            f"({', '.join(names)} in order); got {len(ids)}"
+        )
+    return dict(zip(names, ids))
+
+
+def _variants_cmd(run_fn):
+    def invoke(argv):
+        conf = GenomicsConf.parse(argv)
+        return run_fn(conf, _source(conf))
+
+    return invoke
+
+
+def _reads_cmd(run_fn, readset_params: Sequence[str]):
+    def invoke(argv):
+        conf = GenomicsConf.parse(argv)
+        return run_fn(conf, _source(conf), **_readset_kwargs(conf, readset_params))
+
+    return invoke
+
+
 COMMANDS = {
     "variants-pca": lambda argv: pca_driver.run(argv),
-    "search-variants-klotho": lambda argv: variants_examples.run_klotho(
-        *(lambda c: (c, _source(c)))(GenomicsConf.parse(argv))
-    ),
-    "search-variants-brca1": lambda argv: variants_examples.run_brca1(
-        *(lambda c: (c, _source(c)))(GenomicsConf.parse(argv))
-    ),
-    "search-reads-example-1": lambda argv: reads_examples.run_example1(
-        *(lambda c: (c, _source(c)))(GenomicsConf.parse(argv))
-    ),
-    "search-reads-example-2": lambda argv: reads_examples.run_example2(
-        *(lambda c: (c, _source(c)))(GenomicsConf.parse(argv))
-    ),
-    "search-reads-example-3": lambda argv: reads_examples.run_example3(
-        *(lambda c: (c, _source(c)))(GenomicsConf.parse(argv))
-    ),
-    "search-reads-example-4": lambda argv: reads_examples.run_example4(
-        *(lambda c: (c, _source(c)))(GenomicsConf.parse(argv))
+    "search-variants-klotho": _variants_cmd(variants_examples.run_klotho),
+    "search-variants-brca1": _variants_cmd(variants_examples.run_brca1),
+    "search-reads-example-1": _reads_cmd(reads_examples.run_example1, ["readset"]),
+    "search-reads-example-2": _reads_cmd(reads_examples.run_example2, ["readset"]),
+    "search-reads-example-3": _reads_cmd(reads_examples.run_example3, ["readset"]),
+    "search-reads-example-4": _reads_cmd(
+        reads_examples.run_example4, ["normal_readset", "tumor_readset"]
     ),
 }
 

@@ -73,9 +73,19 @@ def _build_base_parser(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
     # TPU-native additions.
     parser.add_argument(
         "--source",
-        choices=["synthetic", "rest"],
+        choices=["synthetic", "rest", "file"],
         default="synthetic",
         help="Genomics backend to stream from.",
+    )
+    parser.add_argument(
+        "--input-files",
+        default=None,
+        help=(
+            "Comma-separated input files for --source file: .vcf[.gz] / "
+            ".jsonl[.gz] variants (or a checkpoint directory), .sam reads. "
+            "Each file becomes one variant set whose id is its sanitized "
+            "stem; --variant-set-id defaults to all of them in order."
+        ),
     )
     parser.add_argument(
         "--num-samples",
@@ -112,6 +122,7 @@ class GenomicsConf:
         default_factory=lambda: [GoogleGenomicsPublicData.THOUSAND_GENOMES_PHASE_1]
     )
     source: str = "synthetic"
+    input_files: Optional[List[str]] = None
     num_samples: int = 2504
     seed: int = 42
     coordinator_address: Optional[str] = None
@@ -145,6 +156,28 @@ class GenomicsConf:
             conf.variant_set_id = [
                 v for v in conf.variant_set_id.split(",") if v.strip()
             ]
+        if isinstance(conf.input_files, str):
+            conf.input_files = [
+                p.strip() for p in conf.input_files.split(",") if p.strip()
+            ]
+        if conf.source == "file":
+            if not conf.input_files:
+                raise ValueError("--source file requires --input-files")
+            from spark_examples_tpu.sources.files import file_set_ids
+
+            ids = file_set_ids(conf.input_files)
+            if conf.variant_set_id == [
+                GoogleGenomicsPublicData.THOUSAND_GENOMES_PHASE_1
+            ]:
+                # The untouched default: every input file is one variant set.
+                conf.variant_set_id = ids
+            elif not set(conf.variant_set_id) <= set(ids):
+                # An explicit id that matches no input must fail loudly, not
+                # silently widen the run back to every file.
+                raise ValueError(
+                    f"--variant-set-id {conf.variant_set_id} not among the "
+                    f"file-derived set ids {ids}"
+                )
         return conf
 
     def get_references(self) -> List[List[Contig]]:

@@ -95,6 +95,10 @@ def _samples_sharded_mesh(similarity):
 def make_source(conf: PcaConf) -> GenomicsSource:
     if conf.source == "synthetic":
         return SyntheticGenomicsSource(num_samples=conf.num_samples, seed=conf.seed)
+    if conf.source == "file":
+        from spark_examples_tpu.sources.files import FileGenomicsSource
+
+        return FileGenomicsSource(conf.input_files or [])
     from spark_examples_tpu.sources.base import get_access_token
     from spark_examples_tpu.sources.rest import RestGenomicsSource
 

@@ -1,0 +1,488 @@
+"""File-backed genomics source: VCF / wire-JSONL variants, SAM reads.
+
+The reference lived on real 1000 Genomes data served by the (since sunset)
+Google Genomics API (``rdd/VariantsRDD.scala:198-225``); its only offline
+ingest was resuming pre-materialized ``objectFile`` records
+(``VariantsPca.scala:112-113``). This source makes local files a first-class
+backend behind the same :class:`GenomicsSource` seam, so every pipeline
+(the PCoA driver, the seven example analyses) runs unchanged on real data:
+
+- ``*.vcf`` / ``*.vcf.gz`` — VCF 4.x text: sites, INFO (``AF`` feeds the
+  ``--min-allele-frequency`` filter), and per-sample GT calls.
+- ``*.jsonl`` / ``*.jsonl.gz`` — one wire-format variant dict per line (the
+  REST SearchVariants item shape), or the checkpoint entry shape
+  ``{"key": ..., "variant": ...}``; a checkpoint DIRECTORY
+  (``pipeline/checkpoint.py``) is read via its part files.
+- ``*.sam`` — SAM text alignments for the reads analyses.
+
+Files parse once into per-contig start-sorted tables; shard queries
+(``search_variants`` with STRICT/OVERLAPS boundaries) bisect into them, so
+the partitioner/window machinery drives this source exactly as it drives the
+REST and synthetic backends.
+
+Each file is one variant set (or read group set) whose id is the file's
+sanitized stem — e.g. ``/data/chr17.vcf.gz`` → ``chr17`` — with callset ids
+``<set>-<i>`` so ``emit_result``'s dataset split on ``-`` works
+(``VariantsPca.scala:275``).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import re
+import threading
+from bisect import bisect_left, bisect_right
+from typing import Dict, Iterator, List, Mapping, Optional, Sequence, Tuple
+
+from spark_examples_tpu.sharding.contig import (
+    Contig,
+    SexChromosomeFilter,
+    filter_sex_chromosomes,
+)
+from spark_examples_tpu.sources.base import (
+    GenomicsClient,
+    GenomicsSource,
+    ShardBoundary,
+)
+
+#: letter → wire operation (inverse of ``ReadBuilder.CIGAR_MATCH``,
+#: ``models/read.py``; SAM column 6).
+_CIGAR_OPS = {
+    "M": "ALIGNMENT_MATCH",
+    "H": "CLIP_HARD",
+    "S": "CLIP_SOFT",
+    "D": "DELETE",
+    "I": "INSERT",
+    "P": "PAD",
+    "=": "SEQUENCE_MATCH",
+    "X": "SEQUENCE_MISMATCH",
+    "N": "SKIP",
+}
+
+_CIGAR_RE = re.compile(r"(\d+)([MIDNSHP=X])")
+
+
+def file_set_id(path: str) -> str:
+    """A file's variant/read-group set id: the stem, sanitized so callset ids
+    ``<set>-<i>`` split unambiguously on the FIRST '-' (dashes and other
+    separators become '_')."""
+    stem = os.path.basename(path.rstrip("/"))
+    for suffix in (".gz", ".vcf", ".jsonl", ".sam"):
+        if stem.endswith(suffix):
+            stem = stem[: -len(suffix)]
+    sanitized = re.sub(r"[^A-Za-z0-9_.]", "_", stem)
+    return sanitized or "file"
+
+
+def file_set_ids(paths: Sequence[str]) -> List[str]:
+    """Set ids for a list of input files, in order; duplicates get a numeric
+    suffix so every file stays addressable."""
+    ids: List[str] = []
+    for path in paths:
+        base = file_set_id(path)
+        candidate, k = base, 1
+        while candidate in ids:
+            k += 1
+            candidate = f"{base}{k}"
+        ids.append(candidate)
+    return ids
+
+
+def _open_text(path: str):
+    return gzip.open(path, "rt") if path.endswith(".gz") else open(path, "rt")
+
+
+def _parse_vcf_info(text: str) -> Dict[str, List[str]]:
+    """``AF=0.02,0.1;DB;NS=60`` → ``{"AF": ["0.02", "0.1"], "DB": [], ...}``."""
+    info: Dict[str, List[str]] = {}
+    if text in (".", ""):
+        return info
+    for item in text.split(";"):
+        if "=" in item:
+            key, value = item.split("=", 1)
+            info[key] = value.split(",")
+        elif item:
+            info[item] = []
+    return info
+
+
+def _parse_genotype(gt: str) -> List[int]:
+    """``0|1`` / ``0/1`` → ``[0, 1]``; missing alleles ('.') → -1 (the GA4GH
+    convention; never counts as variation since only ``> 0`` does,
+    ``VariantsPca.scala:67``)."""
+    return [
+        -1 if allele in (".", "") else int(allele)
+        for allele in re.split(r"[/|]", gt)
+    ]
+
+
+def _parse_vcf(path: str, set_id: str):
+    """→ (callsets, {contig: (starts, records)}) with records start-sorted.
+
+    Wire-shape parity: VCF's 1-based POS becomes the half-open 0-based
+    ``[start, end)`` interval the API used (``start = POS-1``,
+    ``end = start + len(REF)``).
+    """
+    samples: List[str] = []
+    by_contig: Dict[str, List[Tuple[int, Dict]]] = {}
+    with _open_text(path) as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            if line.startswith("##"):
+                continue
+            if line.startswith("#CHROM"):
+                columns = line.split("\t")
+                samples = columns[9:] if len(columns) > 9 else []
+                continue
+            fields = line.split("\t")
+            if len(fields) < 8:
+                raise ValueError(
+                    f"{path}: malformed VCF data line (<8 fields): {line[:80]!r}"
+                )
+            chrom, pos, vid, ref, alt = fields[:5]
+            start = int(pos) - 1
+            record: Dict = {
+                "referenceName": chrom,
+                "variantSetId": set_id,
+                "id": vid if vid != "." else f"{chrom}:{pos}:{ref}",
+                "start": start,
+                "end": start + len(ref),
+                "referenceBases": ref,
+                "info": _parse_vcf_info(fields[7]),
+            }
+            if vid != ".":
+                record["names"] = vid.split(";")
+            if alt not in (".", ""):
+                record["alternateBases"] = alt.split(",")
+            if len(fields) > 9 and samples:
+                format_keys = fields[8].split(":")
+                try:
+                    gt_index = format_keys.index("GT")
+                except ValueError:
+                    gt_index = None
+                calls = []
+                for i, sample_field in enumerate(fields[9 : 9 + len(samples)]):
+                    call: Dict = {
+                        "callSetId": f"{set_id}-{i}",
+                        "callSetName": samples[i],
+                        "genotype": [],
+                    }
+                    if gt_index is not None:
+                        parts = sample_field.split(":")
+                        if gt_index < len(parts):
+                            call["genotype"] = _parse_genotype(parts[gt_index])
+                    calls.append(call)
+                record["calls"] = calls
+            by_contig.setdefault(chrom, []).append((start, record))
+    callsets = [
+        {"id": f"{set_id}-{i}", "name": name} for i, name in enumerate(samples)
+    ]
+    return callsets, _finish_tables(by_contig)
+
+
+def _parse_jsonl(path: str, set_id: str):
+    """Wire-format JSON lines (bare variant dicts, or checkpoint entries
+    ``{"key": ..., "variant": ...}``). The cohort is taken from the first
+    record carrying calls (1000G-style uniform cohorts)."""
+    by_contig: Dict[str, List[Tuple[int, Dict]]] = {}
+    callsets: List[Dict] = []
+    with _open_text(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            entry = json.loads(line)
+            record = entry["variant"] if "variant" in entry else entry
+            record = dict(record)
+            record.setdefault("variantSetId", set_id)
+            if not callsets and record.get("calls"):
+                callsets = [
+                    {
+                        "id": c.get("callSetId"),
+                        "name": c.get("callSetName") or c.get("callSetId"),
+                    }
+                    for c in record["calls"]
+                ]
+            by_contig.setdefault(record["referenceName"], []).append(
+                (int(record["start"]), record)
+            )
+    return callsets, _finish_tables(by_contig)
+
+
+def _parse_sam(path: str, set_id: str):
+    """SAM text → per-contig start-sorted read wire dicts (the SearchReads
+    item shape ``ReadBuilder.build`` consumes, ``models/read.py``)."""
+    by_contig: Dict[str, List[Tuple[int, Dict]]] = {}
+    with _open_text(path) as f:
+        for line_no, line in enumerate(f):
+            line = line.rstrip("\n")
+            if not line or line.startswith("@"):
+                continue
+            fields = line.split("\t")
+            if len(fields) < 11:
+                raise ValueError(
+                    f"{path}: malformed SAM data line (<11 fields): {line[:80]!r}"
+                )
+            qname, _flag, rname, pos, mapq, cigar, rnext, pnext, tlen, seq, qual = (
+                fields[:11]
+            )
+            if rname == "*":
+                continue  # unmapped: no position to shard on
+            start = int(pos) - 1
+            record: Dict = {
+                "id": f"{set_id}:{line_no}",
+                "fragmentName": qname,
+                "readGroupSetId": set_id,
+                "alignedSequence": "" if seq == "*" else seq,
+                "fragmentLength": int(tlen),
+                "alignment": {
+                    "position": {"referenceName": rname, "position": start},
+                    "mappingQuality": int(mapq),
+                    "cigar": [
+                        {
+                            "operationLength": int(length),
+                            "operation": _CIGAR_OPS[op],
+                        }
+                        for length, op in _CIGAR_RE.findall(cigar)
+                    ],
+                },
+            }
+            if qual != "*":
+                record["alignedQuality"] = [ord(c) - 33 for c in qual]
+            if rnext != "*":
+                record["nextMatePosition"] = {
+                    "referenceName": rname if rnext == "=" else rnext,
+                    "position": int(pnext) - 1,
+                }
+            by_contig.setdefault(rname, []).append((start, record))
+    return [], _finish_tables(by_contig)
+
+
+def _finish_tables(
+    by_contig: Dict[str, List[Tuple[int, Dict]]],
+) -> Dict[str, Tuple[List[int], List[Dict]]]:
+    tables = {}
+    for contig, items in by_contig.items():
+        items.sort(key=lambda pair: pair[0])
+        tables[contig] = (
+            [start for start, _ in items],
+            [record for _, record in items],
+        )
+    return tables
+
+
+def _load(path: str, set_id: str):
+    if os.path.isdir(path):
+        # A checkpoint directory (``pipeline/checkpoint.py``): concatenation
+        # of its part files. A directory with no part files is a wrong path
+        # (e.g. the checkpoint's parent), not an empty cohort — fail loudly.
+        parts = [n for n in sorted(os.listdir(path)) if n.startswith("part-")]
+        if not parts:
+            raise ValueError(
+                f"{path!r} is a directory with no part-* files; expected a "
+                "checkpoint directory written by save_variants "
+                "(pipeline/checkpoint.py)"
+            )
+        callsets: List[Dict] = []
+        merged: Dict[str, List[Tuple[int, Dict]]] = {}
+        for name in parts:
+            part_callsets, tables = _parse_jsonl(os.path.join(path, name), set_id)
+            callsets = callsets or part_callsets
+            for contig, (starts, records) in tables.items():
+                merged.setdefault(contig, []).extend(zip(starts, records))
+        return callsets, _finish_tables(merged), "variants"
+    lowered = path[:-3] if path.endswith(".gz") else path
+    if lowered.endswith(".vcf"):
+        callsets, tables = _parse_vcf(path, set_id)
+        return callsets, tables, "variants"
+    if lowered.endswith(".jsonl"):
+        callsets, tables = _parse_jsonl(path, set_id)
+        return callsets, tables, "variants"
+    if lowered.endswith(".sam"):
+        callsets, tables = _parse_sam(path, set_id)
+        return callsets, tables, "reads"
+    raise ValueError(
+        f"unsupported input file {path!r}: expected .vcf[.gz], .jsonl[.gz], "
+        ".sam, or a checkpoint directory"
+    )
+
+
+class _FileTable:
+    """One parsed file: per-contig start-sorted records + bisect queries."""
+
+    def __init__(self, path: str, set_id: str):
+        self.path = path
+        self.set_id = set_id
+        self.callsets, self.tables, self.kind = _load(path, set_id)
+
+    def query(
+        self, contig: str, start: int, end: int, boundary: ShardBoundary
+    ) -> Iterator[Dict]:
+        starts, records = self.tables.get(contig, ([], []))
+        if boundary is ShardBoundary.STRICT:
+            # Exactly the records whose start lies in [start, end).
+            lo = bisect_left(starts, start)
+            hi = bisect_right(starts, end - 1, lo=lo)
+            yield from records[lo:hi]
+            return
+        # OVERLAPS: any record intersecting [start, end). Starts are sorted
+        # but ends are not, so scan the prefix with start < end and filter.
+        hi = bisect_right(starts, end - 1)
+        for record in records[:hi]:
+            if _record_end(record) > start:
+                yield record
+
+    def contigs(self) -> List[Contig]:
+        return [
+            Contig(name, 0, (starts[-1] if starts else 0) + _max_span(records))
+            for name, (starts, records) in sorted(self.tables.items())
+        ]
+
+
+def _record_end(record: Dict) -> int:
+    """Half-open end of a variant or read record. Reads derive theirs from
+    the reference-consuming CIGAR operations (M/D/N/=/X), the SAM span."""
+    alignment = record.get("alignment")
+    if alignment is None:
+        return int(record.get("end", int(record["start"]) + 1))
+    position = int(alignment["position"]["position"])
+    span = sum(
+        int(unit["operationLength"])
+        for unit in alignment.get("cigar", [])
+        if unit["operation"]
+        in ("ALIGNMENT_MATCH", "DELETE", "SKIP", "SEQUENCE_MATCH", "SEQUENCE_MISMATCH")
+    )
+    return position + max(1, span)
+
+
+def _record_start(record: Dict) -> int:
+    alignment = record.get("alignment")
+    if alignment is None:
+        return int(record["start"])
+    return int(alignment["position"]["position"])
+
+
+def _max_span(records: List[Dict]) -> int:
+    """Upper-bound span of the LAST few records (for a contig's bound)."""
+    return max(
+        (max(1, _record_end(r) - _record_start(r)) for r in records[-64:]),
+        default=1,
+    )
+
+
+class FileClient(GenomicsClient):
+    """A per-partition session over the shared parsed tables; counts one
+    initialized request per page of results (REST-parity accounting)."""
+
+    def __init__(self, tables: Mapping[str, _FileTable]):
+        super().__init__()
+        self._tables = tables
+
+    def _search(
+        self, set_ids: Sequence[str], request: Mapping, boundary, page_size: int
+    ) -> Iterator[Dict]:
+        contig = request["referenceName"]
+        start = int(request.get("start", 0))
+        end = int(request.get("end", 1 << 62))
+        emitted = 0
+        for set_id in set_ids:
+            table = self._tables.get(set_id)
+            if table is None:
+                raise KeyError(
+                    f"unknown set id {set_id!r}; have {sorted(self._tables)}"
+                )
+            for record in table.query(contig, start, end, boundary):
+                if emitted % page_size == 0:
+                    self.counters.initialized_requests += 1
+                emitted += 1
+                yield record
+        if emitted == 0:
+            self.counters.initialized_requests += 1  # the empty page
+
+    def search_variants(
+        self,
+        request: Mapping,
+        boundary: ShardBoundary = ShardBoundary.STRICT,
+        page_size: int = 1024,
+    ) -> Iterator[Dict]:
+        return self._search(
+            request["variantSetIds"], request, boundary, page_size
+        )
+
+    def search_reads(
+        self,
+        request: Mapping,
+        boundary: ShardBoundary = ShardBoundary.STRICT,
+        page_size: int = 1024,
+    ) -> Iterator[Dict]:
+        return self._search(
+            request["readGroupSetIds"], request, boundary, page_size
+        )
+
+
+class FileGenomicsSource(GenomicsSource):
+    """Local files behind the :class:`GenomicsSource` seam.
+
+    ``paths`` maps each file to a set id (``file_set_ids``); each file parses
+    once, lazily, under a lock (per-shard worker threads all call
+    :meth:`client` concurrently — without the lock each would re-parse every
+    file) and the tables are shared by every client session.
+    """
+
+    def __init__(self, paths: Sequence[str]):
+        if not paths:
+            raise ValueError("--source file needs --input-files")
+        self.paths = list(paths)
+        self.set_ids = file_set_ids(self.paths)
+        self._by_id = dict(zip(self.set_ids, self.paths))
+        self._tables: Dict[str, _FileTable] = {}
+        self._lock = threading.Lock()
+
+    def _table(self, set_id: str) -> _FileTable:
+        with self._lock:
+            table = self._tables.get(set_id)
+            if table is None:
+                if set_id not in self._by_id:
+                    raise KeyError(
+                        f"unknown set id {set_id!r}; inputs are {self.set_ids}"
+                    )
+                table = _FileTable(self._by_id[set_id], set_id)
+                self._tables[set_id] = table
+            return table
+
+    def client(self) -> FileClient:
+        # Materialize every table so client sessions share one parsed copy.
+        for set_id in self.set_ids:
+            self._table(set_id)
+        return FileClient(self._tables)
+
+    def search_callsets(self, variant_set_ids: Sequence[str]) -> List[Dict]:
+        out: List[Dict] = []
+        seen = set()
+        for set_id in variant_set_ids:
+            if set_id in seen:
+                continue
+            seen.add(set_id)
+            out.extend(self._table(set_id).callsets)
+        return out
+
+    def get_contigs(
+        self,
+        variant_set_id: str,
+        sex_filter: SexChromosomeFilter = SexChromosomeFilter.INCLUDE_XY,
+    ) -> List[Contig]:
+        return filter_sex_chromosomes(
+            self._table(variant_set_id).contigs(), sex_filter
+        )
+
+
+__all__ = [
+    "FileGenomicsSource",
+    "FileClient",
+    "file_set_id",
+    "file_set_ids",
+]

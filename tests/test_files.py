@@ -1,0 +1,286 @@
+"""File-backed source: VCF/JSONL variants and SAM reads through the same
+partitioner/STRICT machinery as every other backend (the real-data ingest
+path the reference lived on, ``rdd/VariantsRDD.scala:198-225``)."""
+
+import gzip
+import textwrap
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.models.read import ReadBuilder
+from spark_examples_tpu.pipeline import pca_driver
+from spark_examples_tpu.sources.base import ShardBoundary
+from spark_examples_tpu.sources.files import (
+    FileGenomicsSource,
+    file_set_id,
+    file_set_ids,
+)
+
+_VCF = textwrap.dedent(
+    """\
+    ##fileformat=VCFv4.2
+    ##INFO=<ID=AF,Number=A,Type=Float,Description="Allele Frequency">
+    #CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tNA00001\tNA00002\tNA00003
+    17\t101\trs1\tA\tG\t50\tPASS\tAF=0.5\tGT\t0|1\t1|1\t0|0
+    17\t205\t.\tT\tC\t50\tPASS\tAF=0.02\tGT\t0/0\t0/1\t./.
+    17\t309\trs3\tG\tA,T\t50\tPASS\tAF=0.3,0.1\tGT\t1|2\t0|0\t0|1
+    17\t401\trs4\tC\tT\t50\tPASS\tNS=3\tGT\t0|0\t1|0\t1|1
+    GL000229.1\t42\trs6\tA\tT\t50\tPASS\tAF=0.5\tGT\t0|1\t0|0\t0|0
+    """
+)
+
+_SAM = textwrap.dedent(
+    """\
+    @HD\tVN:1.6\tSO:coordinate
+    @SQ\tSN:17\tLN:81195210
+    r001\t99\t17\t101\t60\t8M2I4M\t=\t161\t75\tTTAGATAAAGGATA\tFFFFFFFFFFFFFF
+    r002\t0\t17\t120\t30\t5M5D5M\t*\t0\t0\tAGCTAAGCTA\t*
+    r003\t4\t*\t0\t0\t*\t*\t0\t0\tAAAA\tFFFF
+    """
+)
+
+
+def _write(tmp_path, name, text, compress=False):
+    path = tmp_path / name
+    if compress:
+        with gzip.open(path, "wt") as f:
+            f.write(text)
+    else:
+        path.write_text(text)
+    return str(path)
+
+
+def test_set_ids_are_sanitized_and_unique(tmp_path):
+    assert file_set_id("/data/chr17.vcf.gz") == "chr17"
+    assert file_set_id("/data/my-cohort.2.jsonl") == "my_cohort.2"
+    assert file_set_ids(["/a/x.vcf", "/b/x.vcf"]) == ["x", "x2"]
+
+
+def test_vcf_wire_shape_and_callsets(tmp_path):
+    source = FileGenomicsSource([_write(tmp_path, "mini.vcf", _VCF)])
+    callsets = source.search_callsets(["mini"])
+    assert [c["name"] for c in callsets] == ["NA00001", "NA00002", "NA00003"]
+    assert [c["id"] for c in callsets] == ["mini-0", "mini-1", "mini-2"]
+
+    client = source.client()
+    got = list(
+        client.search_variants(
+            {"variantSetIds": ["mini"], "referenceName": "17", "start": 0, "end": 500}
+        )
+    )
+    assert [v["start"] for v in got] == [100, 204, 308, 400]  # 1-based → 0-based
+    first = got[0]
+    assert first["end"] == 101 and first["referenceBases"] == "A"
+    assert first["alternateBases"] == ["G"]
+    assert first["info"]["AF"] == ["0.5"]
+    assert first["names"] == ["rs1"]
+    assert [c["genotype"] for c in first["calls"]] == [[0, 1], [1, 1], [0, 0]]
+    # Missing alleles (./.) become -1: never counted as variation.
+    assert got[1]["calls"][2]["genotype"] == [-1, -1]
+    # Multi-allelic ALT splits; flag-style INFO keys parse to empty lists.
+    assert got[2]["alternateBases"] == ["A", "T"]
+    assert got[3]["info"]["NS"] == ["3"]
+
+
+def test_strict_vs_overlaps_boundaries(tmp_path):
+    source = FileGenomicsSource([_write(tmp_path, "mini.vcf", _VCF)])
+    client = source.client()
+    request = {"variantSetIds": ["mini"], "referenceName": "17", "start": 205, "end": 310}
+    strict = list(client.search_variants(request, ShardBoundary.STRICT))
+    assert [v["start"] for v in strict] == [308]
+    overlaps = list(client.search_variants(request, ShardBoundary.OVERLAPS))
+    # rs2 (start 204, end 205) does NOT overlap [205, 310); rs1 ends at 101.
+    assert [v["start"] for v in overlaps] == [308]
+    wide = list(
+        client.search_variants(
+            {**request, "start": 100}, ShardBoundary.OVERLAPS
+        )
+    )
+    assert [v["start"] for v in wide] == [100, 204, 308]
+
+
+def test_contig_discovery_and_af_filter(tmp_path):
+    path = _write(tmp_path, "mini.vcf.gz", _VCF, compress=True)
+    source = FileGenomicsSource([path])
+    contigs = {c.reference_name: c for c in source.get_contigs("mini")}
+    assert "17" in contigs and contigs["17"].end >= 401
+    # End-to-end with the AF filter: variants without AF and with AF below
+    # the threshold drop (strictly-greater, first AF value —
+    # ``VariantsPca.scala:136-148``); rs2 (0.02) and rs4 (no AF) go.
+    lines = pca_driver.run(
+        [
+            "--source", "file", "--input-files", path,
+            "--references", "17:0:1000",
+            "--pca-backend", "host",
+            "--min-allele-frequency", "0.05",
+        ]
+    )
+    assert len(lines) == 3  # one per sample, PCs from rs1+rs3 only
+
+
+def test_vcf_run_tpu_matches_host_oracle(tmp_path):
+    path = _write(tmp_path, "mini.vcf", _VCF)
+    argv = [
+        "--source", "file", "--input-files", path, "--references", "17:0:1000",
+    ]
+    tpu_lines = pca_driver.run(argv)
+    host_lines = pca_driver.run(argv + ["--pca-backend", "host"])
+    assert [l.split("\t")[:2] for l in tpu_lines] == [
+        l.split("\t")[:2] for l in host_lines
+    ]
+    P_tpu = np.array([[float(p) for p in l.split("\t")[2:]] for l in tpu_lines])
+    P_host = np.array([[float(p) for p in l.split("\t")[2:]] for l in host_lines])
+    # Eigenvector sign is arbitrary per component; align before comparing.
+    signs = np.sign((P_tpu * P_host).sum(axis=0))
+    signs[signs == 0] = 1.0
+    np.testing.assert_allclose(P_tpu, P_host * signs, atol=1e-5)
+
+
+def test_two_vcf_join(tmp_path):
+    """Two file-backed variant sets take the reference's 2-set inner-join
+    path (``VariantsPca.scala:155-168``): matching variant keys concatenate
+    both cohorts' calls."""
+    a = _write(tmp_path, "cohort_a.vcf", _VCF)
+    b = _write(tmp_path, "cohort_b.vcf", _VCF)
+    lines = pca_driver.run(
+        [
+            "--source", "file", "--input-files", f"{a},{b}",
+            "--references", "17:0:1000;17:0:1000",
+            "--pca-backend", "host",
+        ]
+    )
+    assert len(lines) == 6  # both cohorts' samples
+    datasets = {line.split("\t")[1] for line in lines}
+    assert datasets == {"cohort_a", "cohort_b"}
+
+
+def test_checkpoint_directory_as_input(tmp_path):
+    """A checkpoint written by the pipeline reads back through --input-files
+    (the promotion of the reader into a first-class source)."""
+    from spark_examples_tpu.models.variant import VariantsBuilder
+    from spark_examples_tpu.pipeline.checkpoint import save_variants
+
+    source = FileGenomicsSource([_write(tmp_path, "mini.vcf", _VCF)])
+    client = source.client()
+    records = [
+        VariantsBuilder.build(wire)
+        for wire in client.search_variants(
+            {"variantSetIds": ["mini"], "referenceName": "17", "start": 0, "end": 1000}
+        )
+    ]
+    ckpt = tmp_path / "ckpt"
+    save_variants(str(ckpt), [[r for r in records if r is not None]])
+
+    lines_vcf = pca_driver.run(
+        [
+            "--source", "file", "--input-files", str(tmp_path / "mini.vcf"),
+            "--references", "17:0:1000", "--pca-backend", "host",
+        ]
+    )
+    lines_ckpt = pca_driver.run(
+        [
+            "--source", "file", "--input-files", str(ckpt),
+            "--references", "17:0:1000", "--pca-backend", "host",
+        ]
+    )
+    # Same cohort, same variants, same PCs (names come from the callsets).
+    assert [l.split("\t")[2:] for l in lines_ckpt] == [
+        l.split("\t")[2:] for l in lines_vcf
+    ]
+
+
+def test_sam_reads_roundtrip(tmp_path):
+    source = FileGenomicsSource([_write(tmp_path, "sample.sam", _SAM)])
+    client = source.client()
+    got = list(
+        client.search_reads(
+            {"readGroupSetIds": ["sample"], "referenceName": "17", "start": 0, "end": 1000}
+        )
+    )
+    assert len(got) == 2  # the unmapped read (rname '*') is dropped
+    key, read = ReadBuilder.build(got[0])
+    assert read.position == 100 and read.cigar == "8M2I4M"
+    assert read.fragment_name == "r001"
+    assert read.mate_position == 160 and read.mate_reference_name == "17"
+    assert read.aligned_quality[0] == 37  # 'F' → Q37
+    _, read2 = ReadBuilder.build(got[1])
+    assert read2.cigar == "5M5D5M" and read2.aligned_quality == ()
+    # OVERLAPS spans the deletion: r002 covers [119, 134) on the reference.
+    overlapping = list(
+        client.search_reads(
+            {"readGroupSetIds": ["sample"], "referenceName": "17", "start": 130, "end": 140},
+            ShardBoundary.OVERLAPS,
+        )
+    )
+    assert [r["fragmentName"] for r in overlapping] == ["r002"]
+
+
+def test_missing_input_files_flag_raises():
+    with pytest.raises(ValueError, match="input-files"):
+        pca_driver.run(["--source", "file"])
+
+
+def test_unknown_explicit_variant_set_id_raises(tmp_path):
+    """A typo'd --variant-set-id must fail loudly, not silently widen the
+    run to every input file."""
+    path = _write(tmp_path, "mini.vcf", _VCF)
+    with pytest.raises(ValueError, match="tyop"):
+        pca_driver.run(
+            [
+                "--source", "file", "--input-files", path,
+                "--variant-set-id", "tyop",
+            ]
+        )
+
+
+def test_narrowed_variant_set_id_is_kept(tmp_path):
+    a = _write(tmp_path, "cohort_a.vcf", _VCF)
+    b = _write(tmp_path, "cohort_b.vcf", _VCF)
+    lines = pca_driver.run(
+        [
+            "--source", "file", "--input-files", f"{a}, {b}",  # stray space OK
+            "--variant-set-id", "cohort_b",
+            "--references", "17:0:1000",
+            "--pca-backend", "host",
+        ]
+    )
+    assert {line.split("\t")[1] for line in lines} == {"cohort_b"}
+
+
+def test_non_checkpoint_directory_raises(tmp_path):
+    empty = tmp_path / "not_a_checkpoint"
+    empty.mkdir()
+    with pytest.raises(ValueError, match="part-"):
+        pca_driver.run(
+            [
+                "--source", "file", "--input-files", str(empty),
+                "--references", "17:0:1000", "--pca-backend", "host",
+            ]
+        )
+
+
+def test_reads_example_cli_runs_on_sam(tmp_path, capsys):
+    """The reads analyses are reachable from the CLI on a SAM file: the
+    file-derived set id routes into the example's readset parameter."""
+    from spark_examples_tpu.cli import main
+    from spark_examples_tpu.constants import Examples
+
+    snp = Examples.CILANTRO
+    sam = "@HD\tVN:1.6\n@SQ\tSN:11\tLN:135006516\n" + "".join(
+        f"r{i:03d}\t0\t11\t{snp - 20 + i}\t60\t40M\t*\t0\t0\t{'ACGT' * 10}\t{'F' * 40}\n"
+        for i in range(10)
+    )
+    path = _write(tmp_path, "pileup.sam", sam)
+    rc = main(["search-reads-example-1", "--source", "file", "--input-files", path])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "(37)" in out  # pileup rows print the SNP base quality inline
+
+
+def test_reads_example4_needs_two_files(tmp_path):
+    from spark_examples_tpu.cli import main
+
+    path = _write(tmp_path, "only_one.sam", _SAM)
+    with pytest.raises(ValueError, match="normal_readset, tumor_readset"):
+        main(["search-reads-example-4", "--source", "file", "--input-files", path])
