@@ -32,6 +32,20 @@ from spark_examples_tpu.sharding.contig import (
 )
 
 
+def _num_samples_value(text: str) -> str:
+    """Validate ``--num-samples`` (an int, or a comma list of ints) at parse
+    time so malformed input gets argparse's usage error, not a traceback."""
+    values = [v for v in text.split(",") if v.strip()]
+    if not values:
+        raise argparse.ArgumentTypeError("needs at least one value")
+    for v in values:
+        try:
+            int(v)
+        except ValueError:
+            raise argparse.ArgumentTypeError(f"invalid int value: {v!r}")
+    return text
+
+
 def _build_base_parser(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument(
         "--bases-per-partition",
@@ -89,9 +103,15 @@ def _build_base_parser(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
     )
     parser.add_argument(
         "--num-samples",
-        type=int,
-        default=2504,
-        help="Synthetic-source cohort size (1KG phase 1 has 2,504 samples).",
+        type=_num_samples_value,
+        default="2504",
+        help=(
+            "Synthetic-source cohort size (1KG phase 1 has 2,504 samples). "
+            "A comma-separated list gives per-variant-set cohort sizes, "
+            "zipped positionally with --variant-set-id (e.g. '2504,17' for "
+            "the 1KG × Platinum joint-cohort scenario); sets beyond the "
+            "list use the first value."
+        ),
     )
     parser.add_argument(
         "--seed", type=int, default=42, help="Synthetic-source base seed."
@@ -124,6 +144,7 @@ class GenomicsConf:
     source: str = "synthetic"
     input_files: Optional[List[str]] = None
     num_samples: int = 2504
+    num_samples_per_set: Optional[List[int]] = None
     seed: int = 42
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
@@ -160,6 +181,24 @@ class GenomicsConf:
             conf.input_files = [
                 p.strip() for p in conf.input_files.split(",") if p.strip()
             ]
+        if isinstance(conf.num_samples, str):
+            sizes = [
+                int(s) for s in conf.num_samples.split(",") if s.strip()
+            ]
+            if not sizes:
+                raise ValueError("--num-samples needs at least one value")
+            conf.num_samples = sizes[0]
+            conf.num_samples_per_set = sizes if len(sizes) > 1 else None
+        if conf.num_samples_per_set and len(set(conf.variant_set_id)) != len(
+            conf.variant_set_id
+        ):
+            # Per-set sizes are keyed by set id downstream; duplicate ids
+            # would silently collapse to one size instead of the positional
+            # sizes the flag documents.
+            raise ValueError(
+                "per-set --num-samples requires distinct --variant-set-id "
+                "values (duplicate ids share one cohort)"
+            )
         if conf.source == "file":
             if not conf.input_files:
                 raise ValueError("--source file requires --input-files")

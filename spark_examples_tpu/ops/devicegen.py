@@ -141,9 +141,10 @@ def generate_has_variation(
     positions: jax.Array,  # (B,) int64
     thresholds: jax.Array,  # (B, P) uint64 Q53 thresholds, 0 = dropped
     vs_keys: jax.Array,  # (S,) uint64: per-variant-set genotype stream keys
-    pops: jax.Array,  # (N,) int32: sample → population
+    pops: jax.Array,  # (N_total,) int32: per-set cohorts' sample → population
+    set_sizes: Optional[Tuple[int, ...]] = None,  # per-set cohort sizes
 ) -> jax.Array:
-    """(B, S*N) {0,1} has-variation rows, bitwise-equal to the host packed
+    """(B, ΣNₛ) {0,1} has-variation rows, bitwise-equal to the host packed
     path (``sources/synthetic.py:genotype_blocks``) for kept sites; rows
     whose thresholds are zeroed come out all-zero (contribute nothing to
     XᵀX).
@@ -153,16 +154,32 @@ def generate_has_variation(
     the reference's 2-set join and ≥3-set merge-intersect
     (``VariantsPca.scala:155-188``) both reduce to column concatenation of
     per-set genotype matrices; ``vs_keys`` carries one stream per set.
+    Cohorts may differ per set (the 1KG × Platinum scenario): ``pops`` is
+    the concatenation of each set's population vector and ``set_sizes``
+    splits it. With ``set_sizes`` omitted, every set shares the one cohort
+    ``pops`` describes.
     """
-    n = pops.shape[0]
-    samples = (jnp.arange(n, dtype=jnp.uint64) * _c64(_P4))[None, :]
+    n_sets = vs_keys.shape[0]
+    if set_sizes is None:
+        set_sizes = (pops.shape[0],) * n_sets
+        pops_per_set = [pops] * n_sets
+    else:
+        offsets = np.concatenate([[0], np.cumsum(set_sizes)])
+        pops_per_set = [
+            lax.slice_in_dim(pops, int(offsets[s]), int(offsets[s + 1]))
+            for s in range(n_sets)
+        ]
     pos_term = positions.astype(jnp.uint64) * _c64(_P2)
-    t_full = jnp.take(thresholds, pops, axis=1)  # (B, N)
     parts = []
-    for s in range(vs_keys.shape[0]):
+    for s in range(n_sets):
+        pops_s = pops_per_set[s]
+        samples = (
+            jnp.arange(set_sizes[s], dtype=jnp.uint64) * _c64(_P4)
+        )[None, :]
+        t_full = jnp.take(thresholds, pops_s, axis=1)  # (B, N_s)
         h1 = mix64(vs_keys[s] ^ pos_term)  # (B,)
         h2 = mix64(h1 ^ _c64(_S_GENOTYPE * _P3))
-        h3 = mix64(h2[:, None] ^ samples)  # (B, N)
+        h3 = mix64(h2[:, None] ^ samples)  # (B, N_s)
         m1 = mix64(h3 ^ _c64(1 * _P1)) >> jnp.uint64(11)
         m2 = mix64(h3 ^ _c64(2 * _P1)) >> jnp.uint64(11)
         parts.append((m1 < t_full) | (m2 < t_full))
@@ -209,6 +226,7 @@ def _fused_update(
     operand_name: str,
     accum_name: str,
     n_pops: int,
+    set_sizes: Optional[Tuple[int, ...]] = None,
 ):
     """Build (and memoize) the scanned generate→accumulate program for one
     static configuration. Memoizing at module level means every accumulator
@@ -220,10 +238,20 @@ def _fused_update(
     than inferred as ``pops.max()+1``: for a cohort smaller than the
     population count the device must still compute every population's
     threshold stream to stay bit-identical with the host path by
-    construction, not by accident."""
+    construction, not by accident.
+
+    ``set_sizes`` carries per-variant-set cohort sizes for asymmetric
+    joint-cohort configurations (``pops_bytes`` is then the concatenation of
+    each set's population vector); ``None`` means every set shares the one
+    cohort ``pops_bytes`` describes."""
     operand_dtype = np.dtype(operand_name)
     accum_dtype = np.dtype(accum_name)
     K, B = blocks_per_dispatch, block_size
+    column_splits = (
+        [int(x) for x in np.cumsum(set_sizes)[:-1]]
+        if set_sizes is not None
+        else None
+    )
 
     with jax.enable_x64(True):
         vs_keys_arr = jnp.asarray(
@@ -252,9 +280,22 @@ def _fused_update(
                 kept_count += jnp.sum(jnp.any(T > 0, axis=1)).astype(
                     kept_count.dtype
                 )
-                hv = generate_has_variation(positions, T, vs_keys_arr, pops_arr)
-                per_set = hv.reshape(hv.shape[0], rows_count.shape[0], -1)
-                rows_count += jnp.sum(jnp.any(per_set, axis=2), axis=0).astype(
+                hv = generate_has_variation(
+                    positions, T, vs_keys_arr, pops_arr, set_sizes
+                )
+                if column_splits is None:
+                    per_set_any = jnp.any(
+                        hv.reshape(hv.shape[0], rows_count.shape[0], -1), axis=2
+                    )
+                else:
+                    per_set_any = jnp.stack(
+                        [
+                            jnp.any(part, axis=1)
+                            for part in jnp.split(hv, column_splits, axis=1)
+                        ],
+                        axis=1,
+                    )
+                rows_count += jnp.sum(per_set_any, axis=0).astype(
                     rows_count.dtype
                 )
                 X = hv.astype(operand_dtype)
@@ -284,6 +325,7 @@ def _fused_update_mesh(
     operand_name: str,
     accum_name: str,
     n_pops: int,
+    set_sizes: Optional[Tuple[int, ...]],
     mesh,
 ):
     """The data-parallel (shard_map) wrapper of :func:`_fused_update`,
@@ -306,6 +348,7 @@ def _fused_update_mesh(
         operand_name,
         accum_name,
         n_pops,
+        set_sizes,
     )
     g_spec = P(DATA_AXIS, None, None)
     r_spec = P(DATA_AXIS, None)
@@ -447,13 +490,39 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
         exact_int: bool = True,
         mesh=None,
         n_pops: Optional[int] = None,
+        set_sizes: Optional[Sequence[int]] = None,
+        pops_per_set: Optional[Sequence[np.ndarray]] = None,
     ):
         from spark_examples_tpu.ops.gramian import _operand_dtypes
         from spark_examples_tpu.parallel.mesh import DATA_AXIS
 
         self.num_samples = int(num_samples)
         self.n_sets = len(vs_keys)
-        self.total_columns = self.num_samples * self.n_sets
+        # Asymmetric joint cohorts (the 1KG × Platinum scenario): per-set
+        # sizes with per-set population vectors; symmetric configurations
+        # share the one (num_samples,) cohort.
+        if set_sizes is not None:
+            self.set_sizes: Optional[Tuple[int, ...]] = tuple(
+                int(s) for s in set_sizes
+            )
+            if len(self.set_sizes) != self.n_sets:
+                raise ValueError(
+                    f"set_sizes has {len(self.set_sizes)} entries for "
+                    f"{self.n_sets} variant sets"
+                )
+            if pops_per_set is None or len(pops_per_set) != self.n_sets:
+                raise ValueError("set_sizes needs matching pops_per_set")
+            if any(
+                len(p) != s for p, s in zip(pops_per_set, self.set_sizes)
+            ):
+                raise ValueError("pops_per_set lengths must match set_sizes")
+            pops = np.concatenate(
+                [np.asarray(p, dtype=np.int32) for p in pops_per_set]
+            )
+            self.total_columns = sum(self.set_sizes)
+        else:
+            self.set_sizes = None
+            self.total_columns = self.num_samples * self.n_sets
         self.block_size = int(block_size)
         self.blocks_per_dispatch = int(blocks_per_dispatch)
         self.sites_per_dispatch = self.block_size * self.blocks_per_dispatch
@@ -483,6 +552,7 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
             # Source-authoritative population count (falls back to inference
             # for callers that predate the parameter).
             int(n_pops) if n_pops is not None else int(pops32.max()) + 1,
+            self.set_sizes,
         )
 
         D = self.data_parallel

@@ -71,6 +71,40 @@ def _operand_dtypes(exact_int: bool, mesh: Optional[Mesh] = None):
 # approach this, and merged-cohort configs exceed it.
 EXACT_F32_LIMIT = 1 << 24
 
+# Dense vs sharded similarity strategy, decided from memory — the TPU
+# restatement of the reference's guidance, which states its bound in GB ("a
+# matrix which may be up to 20GB for ~50K samples",
+# ``VariantsPca.scala:216-217,296-297``). The dense strategy holds about
+# _DENSE_BUFFERS simultaneous N×N accumulator-dtype buffers per device at
+# peak (G, its non-donated update, the centered copy, and eigensolve
+# temporaries); it fits when that stays under DENSE_HBM_FRACTION of
+# per-device memory. One rule, used by BOTH the driver's strategy resolution
+# and the ingest-path eligibility check — no duplicated magic constants.
+DENSE_HBM_FRACTION = 0.8
+_DENSE_BUFFERS = 4
+_DEFAULT_DEVICE_BYTES = 16 << 30  # v5e HBM, used when memory_stats is absent
+
+
+def per_device_memory_bytes(default: int = _DEFAULT_DEVICE_BYTES) -> int:
+    """This process's per-device memory budget: ``memory_stats()`` when the
+    backend reports it (TPU does), else a v5e-sized default (CPU's virtual
+    test devices report nothing useful)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        limit = int(stats.get("bytes_limit", 0)) if stats else 0
+        if limit > 0:
+            return limit
+    except Exception:
+        return default
+    return default
+
+
+def dense_strategy_fits(n_columns: int, accum_bytes: int = 4) -> bool:
+    """Whether a replicated ``n_columns``² accumulator (plus working copies)
+    fits per-device memory — the dense/sharded auto-switch predicate."""
+    need = _DENSE_BUFFERS * int(n_columns) ** 2 * accum_bytes
+    return need <= DENSE_HBM_FRACTION * per_device_memory_bytes()
+
 
 def _maybe_switch_accumulator(acc, next_bound: int, out_shardings=None) -> bool:
     """Losslessly convert an f32 accumulator to int32 before any entry could

@@ -94,7 +94,14 @@ def _samples_sharded_mesh(similarity):
 
 def make_source(conf: PcaConf) -> GenomicsSource:
     if conf.source == "synthetic":
-        return SyntheticGenomicsSource(num_samples=conf.num_samples, seed=conf.seed)
+        sizes = getattr(conf, "num_samples_per_set", None)
+        return SyntheticGenomicsSource(
+            num_samples=conf.num_samples,
+            seed=conf.seed,
+            cohort_sizes=(
+                dict(zip(conf.variant_set_id, sizes)) if sizes else None
+            ),
+        )
     if conf.source == "file":
         from spark_examples_tpu.sources.files import FileGenomicsSource
 
@@ -258,9 +265,12 @@ class VariantsPcaDriver:
         return default_mesh(num_reduce_partitions=self.conf.num_reduce_partitions)
 
     def _resolve_sharded(self, sharded: Optional[bool], mesh) -> bool:
-        """``--similarity-strategy``: explicit dense/sharded, or auto by
-        cohort size (the reference's ~50K-samples/~20GB in-memory guidance,
-        ``VariantsPca.scala:216-217,296-297``, scaled to per-chip HBM)."""
+        """``--similarity-strategy``: explicit dense/sharded, or auto from
+        per-device memory (the reference's ~50K-samples/~20GB in-memory
+        guidance, ``VariantsPca.scala:216-217,296-297``, restated in bytes
+        against the actual HBM — ``ops/gramian.py:dense_strategy_fits``)."""
+        from spark_examples_tpu.ops.gramian import dense_strategy_fits
+
         strategy = getattr(self.conf, "similarity_strategy", "auto")
         if sharded is None:
             if strategy == "sharded":
@@ -268,7 +278,7 @@ class VariantsPcaDriver:
             elif strategy == "dense":
                 sharded = False
             else:
-                sharded = len(self.indexes) >= 16384
+                sharded = not dense_strategy_fits(len(self.indexes))
         if sharded and (mesh is None or SAMPLES_AXIS not in mesh.shape or mesh.shape[SAMPLES_AXIS] < 2):
             if strategy == "sharded":
                 raise ValueError(
@@ -378,9 +388,9 @@ class VariantsPcaDriver:
             # generates its own column block and ring-exchanges tiles — the
             # large-cohort (~50K samples) regime with zero host traffic.
             acc: object = DeviceGenRingGramianAccumulator(
-                num_samples=source.num_samples,
+                num_samples=source.num_samples_for(conf.variant_set_id[0]),
                 vs_key=source.genotype_stream_key(conf.variant_set_id[0]),
-                pops=source.populations,
+                pops=source.populations_for(conf.variant_set_id[0]),
                 site_key=source.site_key,
                 spacing=source.variant_spacing,
                 ref_block_fraction=source.ref_block_fraction,
@@ -392,6 +402,10 @@ class VariantsPcaDriver:
                 n_pops=source.n_pops,
             )
         else:
+            # Asymmetric joint cohorts (per-set sizes) ride the same kernel
+            # via concatenated per-set population vectors.
+            sizes = [source.num_samples_for(v) for v in conf.variant_set_id]
+            asymmetric = any(s != source.num_samples for s in sizes)
             acc = DeviceGenGramianAccumulator(
                 num_samples=source.num_samples,
                 vs_keys=[
@@ -407,6 +421,12 @@ class VariantsPcaDriver:
                 exact_int=True,
                 mesh=mesh,
                 n_pops=source.n_pops,
+                set_sizes=sizes if asymmetric else None,
+                pops_per_set=(
+                    [source.populations_for(v) for v in conf.variant_set_id]
+                    if asymmetric
+                    else None
+                ),
             )
 
         self._device_gen_scanned = 0
@@ -600,11 +620,20 @@ def run(argv: Sequence[str]) -> List[str]:
     # Device generation needs distinct variant sets (duplicate ids collapse
     # the column index, a same-set join the wire path handles via count
     # multiplicity); multi-set configurations additionally need the dense
-    # accumulator (the ring/sharded device path is single-set).
+    # accumulator (the ring/sharded device path is single-set). Dense
+    # eligibility comes from the one memory rule the strategy resolution
+    # also uses (``ops/gramian.py:dense_strategy_fits``).
+    from spark_examples_tpu.ops.gramian import dense_strategy_fits
+
     unique_sets = len(set(conf.variant_set_id)) == len(conf.variant_set_id)
+    per_set = conf.num_samples_per_set or []
+    total_columns = sum(
+        per_set[i] if i < len(per_set) else conf.num_samples
+        for i in range(len(conf.variant_set_id))
+    )
     dense_ok = conf.similarity_strategy != "sharded" and (
         conf.similarity_strategy == "dense"
-        or len(conf.variant_set_id) * conf.num_samples < 16384
+        or dense_strategy_fits(total_columns)
     )
     device_ok = unique_sets and (
         dense_ok or len(conf.variant_set_id) == 1

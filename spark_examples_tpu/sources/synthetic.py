@@ -168,6 +168,12 @@ class SyntheticGenomicsSource(GenomicsSource):
             class the Klotho/BRCA1 examples count).
         n_pops: number of synthetic populations.
         read_length / read_depth: synthetic read geometry for the reads API.
+        cohort_sizes: optional per-variant-set cohort sizes (variant set id →
+            sample count); sets not listed use ``num_samples``. This is how
+            the reference's ACTUAL joint-cohort scenario is modeled — e.g.
+            1000 Genomes (2,504 samples) joined with Platinum Genomes (~17
+            deep genomes) (``VariantsPca.scala:155-168``;
+            ``SearchVariantsExample.scala:28``).
     """
 
     def __init__(
@@ -180,6 +186,7 @@ class SyntheticGenomicsSource(GenomicsSource):
         read_length: int = 100,
         read_depth: int = 8,
         somatic_rate: float = 0.002,
+        cohort_sizes: Optional[Mapping[str, int]] = None,
     ):
         self.num_samples = int(num_samples)
         self.seed = int(seed)
@@ -189,10 +196,24 @@ class SyntheticGenomicsSource(GenomicsSource):
         self.read_length = int(read_length)
         self.read_depth = int(read_depth)
         self.somatic_rate = float(somatic_rate)
+        self.cohort_sizes = {
+            k: int(v) for k, v in (cohort_sizes or {}).items()
+        }
         # Contiguous population blocks: sample s → pop s*n_pops//N.
-        self._pops = (
-            np.arange(self.num_samples, dtype=np.int64) * self.n_pops
-        ) // self.num_samples
+        self._pops = self._pops_for_size(self.num_samples)
+
+    def _pops_for_size(self, n: int) -> np.ndarray:
+        return (np.arange(n, dtype=np.int64) * self.n_pops) // max(1, n)
+
+    def num_samples_for(self, variant_set_id: str) -> int:
+        """This variant set's cohort size (``cohort_sizes`` override or the
+        default ``num_samples``)."""
+        return self.cohort_sizes.get(variant_set_id, self.num_samples)
+
+    def populations_for(self, variant_set_id: str) -> np.ndarray:
+        """Sample → population for this variant set's cohort."""
+        n = self.num_samples_for(variant_set_id)
+        return self._pops if n == self.num_samples else self._pops_for_size(n)
 
     # ------------------------------------------------------------------ keys
 
@@ -227,7 +248,7 @@ class SyntheticGenomicsSource(GenomicsSource):
             if vsid in seen:
                 continue
             seen.add(vsid)
-            for i in range(self.num_samples):
+            for i in range(self.num_samples_for(vsid)):
                 out.append(
                     {"id": self.callset_id(vsid, i), "name": self.callset_name(vsid, i)}
                 )
@@ -355,11 +376,13 @@ class SyntheticGenomicsSource(GenomicsSource):
         self, variant_set_id: str, positions: np.ndarray
     ) -> np.ndarray:
         """(B, N, 2) {0,1} allele draws; genotypes are per variant set
-        (different datasets = different individuals at shared sites)."""
+        (different datasets = different individuals at shared sites), with
+        N this set's cohort size (``cohort_sizes``)."""
         vs_key = self._vs_key(variant_set_id)
         _, _, af_pop, _, _ = self._site_fields(variant_set_id, positions)
-        prob = af_pop[:, self._pops]  # (B, N)
-        samples = np.arange(self.num_samples, dtype=np.int64)[None, :, None]
+        n = self.num_samples_for(variant_set_id)
+        prob = af_pop[:, self.populations_for(variant_set_id)]  # (B, N)
+        samples = np.arange(n, dtype=np.int64)[None, :, None]
         alleles = np.array([1, 2], dtype=np.int64)[None, None, :]
         u = _u01(vs_key, positions[:, None, None], _S_GENOTYPE, samples, alleles)
         return (u < prob[:, :, None]).astype(np.int8)
@@ -417,7 +440,9 @@ class SyntheticGenomicsSource(GenomicsSource):
         if bool(is_ref_block[0]):
             record["end"] = int(pos) + self.variant_spacing
             record["referenceBases"] = "N"
-            genotypes = np.zeros((1, self.num_samples, 2), dtype=np.int8)
+            genotypes = np.zeros(
+                (1, self.num_samples_for(variant_set_id), 2), dtype=np.int8
+            )
         else:
             record["end"] = int(pos) + 1
             record["referenceBases"] = _BASES[int(ref_idx[0])]
@@ -431,7 +456,7 @@ class SyntheticGenomicsSource(GenomicsSource):
                 "genotype": [int(genotypes[0, s, 0]), int(genotypes[0, s, 1])],
                 "phaseset": "*",
             }
-            for s in range(self.num_samples)
+            for s in range(self.num_samples_for(variant_set_id))
         ]
         return record
 

@@ -267,6 +267,41 @@ def test_device_ingest_matches_wire_multiset(n_sets):
     np.testing.assert_array_equal(S_dev, S_wire)
 
 
+def test_asymmetric_joint_cohort_device_matches_wire():
+    """The reference's ACTUAL joint-cohort scenario — a large cohort joined
+    with a small deep-call cohort (1KG × Platinum,
+    ``VariantsPca.scala:155-168``; ``SearchVariantsExample.scala:28``): a
+    2-set join with DIFFERENT column counts per set, identical between the
+    fused device ingest and the wire-record join path."""
+    argv = [
+        "--references", "17:0:20000",
+        "--variant-set-id", "vs-a,vs-b",
+        "--num-samples", "30,7",
+        "--seed", "5",
+        "--bases-per-partition", "5000",
+    ]
+    device_lines = pca_driver.run(argv + ["--ingest", "device"])
+    wire_lines = pca_driver.run(argv + ["--ingest", "wire"])
+    assert device_lines == wire_lines
+    assert len(device_lines) == 37  # 30 + 7 columns
+
+
+def test_asymmetric_cohort_callsets_and_populations():
+    from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource
+
+    source = SyntheticGenomicsSource(
+        num_samples=30, seed=5, cohort_sizes={"vs-b": 7}
+    )
+    callsets = source.search_callsets(["vs-a", "vs-b"])
+    assert len(callsets) == 37
+    assert source.num_samples_for("vs-a") == 30
+    assert source.num_samples_for("vs-b") == 7
+    # A cohort smaller than n_pops still spans the populations it can:
+    # population assignment is s*n_pops//N within EACH cohort.
+    pops_b = source.populations_for("vs-b")
+    assert len(pops_b) == 7 and pops_b.max() < source.n_pops
+
+
 def test_device_run_entrypoint_matches_wire(tmp_path, capsys):
     argv = [
         "--references", "17:0:20000",
