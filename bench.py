@@ -36,9 +36,16 @@ import numpy as np
 N_SAMPLES = 2504
 VARIANT_SPACING = 73  # 2.881 Gb autosomes / 73 = 39.5M sites >= 1KG's 39.4M
 BASELINE_SECONDS = 7200.0
-BLOCK = 2048
-BLOCKS_PER_DISPATCH = 64
-WARMUP_BASES = VARIANT_SPACING * BLOCK * BLOCKS_PER_DISPATCH  # one dispatch
+# Measured optimum on v5e (DESIGN.md "single-chip ingest roofline"): large
+# dispatch groups amortize per-dispatch overhead; contig remainders run
+# through the accumulator's ~K/8 tail program, so group padding stays <2%.
+BLOCK = 16384
+BLOCKS_PER_DISPATCH = 32
+# Warmup covers BOTH compiled programs: one full main group plus one tail
+# group (main + block*K/8 sites).
+WARMUP_BASES = VARIANT_SPACING * (
+    BLOCK * BLOCKS_PER_DISPATCH + BLOCK * max(1, BLOCKS_PER_DISPATCH // 8)
+)
 
 # The BASELINE.json benchmark configs (plus a beyond-reference large-cohort
 # demo). Only whole-genome has a published

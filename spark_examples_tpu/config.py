@@ -189,16 +189,23 @@ class GenomicsConf:
                 raise ValueError("--num-samples needs at least one value")
             conf.num_samples = sizes[0]
             conf.num_samples_per_set = sizes if len(sizes) > 1 else None
-        if conf.num_samples_per_set and len(set(conf.variant_set_id)) != len(
-            conf.variant_set_id
-        ):
-            # Per-set sizes are keyed by set id downstream; duplicate ids
-            # would silently collapse to one size instead of the positional
-            # sizes the flag documents.
-            raise ValueError(
-                "per-set --num-samples requires distinct --variant-set-id "
-                "values (duplicate ids share one cohort)"
-            )
+        if conf.num_samples_per_set:
+            if conf.source != "synthetic":
+                # Cohort sizing only exists for the synthetic source; files
+                # and APIs carry their own cohorts — silently ignoring the
+                # flag would let users believe they sized the run.
+                raise ValueError(
+                    "per-set --num-samples is synthetic-source-only "
+                    f"(--source {conf.source} reads its cohorts from the data)"
+                )
+            if len(set(conf.variant_set_id)) != len(conf.variant_set_id):
+                # Per-set sizes are keyed by set id downstream; duplicate ids
+                # would silently collapse to one size instead of the
+                # positional sizes the flag documents.
+                raise ValueError(
+                    "per-set --num-samples requires distinct --variant-set-id "
+                    "values (duplicate ids share one cohort)"
+                )
         if conf.source == "file":
             if not conf.input_files:
                 raise ValueError("--source file requires --input-files")

@@ -22,15 +22,13 @@ whole ingest onto the TPU:
   bottleneck, and the final fetch pays O(prior dispatches) — fused scanning
   keeps dispatches in the hundreds for a whole-genome run).
 
-Exactness of the float↔integer correspondence: the host draws
-``u = (h >> 11) * 2**-53`` and keeps an allele when ``u < p`` where every
-``p`` is an exact dyadic rational ``k·2⁻³²`` (``sources/synthetic.py``
-fixed-point site fields). Because ``m = h >> 11`` is a 53-bit integer,
-``m · 2⁻⁵³ < k · 2⁻³²  ⟺  m < k · 2²¹`` — the device compares 64-bit
-integers and never touches floating point. The AF filter compares
-micro-units (``round(af·1e6)``, half-even) against ``floor(threshold·1e6)``
-(exact via Fraction) — the same rule every host path uses
-(``sources/synthetic.py:af_passes``).
+Exactness of the host↔device correspondence is trivial by construction:
+both sides draw the same uint32 allele pair (``_allele_pair`` here,
+``_genotype_draw_pair`` on host) and compare against the same Q32 integer
+thresholds — pure integer arithmetic, no floating point anywhere in the
+data plane. The AF filter compares micro-units (``round(af·1e6)``,
+half-even) against ``floor(threshold·1e6)`` (exact via Fraction) — the same
+rule every host path uses (``sources/synthetic.py:af_passes``).
 """
 
 from __future__ import annotations
@@ -92,10 +90,10 @@ def site_thresholds_on_device(
     ref_block_fraction: float,
     min_af_micro: Optional[int],
 ) -> jax.Array:
-    """(B, P) uint64 genotype thresholds (``af_pop_q32 << 21``), zeroed for
+    """(B, P) uint64 Q32 genotype thresholds (``af_pop_q32``), zeroed for
     ref-block sites, AF-filtered sites, and invalid (padding) rows —
-    bit-identical to the host's ``_site_fields_q`` metadata
-    (``sources/synthetic.py``)."""
+    bit-identical to the host's ``_site_fields_q`` metadata / the
+    ``site_threshold_plan`` values (``sources/synthetic.py``)."""
     from spark_examples_tpu.sources.synthetic import (
         _AF_BASE_Q32,
         _AF_SPAN_Q16,
@@ -132,14 +130,62 @@ def site_thresholds_on_device(
             _c64(_POP_LO_Q32),
             _c64(_POP_HI_Q32),
         )
-        pops.append(af_pop << jnp.uint64(21))  # Q32 → Q53 threshold
+        pops.append(af_pop)  # Q32 threshold
     T = jnp.stack(pops, axis=1)  # (B, P)
     return jnp.where(keep[:, None], T, jnp.uint64(0))
 
 
+def fmix32(x: jax.Array) -> jax.Array:
+    """murmur3 fmix32 finalizer on uint32 arrays — bitwise-identical to
+    ``sources/synthetic.py:_fmix32`` (tested)."""
+    x = (x ^ (x >> jnp.uint32(16))) * jnp.uint32(0x85EBCA6B)
+    x = (x ^ (x >> jnp.uint32(13))) * jnp.uint32(0xC2B2AE35)
+    return x ^ (x >> jnp.uint32(16))
+
+
+def _pop_segments(pops_np: np.ndarray) -> Optional[list]:
+    """``[(pop, start, stop)]`` run-length segments of a non-decreasing
+    population vector, or ``None`` when the vector is not contiguous (or too
+    fragmented to be worth unrolling). The synthetic source assigns
+    contiguous population blocks by construction, which lets the kernel
+    broadcast one scalar threshold per segment instead of a (B, N) gather."""
+    if pops_np.ndim != 1 or len(pops_np) == 0:
+        return None
+    diffs = np.diff(pops_np)
+    if np.any(diffs < 0):
+        return None
+    boundaries = np.flatnonzero(diffs) + 1
+    if len(boundaries) > 15:
+        return None
+    if len(pops_np) < 128 * (len(boundaries) + 1):
+        # Narrow segments waste VPU lanes (each pads to the 128-lane
+        # register width): a 17-sample deep-call cohort is ~2.5× FASTER
+        # through the single gathered compare (measured, BENCH_r04 platinum).
+        return None
+    starts = np.concatenate([[0], boundaries])
+    stops = np.concatenate([boundaries, [len(pops_np)]])
+    return [
+        (int(pops_np[s]), int(s), int(e)) for s, e in zip(starts, stops)
+    ]
+
+
+def _allele_pair(h2_col: jax.Array, samples_u64: jax.Array):
+    """The two (B, n) uint32 allele draws from the per-site genotype state —
+    the device half of ``sources/synthetic.py:_genotype_draw_pair``: xor the
+    sample term into the 64-bit state, fold to 32 bits, one fmix32, and a
+    multiplicative re-mix for the second allele. One u64 xor + three u32
+    multiplies per (site, sample) — the ingest hot loop (DESIGN.md
+    "single-chip ingest roofline")."""
+    x64 = h2_col ^ samples_u64
+    x32 = ((x64 >> jnp.uint64(32)) ^ x64).astype(jnp.uint32)
+    d1 = fmix32(x32)
+    d2 = (d1 * jnp.uint32(0x9E3779B9)) ^ jnp.uint32(0x85EBCA6B)
+    return d1, d2
+
+
 def generate_has_variation(
     positions: jax.Array,  # (B,) int64
-    thresholds: jax.Array,  # (B, P) uint64 Q53 thresholds, 0 = dropped
+    thresholds: jax.Array,  # (B, P) uint64 Q32 thresholds, 0 = dropped
     vs_keys: jax.Array,  # (S,) uint64: per-variant-set genotype stream keys
     pops: jax.Array,  # (N_total,) int32: per-set cohorts' sample → population
     set_sizes: Optional[Tuple[int, ...]] = None,  # per-set cohort sizes
@@ -158,37 +204,63 @@ def generate_has_variation(
     the concatenation of each set's population vector and ``set_sizes``
     splits it. With ``set_sizes`` omitted, every set shares the one cohort
     ``pops`` describes.
+
+    When ``pops`` is a concrete array (always the case from the memoized
+    update builders, which close over it), contiguous population blocks are
+    unrolled into per-segment scalar-threshold compares — no (B, N) gather;
+    a traced or non-contiguous ``pops`` falls back to the gather.
     """
     n_sets = vs_keys.shape[0]
+    try:
+        pops_np: Optional[np.ndarray] = np.asarray(pops)
+    except Exception:  # a tracer: no static view available
+        pops_np = None
     if set_sizes is None:
-        set_sizes = (pops.shape[0],) * n_sets
-        pops_per_set = [pops] * n_sets
+        sizes = (pops.shape[0],) * n_sets
+        offsets = [0] * n_sets
+        pops_dyn = [pops] * n_sets
     else:
-        offsets = np.concatenate([[0], np.cumsum(set_sizes)])
-        pops_per_set = [
-            lax.slice_in_dim(pops, int(offsets[s]), int(offsets[s + 1]))
+        sizes = tuple(int(s) for s in set_sizes)
+        cum = np.concatenate([[0], np.cumsum(sizes)])
+        offsets = [int(c) for c in cum[:-1]]
+        pops_dyn = [
+            lax.slice_in_dim(pops, offsets[s], offsets[s] + sizes[s])
             for s in range(n_sets)
         ]
+    Tq32 = thresholds.astype(jnp.uint32)
     pos_term = positions.astype(jnp.uint64) * _c64(_P2)
     parts = []
     for s in range(n_sets):
-        pops_s = pops_per_set[s]
-        samples = (
-            jnp.arange(set_sizes[s], dtype=jnp.uint64) * _c64(_P4)
-        )[None, :]
-        t_full = jnp.take(thresholds, pops_s, axis=1)  # (B, N_s)
         h1 = mix64(vs_keys[s] ^ pos_term)  # (B,)
-        h2 = mix64(h1 ^ _c64(_S_GENOTYPE * _P3))
-        h3 = mix64(h2[:, None] ^ samples)  # (B, N_s)
-        m1 = mix64(h3 ^ _c64(1 * _P1)) >> jnp.uint64(11)
-        m2 = mix64(h3 ^ _c64(2 * _P1)) >> jnp.uint64(11)
-        parts.append((m1 < t_full) | (m2 < t_full))
+        h2 = mix64(h1 ^ _c64(_S_GENOTYPE * _P3))[:, None]
+        segments = (
+            _pop_segments(pops_np[offsets[s] : offsets[s] + sizes[s]])
+            if pops_np is not None
+            else None
+        )
+        if segments is not None:
+            columns = []
+            for pop, start, stop in segments:
+                samples = (
+                    jnp.arange(start, stop, dtype=jnp.uint64) * _c64(_P4)
+                )[None, :]
+                d1, d2 = _allele_pair(h2, samples)
+                tf = Tq32[:, pop : pop + 1]  # (B, 1) broadcast
+                columns.append((d1 < tf) | (d2 < tf))
+            parts.append(jnp.concatenate(columns, axis=1))
+        else:
+            samples = (jnp.arange(sizes[s], dtype=jnp.uint64) * _c64(_P4))[
+                None, :
+            ]
+            d1, d2 = _allele_pair(h2, samples)
+            tf = jnp.take(Tq32, pops_dyn[s], axis=1)  # (B, N_s)
+            parts.append((d1 < tf) | (d2 < tf))
     return jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
 
 
 def generate_column_block(
     positions: jax.Array,  # (B,) int64
-    thresholds: jax.Array,  # (B, P) uint64 Q53 thresholds, 0 = dropped
+    thresholds: jax.Array,  # (B, P) uint64 Q32 thresholds, 0 = dropped
     vs_key: jax.Array,  # scalar uint64 genotype stream key (one set)
     pops_local: jax.Array,  # (N_local,) int32: this slice's sample pops
     col_start: jax.Array,  # scalar int: first GLOBAL sample index
@@ -198,19 +270,19 @@ def generate_column_block(
     genotype draw is keyed by the global sample index, so a slice can
     generate exactly its own columns of the cohort matrix (bitwise-equal to
     the corresponding columns of :func:`generate_has_variation`); padded
-    columns past ``num_samples`` come out all-zero."""
+    columns past ``num_samples`` come out all-zero. ``pops_local`` is traced
+    (sliced by axis index inside shard_map), so this path keeps the
+    threshold gather."""
     n_local = pops_local.shape[0]
     cols = col_start + jnp.arange(n_local, dtype=jnp.int64)
     samples = (cols.astype(jnp.uint64) * _c64(_P4))[None, :]
     pos_term = positions.astype(jnp.uint64) * _c64(_P2)
-    t_full = jnp.take(thresholds, pops_local, axis=1)  # (B, N_local)
-    t_full = jnp.where((cols < num_samples)[None, :], t_full, jnp.uint64(0))
+    t_full = jnp.take(thresholds, pops_local, axis=1).astype(jnp.uint32)
+    t_full = jnp.where((cols < num_samples)[None, :], t_full, jnp.uint32(0))
     h1 = mix64(vs_key ^ pos_term)  # (B,)
-    h2 = mix64(h1 ^ _c64(_S_GENOTYPE * _P3))
-    h3 = mix64(h2[:, None] ^ samples)  # (B, N_local)
-    m1 = mix64(h3 ^ _c64(1 * _P1)) >> jnp.uint64(11)
-    m2 = mix64(h3 ^ _c64(2 * _P1)) >> jnp.uint64(11)
-    return (m1 < t_full) | (m2 < t_full)
+    h2 = mix64(h1 ^ _c64(_S_GENOTYPE * _P3))[:, None]
+    d1, d2 = _allele_pair(h2, samples)
+    return (d1 < t_full) | (d2 < t_full)
 
 
 @functools.lru_cache(maxsize=32)
@@ -567,6 +639,16 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
                 self.kept_sites = jnp.zeros((), jnp.int64)
                 self._update = _fused_update(*update_key)
                 self._scalar_sharding = None
+                # Tail program: a ~K/8-length variant of the same scanned
+                # update for contig remainders. Large dispatch groups
+                # amortize per-dispatch overhead, but a whole-genome run has
+                # 22 contig tails — padding each to the full group would
+                # waste up to (group-1) sites of compute per contig (>50%
+                # at the tuned 16K×32 group size). Built lazily: only runs
+                # that produce remainders pay its compile.
+                self._update_key = update_key
+                self._tail_blocks = max(1, self.blocks_per_dispatch // 8)
+                self._update_tail = None
             else:
                 # Data-parallel ingest: each data slice generates and
                 # accumulates a DIFFERENT span of the site grid (its own
@@ -616,8 +698,11 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
             offsets[0], valids[0] = grid_offset, n_valid
             self.add_ranges(offsets, valids)
             return
+        self._dispatch_single(self._update, grid_offset, n_valid)
+
+    def _dispatch_single(self, update, grid_offset: int, n_valid: int) -> None:
         with jax.enable_x64(True):
-            self.G, self.variant_rows, self.kept_sites = self._update(
+            self.G, self.variant_rows, self.kept_sites = update(
                 self.G,
                 self.variant_rows,
                 self.kept_sites,
@@ -626,15 +711,40 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
             )
         self.dispatches += 1
 
+    def _tail_update(self):
+        """The short-scan remainder program (``_tail_blocks`` instead of
+        ``blocks_per_dispatch``), compiled on first use and memoized at
+        module level like the main program."""
+        if self._update_tail is None:
+            key = (
+                self._update_key[:7]
+                + (self._tail_blocks,)
+                + self._update_key[8:]
+            )
+            self._update_tail = _fused_update(*key)
+        return self._update_tail
+
     def add_grid(self, first_index: int, last_index: int) -> None:
         """Single-slice fast path keeps scalar dispatches; data-parallel
-        instances use the shared round-robin."""
+        instances use the shared round-robin. Full groups dispatch the main
+        program; the contig remainder runs through the ~8× shorter tail
+        program, bounding padding waste per contig to half a tail group."""
         if self.data_parallel > 1:
             super().add_grid(first_index, last_index)
             return
-        step = self.sites_per_dispatch
-        for off in range(first_index, last_index, step):
-            self.add_range(off, min(step, last_index - off))
+        main = self.sites_per_dispatch
+        tail = self.block_size * self._tail_blocks
+        off = first_index
+        while last_index - off >= main:
+            self.add_range(off, main)
+            off += main
+            if self.dispatches == 1:
+                self.poke()
+        while off < last_index:
+            self._dispatch_single(
+                self._tail_update(), off, min(tail, last_index - off)
+            )
+            off += tail
             if self.dispatches == 1:
                 self.poke()
 

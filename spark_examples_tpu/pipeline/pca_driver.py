@@ -198,14 +198,23 @@ class VariantsPcaDriver:
             return
 
         # Multi-dataset: all datasets share the same partitions, so records
-        # with equal variant keys co-locate per window; join there.
+        # with equal variant keys co-locate per window; join there. Window
+        # record-building streams through the same bounded thread pool the
+        # single-set path uses (the Spark-executor analog,
+        # ``pipeline/datasets.py:_parallel_shards``): windows N+1..N+k build
+        # all their datasets' records while window N's join is consumed,
+        # keeping --num-workers saturated instead of computing every
+        # dataset's window serially per index.
         partitions = datasets[0].partitions()
+        # One partition list per dataset, built once — not per window per
+        # worker (a whole-genome join has thousands of windows).
+        partition_lists = [dataset.partitions() for dataset in datasets]
         debug = self.conf.debug_datasets
 
         def window_records(index: int) -> List[Dict[str, List[List[CallData]]]]:
             per_set: List[Dict[str, List[List[CallData]]]] = []
-            for dataset in datasets:
-                part = dataset.partitions()[index]
+            for dataset, parts in zip(datasets, partition_lists):
+                part = parts[index]
                 keyed: Dict[str, List[List[CallData]]] = {}
                 for variant in (v for _, v in dataset.compute(part)):
                     if not self.filter_variant(variant):
@@ -217,8 +226,10 @@ class VariantsPcaDriver:
                 per_set.append(keyed)
             return per_set
 
-        for index in range(len(partitions)):
-            per_set = window_records(index)
+        num_workers = getattr(self.conf, "num_workers", 8)
+        for _, per_set in _parallel_shards(
+            list(range(len(partitions))), window_records, num_workers
+        ):
             if n_sets == 2:
                 # joinDatasets (``VariantsPca.scala:155-168``): inner join,
                 # concatenate both call lists.

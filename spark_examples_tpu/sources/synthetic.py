@@ -81,13 +81,13 @@ def _af6(af: np.ndarray) -> np.ndarray:
     return np.round(np.asarray(af) * 1e6) / 1e6
 
 
-# Fixed-point site-field constants (Q16/Q32/Q53). All site metadata is
+# Fixed-point site-field constants (Q16/Q32). All site metadata is
 # derived with u64-only arithmetic so the device ingest kernel
 # (``ops/devicegen.py``) can recompute it bit-identically from positions
 # alone — no per-site host→device traffic. The float forms used by the wire
-# path are exact dyadic rationals (k·2⁻³²), so float comparisons elsewhere
-# (``u < af_pop`` in :meth:`_genotype_alleles`) remain bitwise-equal to the
-# integer threshold compares on device.
+# path are exact dyadic rationals (k·2⁻³²); the genotype draws compare
+# against the Q32 integers directly (``_genotype_draw_pair``), identically
+# on host and device.
 _AF_BASE_Q32 = round(0.01 * 2**32)  # af = 0.01 + u²·0.49
 _AF_SPAN_Q16 = round(0.49 * 2**16)
 _POP_BASE_Q16 = round(0.25 * 2**16)  # af_pop = af·(0.25 + 1.5·u_p), clipped
@@ -153,6 +153,55 @@ def _u64(key: np.uint64, pos, stream: int, sample=0, allele=0) -> np.ndarray:
         h = _mix(h ^ (np.asarray(sample, dtype=np.int64).astype(_U64) * _P4))
         h = _mix(h ^ (np.asarray(allele, dtype=np.int64).astype(_U64) * _P1))
     return h
+
+
+# ---- the genotype draw stream (the hot path) -------------------------------
+#
+# The genotype data plane is the only stream drawn per (site, sample) — at
+# whole-genome scale that is ~10¹¹ draws, and its hash cost bounds ingest
+# throughput (see DESIGN.md "single-chip ingest roofline"). It therefore uses
+# a cheaper construction than the general-purpose ``_u64`` stream: the 64-bit
+# per-site state ``h₂`` (same splitmix64 prefix as ``_u64`` with
+# ``stream=_S_GENOTYPE``) is xor-combined with the sample term and FOLDED to
+# 32 bits, then finalized with ONE murmur3 fmix32 — 1 u64 xor + 2 u32
+# multiplies per (site, sample) instead of three full splitmix64 rounds
+# (6 u64 multiplies, each ~3 u32 multiplies once XLA emulates u64 on TPU).
+# The second allele's draw is a multiplicative re-mix of the first (one more
+# u32 multiply). Folding AFTER the sample xor keeps the pre-fold state
+# unique per (site, sample): fold collisions are isolated scalar
+# coincidences (~2⁻³² per pair), never whole shared genotype rows.
+# Allele draws compare directly against the Q32 integer thresholds
+# (``draw32 < af_pop_q32`` ⟺ ``draw32·2⁻³² < af_pop``) — the device kernel
+# (``ops/devicegen.py``) reproduces this bit for bit.
+
+_GOLD32 = np.uint32(0x9E3779B9)
+_FMIX_C1 = np.uint32(0x85EBCA6B)
+_FMIX_C2 = np.uint32(0xC2B2AE35)
+
+
+def _fmix32(x: np.ndarray) -> np.ndarray:
+    """murmur3 fmix32 finalizer, vectorized over uint32 (wrapping mod 2^32)."""
+    with np.errstate(over="ignore"):
+        x = ((x ^ (x >> np.uint32(16))) * _FMIX_C1).astype(np.uint32)
+        x = ((x ^ (x >> np.uint32(13))) * _FMIX_C2).astype(np.uint32)
+        return (x ^ (x >> np.uint32(16))).astype(np.uint32)
+
+
+def _genotype_draw_pair(
+    vs_key: np.uint64, positions: np.ndarray, num_samples: int
+) -> "tuple[np.ndarray, np.ndarray]":
+    """The two (B, N) uint32 allele draws of the genotype stream."""
+    with np.errstate(over="ignore"):
+        h1 = _mix(
+            vs_key ^ (np.asarray(positions, dtype=np.int64).astype(_U64) * _P2)
+        )
+        h2 = _mix(h1 ^ (_U64(_S_GENOTYPE) * _P3))
+        samples = np.arange(num_samples, dtype=np.int64).astype(_U64) * _P4
+        x64 = h2[:, None] ^ samples[None, :]
+        x32 = ((x64 >> _U64(32)) ^ x64).astype(np.uint32)
+        d1 = _fmix32(x32)
+        d2 = ((d1 * _GOLD32) ^ _FMIX_C1).astype(np.uint32)
+    return d1, d2
 
 
 class SyntheticGenomicsSource(GenomicsSource):
@@ -352,9 +401,10 @@ class SyntheticGenomicsSource(GenomicsSource):
         comparison thresholds for kept sites.
 
         Yields dense ``(positions (B,), thresholds (B, n_pops) uint64)``
-        batches where ``thresholds[:, p] = ceil(af_pop[:, p] * 2**53)`` —
-        the exact integer form of the host's ``u < af_pop`` float comparison
-        (see ``ops/devicegen.py``). Ref-block sites and AF-filtered sites are
+        batches where ``thresholds[:, p] = af_pop_q32[:, p]`` — the Q32
+        integer thresholds the genotype draws compare against
+        (``draw32 < af_pop_q32``, see ``_genotype_draw_pair`` and
+        ``ops/devicegen.py``). Ref-block sites and AF-filtered sites are
         compacted out, mirroring :meth:`genotype_blocks`' drop semantics.
         """
         all_positions = self._site_positions(contig.start, contig.end)
@@ -369,7 +419,8 @@ class SyntheticGenomicsSource(GenomicsSource):
             positions = positions[keep]
             if len(positions) == 0:
                 continue
-            thresholds = np.ceil(af_pop[keep] * (2.0**53)).astype(np.uint64)
+            # af_pop is the exact dyadic k·2⁻³², so ·2³² recovers k exactly.
+            thresholds = np.round(af_pop[keep] * (2.0**32)).astype(np.uint64)
             yield positions, thresholds
 
     def _genotype_alleles(
@@ -377,15 +428,20 @@ class SyntheticGenomicsSource(GenomicsSource):
     ) -> np.ndarray:
         """(B, N, 2) {0,1} allele draws; genotypes are per variant set
         (different datasets = different individuals at shared sites), with
-        N this set's cohort size (``cohort_sizes``)."""
+        N this set's cohort size (``cohort_sizes``). Integer Q32 compares of
+        the genotype draw stream (``_genotype_draw_pair``) against the
+        per-population thresholds — bit-identical to the device kernel."""
         vs_key = self._vs_key(variant_set_id)
-        _, _, af_pop, _, _ = self._site_fields(variant_set_id, positions)
+        site_key = _mix(_U64(self.seed))
+        _, _, af_pop_q32 = _site_fields_q(
+            site_key, positions, self.ref_block_fraction, self.n_pops
+        )
         n = self.num_samples_for(variant_set_id)
-        prob = af_pop[:, self.populations_for(variant_set_id)]  # (B, N)
-        samples = np.arange(n, dtype=np.int64)[None, :, None]
-        alleles = np.array([1, 2], dtype=np.int64)[None, None, :]
-        u = _u01(vs_key, positions[:, None, None], _S_GENOTYPE, samples, alleles)
-        return (u < prob[:, :, None]).astype(np.int8)
+        pops = self.populations_for(variant_set_id)
+        # Q32 thresholds are < 2^32 by construction (clipped at _POP_HI_Q32).
+        k = af_pop_q32[:, pops].astype(np.uint32)  # (B, N)
+        d1, d2 = _genotype_draw_pair(vs_key, positions, n)
+        return np.stack([d1 < k, d2 < k], axis=2).astype(np.int8)
 
     def genotype_blocks(
         self,

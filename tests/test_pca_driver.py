@@ -267,6 +267,54 @@ def test_device_ingest_matches_wire_multiset(n_sets):
     np.testing.assert_array_equal(S_dev, S_wire)
 
 
+def test_multiset_wire_join_runs_windows_concurrently():
+    """The ≥2-set wire join streams windows through the shard thread pool:
+    with --num-workers N and a blocking source, multiple windows' record
+    builds must be in flight at once (round-2 ask: the join previously
+    computed every dataset's window serially per index)."""
+    import threading
+    import time
+
+    from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource
+
+    class SlowSource(SyntheticGenomicsSource):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.lock = threading.Lock()
+            self.active = 0
+            self.max_active = 0
+
+        def client(self):
+            outer = self
+
+            class SlowClient(type(super().client())):
+                def search_variants(self, request, *a, **kw):
+                    with outer.lock:
+                        outer.active += 1
+                        outer.max_active = max(outer.max_active, outer.active)
+                    time.sleep(0.05)
+                    try:
+                        yield from super().search_variants(request, *a, **kw)
+                    finally:
+                        with outer.lock:
+                            outer.active -= 1
+
+            return SlowClient(outer)
+
+    source = SlowSource(num_samples=8, seed=5, variant_spacing=100)
+    conf = _conf(
+        variant_set_id=["vs-a", "vs-b"],
+        references="17:0:40000",
+        num_samples=8,
+        bases_per_partition=5000,  # 8 windows
+        num_workers=4,
+    )
+    driver = VariantsPcaDriver(conf, source)
+    rows = list(driver.iter_calls(driver.get_data()))
+    assert rows  # the join produced records
+    assert source.max_active >= 2  # windows overlapped, not serial
+
+
 def test_asymmetric_joint_cohort_device_matches_wire():
     """The reference's ACTUAL joint-cohort scenario — a large cohort joined
     with a small deep-call cohort (1KG × Platinum,
