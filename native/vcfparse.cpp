@@ -1,0 +1,238 @@
+// Native VCF data-plane parser: the file source's ingest hot loop.
+//
+// The reference's runtime was dominated by ingest (SURVEY.md §7); for local
+// VCFs the analogous bottleneck is per-sample genotype parsing — a
+// 2,504-sample cohort means thousands of GT fields per data line, which the
+// pure-Python wire path builds as per-call objects (kept as the semantic
+// oracle, sources/files.py). This translation unit feeds the PACKED ingest
+// path instead: one pass over the decompressed VCF text emitting dense
+// numpy-ready arrays — positions, ends, first-AF values, and the
+// (line, sample) has-variation byte matrix the Gramian accumulator consumes
+// directly (ops/gramian.py:add_rows).
+//
+// Contract (mirrors sources/files.py:_parse_vcf, tested for parity):
+//   - 1-based POS becomes the half-open 0-based [start, start + len(REF));
+//   - the GT subfield is located via the FORMAT column per line;
+//   - an allele is "variation" iff its integer value is > 0; missing ('.')
+//     alleles are not variation (VariantsPca.scala:67 semantics);
+//   - AF is INFO's first AF= value (NaN when absent) so the
+//     --min-allele-frequency filter (strictly greater, first value,
+//     absent→drop) can run on the array;
+//   - contig filtering/normalization stays in Python (per-contig row spans
+//     are selected by the caller via the contig index arrays).
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image); compiled
+// on demand by spark_examples_tpu/utils/native.py.
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <cstdlib>
+
+namespace {
+
+struct Cursor {
+    const char* p;
+    const char* end;
+};
+
+// Advance to one past the next '\n' (or end).
+inline const char* next_line(const char* p, const char* end) {
+    const char* nl = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    return nl ? nl + 1 : end;
+}
+
+// [begin, end) of field `index` (tab-separated) within the line
+// [line, line_end). Returns false when the line has too few fields.
+inline bool field_span(const char* line, const char* line_end, int index,
+                       const char** fb, const char** fe) {
+    const char* p = line;
+    for (int i = 0; i < index; ++i) {
+        const char* tab = static_cast<const char*>(
+            memchr(p, '\t', static_cast<size_t>(line_end - p)));
+        if (!tab) return false;
+        p = tab + 1;
+    }
+    const char* tab = static_cast<const char*>(
+        memchr(p, '\t', static_cast<size_t>(line_end - p)));
+    *fb = p;
+    *fe = tab ? tab : line_end;
+    return true;
+}
+
+inline int64_t parse_int(const char* b, const char* e, bool* ok) {
+    int64_t v = 0;
+    if (b == e) { *ok = false; return 0; }
+    for (const char* p = b; p < e; ++p) {
+        if (*p < '0' || *p > '9') { *ok = false; return 0; }
+        v = v * 10 + (*p - '0');
+    }
+    *ok = true;
+    return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Count data lines and samples. Returns 0 on success, negative on error:
+// -1 no #CHROM header. Outputs: n_lines (data lines), n_samples.
+int vcf_scan(const char* buf, int64_t len, int64_t* n_lines,
+             int64_t* n_samples) {
+    const char* p = buf;
+    const char* end = buf + len;
+    *n_lines = 0;
+    *n_samples = -1;
+    while (p < end) {
+        const char* line_end = static_cast<const char*>(
+            memchr(p, '\n', static_cast<size_t>(end - p)));
+        if (!line_end) line_end = end;
+        if (line_end > p && *(line_end - 1) == '\r') --line_end;
+        if (line_end == p) { p = next_line(p, end); continue; }
+        if (p[0] == '#') {
+            if (line_end - p >= 6 && memcmp(p, "#CHROM", 6) == 0) {
+                // Samples are columns 10.. of the header row.
+                int64_t tabs = 0;
+                for (const char* q = p; q < line_end; ++q)
+                    if (*q == '\t') ++tabs;
+                *n_samples = tabs >= 9 ? tabs - 8 : 0;
+            }
+        } else {
+            ++(*n_lines);
+        }
+        p = next_line(p, end);
+    }
+    return *n_samples >= 0 ? 0 : -1;
+}
+
+// Parse all data lines. Arrays are caller-allocated with n_lines rows (from
+// vcf_scan): positions/ends int64, af double (NaN = absent),
+// has_variation int8 (n_lines * n_samples, row-major), contig_off/contig_len
+// int64 byte spans of the CHROM field within buf (Python decodes the
+// strings). Returns the number of parsed lines, or the negative (1-based)
+// line ordinal of the first malformed data line.
+int64_t vcf_parse(const char* buf, int64_t len, int64_t n_samples,
+                  int64_t* positions, int64_t* ends, double* af,
+                  int8_t* has_variation, int64_t* contig_off,
+                  int64_t* contig_len) {
+    const char* p = buf;
+    const char* end = buf + len;
+    int64_t row = 0;
+    int64_t ordinal = 0;
+    while (p < end) {
+        const char* line_end = static_cast<const char*>(
+            memchr(p, '\n', static_cast<size_t>(end - p)));
+        if (!line_end) line_end = end;
+        const char* stripped_end = line_end;
+        if (stripped_end > p && *(stripped_end - 1) == '\r') --stripped_end;
+        if (stripped_end == p || p[0] == '#') { p = next_line(p, end); continue; }
+        ++ordinal;
+
+        const char *fb, *fe;
+        // CHROM
+        if (!field_span(p, stripped_end, 0, &fb, &fe)) return -ordinal;
+        contig_off[row] = fb - buf;
+        contig_len[row] = fe - fb;
+        // POS (1-based) and REF length give [start, end).
+        if (!field_span(p, stripped_end, 1, &fb, &fe)) return -ordinal;
+        bool ok = false;
+        int64_t pos1 = parse_int(fb, fe, &ok);
+        if (!ok || pos1 < 1) return -ordinal;
+        positions[row] = pos1 - 1;
+        if (!field_span(p, stripped_end, 3, &fb, &fe)) return -ordinal;
+        ends[row] = positions[row] + (fe - fb);
+        // INFO: first AF= value.
+        if (!field_span(p, stripped_end, 7, &fb, &fe)) return -ordinal;
+        af[row] = NAN;
+        for (const char* q = fb; q + 3 <= fe;) {
+            bool at_start = (q == fb) || (*(q - 1) == ';');
+            if (at_start && memcmp(q, "AF=", 3) == 0) {
+                const char* vb = q + 3;
+                const char* ve = vb;
+                while (ve < fe && *ve != ';' && *ve != ',') ++ve;
+                char tmp[64];
+                size_t n = static_cast<size_t>(ve - vb);
+                if (n > 0 && n < sizeof(tmp)) {
+                    memcpy(tmp, vb, n);
+                    tmp[n] = '\0';
+                    char* endp = nullptr;
+                    double v = strtod(tmp, &endp);
+                    if (endp == tmp + n) af[row] = v;
+                }
+                break;
+            }
+            const char* semi = static_cast<const char*>(
+                memchr(q, ';', static_cast<size_t>(fe - q)));
+            if (!semi) break;
+            q = semi + 1;
+        }
+        // FORMAT: find the GT subfield index.
+        int8_t* hv = has_variation + row * n_samples;
+        memset(hv, 0, static_cast<size_t>(n_samples));
+        const char *fmtb, *fmte;
+        int gt_index = -1;
+        if (field_span(p, stripped_end, 8, &fmtb, &fmte)) {
+            int idx = 0;
+            const char* q = fmtb;
+            while (q <= fmte) {
+                const char* colon = static_cast<const char*>(
+                    memchr(q, ':', static_cast<size_t>(fmte - q)));
+                const char* sub_end = colon ? colon : fmte;
+                if (sub_end - q == 2 && q[0] == 'G' && q[1] == 'T') {
+                    gt_index = idx;
+                    break;
+                }
+                if (!colon) break;
+                q = colon + 1;
+                ++idx;
+            }
+            if (gt_index >= 0) {
+                // Walk sample columns 9..9+n_samples-1.
+                const char* s = fmte < stripped_end ? fmte + 1 : stripped_end;
+                for (int64_t sample = 0;
+                     sample < n_samples && s <= stripped_end; ++sample) {
+                    const char* tab = static_cast<const char*>(memchr(
+                        s, '\t', static_cast<size_t>(stripped_end - s)));
+                    const char* col_end = tab ? tab : stripped_end;
+                    // The GT subfield within this column.
+                    const char* g = s;
+                    for (int i = 0; i < gt_index && g; ++i) {
+                        const char* colon = static_cast<const char*>(memchr(
+                            g, ':', static_cast<size_t>(col_end - g)));
+                        g = colon ? colon + 1 : nullptr;
+                    }
+                    if (g) {
+                        const char* colon = static_cast<const char*>(memchr(
+                            g, ':', static_cast<size_t>(col_end - g)));
+                        const char* g_end = colon ? colon : col_end;
+                        // Alleles separated by '/' or '|'; integer > 0 is
+                        // variation; '.' (missing) is not.
+                        int64_t allele = 0;
+                        bool in_number = false;
+                        for (const char* c = g; c <= g_end; ++c) {
+                            if (c < g_end && *c >= '0' && *c <= '9') {
+                                allele = allele * 10 + (*c - '0');
+                                in_number = true;
+                            } else {
+                                if (in_number && allele > 0) {
+                                    hv[sample] = 1;
+                                    break;
+                                }
+                                allele = 0;
+                                in_number = false;
+                            }
+                        }
+                    }
+                    if (!tab) break;
+                    s = tab + 1;
+                }
+            }
+        }
+        ++row;
+        p = next_line(p, end);
+    }
+    return row;
+}
+
+}  // extern "C"

@@ -452,21 +452,24 @@ class _GridDispatchAccumulator:
         """Data-parallel dispatch: slice d processes grid indices
         ``[grid_offsets[d], grid_offsets[d] + n_valids[d])`` (``n_valids[d]
         == 0`` means an idle slice this round)."""
+        self._dispatch_ranges(
+            self._update, self.sites_per_dispatch, grid_offsets, n_valids
+        )
+
+    def _dispatch_ranges(self, update, cap, grid_offsets, n_valids) -> None:
         D = self.data_parallel
         grid_offsets = np.asarray(grid_offsets, dtype=np.int64)
         n_valids = np.asarray(n_valids, dtype=np.int64)
         if grid_offsets.shape != (D,) or n_valids.shape != (D,):
             raise ValueError(f"expected ({D},) offsets/valids")
-        if n_valids.min(initial=0) < 0 or n_valids.max(initial=0) > self.sites_per_dispatch:
-            raise ValueError(
-                f"n_valids must be in [0, {self.sites_per_dispatch}]"
-            )
+        if n_valids.min(initial=0) < 0 or n_valids.max(initial=0) > cap:
+            raise ValueError(f"n_valids must be in [0, {cap}]")
         if (grid_offsets < 0).any():
             # Negative grid indices would wrap to garbage uint64 positions on
             # device and silently corrupt the Gramian.
             raise ValueError("grid_offsets must be non-negative")
         with jax.enable_x64(True):
-            self.G, self.variant_rows, self.kept_sites = self._update(
+            self.G, self.variant_rows, self.kept_sites = update(
                 self.G,
                 self.variant_rows,
                 self.kept_sites,
@@ -475,22 +478,72 @@ class _GridDispatchAccumulator:
             )
         self.dispatches += 1
 
-    def add_grid(self, first_index: int, last_index: int) -> None:
-        """Dispatch all groups for a contiguous grid index range
-        ``[first_index, last_index)``, round-robining groups over the data
-        axis."""
-        step = self.sites_per_dispatch
-        starts = list(range(first_index, last_index, step))
+    #: position of ``blocks_per_dispatch`` in both subclasses' update-key
+    #: tuples (``_fused_update`` and ``_ring_update`` share the prefix
+    #: ``(..., block_size, blocks_per_dispatch, ...)``).
+    _TAIL_KEY_INDEX = 7
+
+    def _compile_update(self, key):
+        """Build the update program for a (possibly tail-modified) key;
+        subclasses with a tail program override this."""
+        return None
+
+    def _tail_spec(self):
+        """(tail_update, tail_sites) — a ~K/8-length program for grid
+        remainders, or ``(None, 0)`` for accumulators without one (the
+        remainder then pads a full group, the pre-tail behavior)."""
+        if getattr(self, "_update_key", None) is None:
+            return None, 0
+        if self._update_tail is None:
+            i = self._TAIL_KEY_INDEX
+            key = (
+                self._update_key[:i]
+                + (self._tail_blocks,)
+                + self._update_key[i + 1 :]
+            )
+            self._update_tail = self._compile_update(key)
+        return self._update_tail, self.block_size * self._tail_blocks
+
+    def _round_robin(self, update, cap, starts, last_index: int) -> None:
         D = self.data_parallel
         for i in range(0, len(starts), D):
             offsets = np.zeros(D, dtype=np.int64)
             valids = np.zeros(D, dtype=np.int64)
             for d, off in enumerate(starts[i : i + D]):
                 offsets[d] = off
-                valids[d] = min(step, last_index - off)
-            self.add_ranges(offsets, valids)
+                valids[d] = min(cap, last_index - off)
+            self._dispatch_ranges(update, cap, offsets, valids)
             if self.dispatches == 1:
                 self.poke()
+
+    def add_grid(self, first_index: int, last_index: int) -> None:
+        """Dispatch all groups for a contiguous grid index range
+        ``[first_index, last_index)``, round-robining groups over the data
+        axis; the remainder after the full groups runs through the tail
+        program when the subclass provides one (padding waste bounded by one
+        tail group instead of one full group per contig)."""
+        step = self.sites_per_dispatch
+        total = max(0, last_index - first_index)
+        n_main = total // step
+        self._round_robin(
+            self._update,
+            step,
+            [first_index + i * step for i in range(n_main)],
+            last_index,
+        )
+        rem_start = first_index + n_main * step
+        if rem_start >= last_index:
+            return
+        tail_update, tail_sites = self._tail_spec()
+        if tail_update is None:
+            self._round_robin(self._update, step, [rem_start], last_index)
+            return
+        self._round_robin(
+            tail_update,
+            tail_sites,
+            list(range(rem_start, last_index, tail_sites)),
+            last_index,
+        )
 
     def poke(self) -> None:
         """Force the backend into eager execution with one tiny sync fetch.
@@ -639,16 +692,6 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
                 self.kept_sites = jnp.zeros((), jnp.int64)
                 self._update = _fused_update(*update_key)
                 self._scalar_sharding = None
-                # Tail program: a ~K/8-length variant of the same scanned
-                # update for contig remainders. Large dispatch groups
-                # amortize per-dispatch overhead, but a whole-genome run has
-                # 22 contig tails — padding each to the full group would
-                # waste up to (group-1) sites of compute per contig (>50%
-                # at the tuned 16K×32 group size). Built lazily: only runs
-                # that produce remainders pay its compile.
-                self._update_key = update_key
-                self._tail_blocks = max(1, self.blocks_per_dispatch // 8)
-                self._update_tail = None
             else:
                 # Data-parallel ingest: each data slice generates and
                 # accumulates a DIFFERENT span of the site grid (its own
@@ -675,6 +718,22 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
                     np.zeros((D,), np.int64), NamedSharding(mesh, s_spec)
                 )
                 self._update = _fused_update_mesh(*update_key, mesh)
+        # Tail program: a ~K/8-length variant of the same scanned update for
+        # contig remainders. Large dispatch groups amortize per-dispatch
+        # overhead, but a whole-genome run has 22 contig tails — padding
+        # each to the full group would waste up to (group-1) sites of
+        # compute per contig (>50% at the tuned 16K×32 group size). Built
+        # lazily: only runs that produce remainders pay its compile.
+        self._update_key = update_key
+        self._tail_blocks = max(1, self.blocks_per_dispatch // 8)
+        self._update_tail = None
+
+    def _compile_update(self, key):
+        return (
+            _fused_update_mesh(*key, self.mesh)
+            if self.data_parallel > 1
+            else _fused_update(*key)
+        )
 
     def _reduce_row_counts(self, rows: np.ndarray) -> np.ndarray:
         """(n_sets,) per-set totals: data-parallel slices each hold partial
@@ -711,42 +770,30 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
             )
         self.dispatches += 1
 
-    def _tail_update(self):
-        """The short-scan remainder program (``_tail_blocks`` instead of
-        ``blocks_per_dispatch``), compiled on first use and memoized at
-        module level like the main program."""
-        if self._update_tail is None:
-            key = (
-                self._update_key[:7]
-                + (self._tail_blocks,)
-                + self._update_key[8:]
-            )
-            self._update_tail = _fused_update(*key)
-        return self._update_tail
-
     def add_grid(self, first_index: int, last_index: int) -> None:
         """Single-slice fast path keeps scalar dispatches; data-parallel
-        instances use the shared round-robin. Full groups dispatch the main
-        program; the contig remainder runs through the ~8× shorter tail
-        program, bounding padding waste per contig to half a tail group."""
+        instances use the shared round-robin (both with the tail program
+        for remainders, bounding padding waste per contig to under one tail
+        group)."""
         if self.data_parallel > 1:
             super().add_grid(first_index, last_index)
             return
         main = self.sites_per_dispatch
-        tail = self.block_size * self._tail_blocks
         off = first_index
         while last_index - off >= main:
             self.add_range(off, main)
             off += main
             if self.dispatches == 1:
                 self.poke()
-        while off < last_index:
-            self._dispatch_single(
-                self._tail_update(), off, min(tail, last_index - off)
-            )
-            off += tail
-            if self.dispatches == 1:
-                self.poke()
+        if off < last_index:
+            tail_update, tail = self._tail_spec()
+            while off < last_index:
+                self._dispatch_single(
+                    tail_update, off, min(tail, last_index - off)
+                )
+                off += tail
+                if self.dispatches == 1:
+                    self.poke()
 
     def finalize_device(self) -> jax.Array:
         """The accumulated Gramian, still on device; for data-parallel
@@ -938,7 +985,7 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
             self.variant_rows = jax.device_put(
                 np.zeros((D,), np.int64), self._scalar_sharding
             )
-        self._update = _ring_update(
+        self._update_key = (
             int(vs_key),
             pops_padded.tobytes(),
             int(site_key),
@@ -955,6 +1002,12 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
             else int(np.asarray(pops, dtype=np.int32).max()) + 1,
             mesh,
         )
+        self._update = _ring_update(*self._update_key)
+        self._tail_blocks = max(1, self.blocks_per_dispatch // 8)
+        self._update_tail = None
+
+    def _compile_update(self, key):
+        return _ring_update(*key)
 
     def finalize_sharded(self) -> jax.Array:
         """(padded, padded) Gramian, row-sharded over ``samples`` — feeds

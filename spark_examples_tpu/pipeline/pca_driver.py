@@ -653,23 +653,45 @@ def run(argv: Sequence[str]) -> List[str]:
         conf.ingest == "auto" and synthetic_tpu and device_ok
     )
     # Every auto-eligible synthetic single-set config now takes the device
-    # path (dense or ring); packed ingest remains available explicitly.
+    # path (dense or ring); packed ingest remains available explicitly —
+    # for the synthetic source AND for single-set VCF file inputs (the
+    # native-parser fast path, ``sources/files.py:genotype_blocks``).
     use_packed = conf.ingest == "packed"
+    file_packed = (
+        conf.source == "file"
+        and not conf.input_path
+        and conf.pca_backend == "tpu"
+    )
     if use_device and not (synthetic_tpu and device_ok):
         raise ValueError(
             "--ingest device requires --source synthetic, --pca-backend tpu, "
             "distinct variant-set ids, and (for multi-set configs) the dense "
             "similarity strategy"
         )
-    if use_packed and not synthetic_tpu:
+    if use_packed and not (synthetic_tpu or file_packed):
         raise ValueError(
-            "--ingest packed requires --source synthetic and --pca-backend tpu"
+            "--ingest packed requires --pca-backend tpu and --source "
+            "synthetic or file (VCF inputs)"
         )
     if use_packed and len(conf.variant_set_id) != 1:
         raise ValueError(
             "--ingest packed supports a single variant set; use --ingest "
             "device (distinct sets) or --ingest wire"
         )
+    if use_packed and file_packed and not synthetic_tpu:
+        # Fail fast here with the other ingest preconditions, not from a
+        # worker thread mid-pipeline: packed file ingest is VCF-only.
+        from spark_examples_tpu.sources.files import file_set_ids
+
+        selected = dict(zip(file_set_ids(conf.input_files or []), conf.input_files))[
+            conf.variant_set_id[0]
+        ]
+        lowered = selected[:-3] if selected.endswith(".gz") else selected
+        if not lowered.endswith(".vcf"):
+            raise ValueError(
+                f"--ingest packed needs a .vcf[.gz] input; got {selected!r} "
+                "(use --ingest wire for JSONL/checkpoint inputs)"
+            )
     driver = VariantsPcaDriver(conf)
     from spark_examples_tpu.utils.tracing import StageTimes, device_trace
 
@@ -713,8 +735,11 @@ def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
         contigs = conf.get_contigs(driver.source, conf.variant_set_id)
         return driver.get_similarity_device_gen(contigs)
     if use_packed:
-        # Packed fast path: synthetic blocks straight onto the device.
-        source: SyntheticGenomicsSource = driver.source  # type: ignore[assignment]
+        # Packed fast path: dense genotype blocks straight onto the device
+        # — synthetic generation, or VCF arrays from the native parser
+        # (``sources/files.py``; pure-Python fallback, identical output).
+        source = driver.source
+        synthetic = isinstance(source, SyntheticGenomicsSource)
         contigs = conf.get_contigs(source, conf.variant_set_id)
         partitioner = VariantsPartitioner(contigs, conf.bases_per_partition)
         partitions = partitioner.get_partitions(conf.variant_set_id[0])
@@ -733,9 +758,15 @@ def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
                 driver.io_stats.add_variants(
                     sum(len(b["positions"]) for b in blocks)
                 )
-                # Wire-equivalent page accounting (shared helper).
-                driver.io_stats.requests += source.page_requests(
-                    part.contig, conf.bases_per_partition
+                # Wire-equivalent page accounting (shared helpers).
+                driver.io_stats.requests += (
+                    source.page_requests(part.contig, conf.bases_per_partition)
+                    if synthetic
+                    else source.page_requests(
+                        part.variant_set_id,
+                        part.contig,
+                        conf.bases_per_partition,
+                    )
                 )
             return blocks
 

@@ -34,6 +34,8 @@ import os
 import re
 import threading
 from bisect import bisect_left, bisect_right
+
+import numpy as np
 from typing import Dict, Iterator, List, Mapping, Optional, Sequence, Tuple
 
 from spark_examples_tpu.sharding.contig import (
@@ -374,6 +376,115 @@ def _max_span(records: List[Dict]) -> int:
     )
 
 
+#: SearchVariants page size mirrored by the packed path's request
+#: accounting (one request per page per shard, at least one per shard) —
+#: keeps I/O stats identical between the wire and packed ingest paths.
+FILE_PAGE_SIZE = 1024
+
+
+def _python_vcf_arrays(path: str, set_id: str):
+    """Pure-Python fallback producing the same arrays as the native parser
+    (``utils/native.py:parse_vcf_arrays``), derived from the wire records.
+    Like the native parser, rows with fewer sample columns than the header
+    zero-fill the missing samples (the header is the cohort authority)."""
+    callsets, tables = _parse_vcf(path, set_id)
+    n_samples = len(callsets)
+    contigs: List[str] = []
+    positions: List[int] = []
+    ends: List[int] = []
+    af: List[float] = []
+    hv_rows: List[np.ndarray] = []
+    for contig, (starts, records) in sorted(tables.items()):
+        for start, record in zip(starts, records):
+            calls = record.get("calls", [])
+            contigs.append(contig)
+            positions.append(start)
+            ends.append(int(record["end"]))
+            af_values = record.get("info", {}).get("AF")
+            af.append(float(af_values[0]) if af_values else float("nan"))
+            row = np.zeros(n_samples, dtype=np.int8)
+            for i, call in enumerate(calls[:n_samples]):
+                if any(g > 0 for g in call.get("genotype", [])):
+                    row[i] = 1
+            hv_rows.append(row)
+    hv = (
+        np.stack(hv_rows)
+        if hv_rows
+        else np.zeros((0, n_samples), dtype=np.int8)
+    )
+    return (
+        np.array(contigs, dtype=object),
+        np.array(positions, dtype=np.int64),
+        np.array(ends, dtype=np.int64),
+        np.array(af, dtype=np.float64),
+        hv,
+    )
+
+
+class _PackedVcf:
+    """Column-oriented view of one VCF: per-contig start-sorted arrays
+    (positions, AF, has-variation rows) feeding the packed ingest path —
+    parsed by the native C++ parser when available (``native/vcfparse.cpp``),
+    by Python otherwise, with identical output (tested)."""
+
+    def __init__(self, path: str, set_id: str):
+        from spark_examples_tpu.utils.native import (
+            parse_vcf_arrays,
+            vcf_library,
+        )
+
+        self.path = path
+        self.native = False
+        lowered = path[:-3] if path.endswith(".gz") else path
+        if not lowered.endswith(".vcf"):
+            raise ValueError(
+                f"packed ingest needs a .vcf[.gz] input; got {path!r}"
+            )
+        # Probe library availability BEFORE reading: without a compiler the
+        # fallback parser reads the file itself — no point paying a full
+        # read + gzip.decompress of a multi-GB VCF just to get None back.
+        if vcf_library() is not None:
+            with open(path, "rb") as f:
+                raw = f.read()
+            if path.endswith(".gz"):
+                raw = gzip.decompress(raw)
+            arrays = parse_vcf_arrays(raw)
+            self.native = arrays is not None
+        else:
+            arrays = None
+        if arrays is None:
+            arrays = _python_vcf_arrays(path, set_id)
+        contigs, positions, ends, af, hv = arrays
+        self.num_samples = hv.shape[1]
+        self.by_contig: Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
+        self.contig_bounds: Dict[str, int] = {}
+        for name in dict.fromkeys(contigs.tolist()):  # first-seen order
+            mask = contigs == name
+            order = np.argsort(positions[mask], kind="stable")
+            self.by_contig[str(name)] = (
+                positions[mask][order],
+                af[mask][order],
+                np.ascontiguousarray(hv[mask][order]),
+            )
+            self.contig_bounds[str(name)] = int(ends[mask].max())
+
+    def window(self, contig: Contig):
+        """(positions, af, hv) rows with start in [contig.start, contig.end)
+        — the STRICT shard semantics of the wire path."""
+        starts, af, hv = self.by_contig.get(
+            contig.reference_name, (np.empty(0, np.int64), None, None)
+        )
+        if af is None:
+            return (
+                np.empty(0, np.int64),
+                np.empty(0, np.float64),
+                np.zeros((0, self.num_samples), np.int8),
+            )
+        lo = int(np.searchsorted(starts, contig.start, side="left"))
+        hi = int(np.searchsorted(starts, contig.end - 1, side="right"))
+        return starts[lo:hi], af[lo:hi], hv[lo:hi]
+
+
 class FileClient(GenomicsClient):
     """A per-partition session over the shared parsed tables; counts one
     initialized request per page of results (REST-parity accounting)."""
@@ -407,7 +518,7 @@ class FileClient(GenomicsClient):
         self,
         request: Mapping,
         boundary: ShardBoundary = ShardBoundary.STRICT,
-        page_size: int = 1024,
+        page_size: int = FILE_PAGE_SIZE,
     ) -> Iterator[Dict]:
         return self._search(
             request["variantSetIds"], request, boundary, page_size
@@ -417,7 +528,7 @@ class FileClient(GenomicsClient):
         self,
         request: Mapping,
         boundary: ShardBoundary = ShardBoundary.STRICT,
-        page_size: int = 1024,
+        page_size: int = FILE_PAGE_SIZE,
     ) -> Iterator[Dict]:
         return self._search(
             request["readGroupSetIds"], request, boundary, page_size
@@ -440,6 +551,7 @@ class FileGenomicsSource(GenomicsSource):
         self.set_ids = file_set_ids(self.paths)
         self._by_id = dict(zip(self.set_ids, self.paths))
         self._tables: Dict[str, _FileTable] = {}
+        self._packed: Dict[str, _PackedVcf] = {}
         self._lock = threading.Lock()
 
     def _table(self, set_id: str) -> _FileTable:
@@ -460,6 +572,65 @@ class FileGenomicsSource(GenomicsSource):
             self._table(set_id)
         return FileClient(self._tables)
 
+    # ------------------------------------------------------ packed fast path
+
+    def packed(self, set_id: str) -> _PackedVcf:
+        """The column-oriented packed view of one VCF input (native parser
+        when available), parsed once under the same lock discipline as the
+        wire tables."""
+        with self._lock:
+            view = self._packed.get(set_id)
+            if view is None:
+                if set_id not in self._by_id:
+                    raise KeyError(
+                        f"unknown set id {set_id!r}; inputs are {self.set_ids}"
+                    )
+                view = _PackedVcf(self._by_id[set_id], set_id)
+                self._packed[set_id] = view
+            return view
+
+    def genotype_blocks(
+        self,
+        variant_set_id: str,
+        contig: Contig,
+        block_size: int = 1024,
+        min_allele_frequency: Optional[float] = None,
+    ) -> Iterator[Dict]:
+        """Packed fast path: dense has-variation blocks for the Gramian —
+        the same contract as the synthetic source's ``genotype_blocks``
+        (AF-filtered, all-zero-variation rows dropped, the
+        ``filter(_.size > 0)`` stage of ``VariantsPca.scala:206``)."""
+        positions, af, hv = self.packed(variant_set_id).window(contig)
+        if min_allele_frequency is not None:
+            # The reference's rule (``VariantsPca.scala:136-148``): strictly
+            # greater, first AF value, records without AF dropped (NaN here).
+            keep = np.nan_to_num(af, nan=-1.0) > min_allele_frequency
+            positions, af, hv = positions[keep], af[keep], hv[keep]
+        for off in range(0, len(positions), block_size):
+            hv_block = hv[off : off + block_size]
+            nonzero = hv_block.any(axis=1)
+            if not nonzero.any():
+                continue
+            yield {
+                "positions": positions[off : off + block_size][nonzero],
+                "has_variation": hv_block[nonzero].astype(np.uint8),
+                "af": af[off : off + block_size][nonzero],
+            }
+
+    def page_requests(
+        self, variant_set_id: str, contig: Contig, bases_per_partition: int
+    ) -> int:
+        """Wire-equivalent request accounting for a packed scan of
+        ``contig``: one request per ``FILE_PAGE_SIZE`` records per shard, at
+        least one per shard — exactly what ``FileClient.search_variants``
+        counts, so I/O stats agree between the wire and packed paths."""
+        view = self.packed(variant_set_id)
+        total = 0
+        for shard in contig.get_shards(bases_per_partition):
+            rows = len(view.window(shard)[0])
+            total += max(1, -(-rows // FILE_PAGE_SIZE))
+        return total
+
     def search_callsets(self, variant_set_ids: Sequence[str]) -> List[Dict]:
         out: List[Dict] = []
         seen = set()
@@ -475,6 +646,31 @@ class FileGenomicsSource(GenomicsSource):
         variant_set_id: str,
         sex_filter: SexChromosomeFilter = SexChromosomeFilter.INCLUDE_XY,
     ) -> List[Contig]:
+        from spark_examples_tpu.utils.native import vcf_library
+
+        path = self._by_id.get(variant_set_id)
+        lowered = (
+            path[:-3] if path and path.endswith(".gz") else (path or "")
+        )
+        with self._lock:
+            packed = self._packed.get(variant_set_id)
+            have_table = variant_set_id in self._tables
+        if (
+            packed is None
+            and not have_table
+            and lowered.endswith(".vcf")
+            and vcf_library() is not None
+        ):
+            # Neither view exists yet: the native packed parse is the cheap
+            # way to learn the contig extents (a packed --all-references run
+            # would otherwise pay the full per-record Python parse here).
+            packed = self.packed(variant_set_id)
+        if packed is not None:
+            contigs = [
+                Contig(name, 0, bound)
+                for name, bound in sorted(packed.contig_bounds.items())
+            ]
+            return filter_sex_chromosomes(contigs, sex_filter)
         return filter_sex_chromosomes(
             self._table(variant_set_id).contigs(), sex_filter
         )

@@ -1,0 +1,178 @@
+"""On-demand build + ctypes bindings for the native (C++) components.
+
+The compute path is JAX/XLA; the runtime AROUND it follows the reference in
+using native code where the hot loop is host-side — here the VCF data-plane
+parser (``native/vcfparse.cpp``) feeding the file source's packed ingest.
+No pybind11 in this image, so the extension is a plain C-ABI shared object
+compiled once with the system toolchain and loaded via ctypes; everything
+degrades to the pure-Python implementations when no compiler is available
+(``sources/files.py`` keeps the oracle).
+
+The build is content-addressed: the .so lands in
+``~/.cache/spark_examples_tpu/native/<sha of source+compiler>.so`` so source
+edits rebuild and unchanged sources never recompile. With
+``SPARK_EXAMPLES_TPU_NO_CACHE=1`` (test/CI hygiene) the artifact goes to a
+process-lifetime temp directory instead.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO_NATIVE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_error: Optional[str] = None
+
+
+def _compiler() -> Optional[str]:
+    for name in ("g++", "clang++", "c++"):
+        path = shutil.which(name)
+        if path:
+            return path
+    return None
+
+
+def _build_dir() -> str:
+    if os.environ.get("SPARK_EXAMPLES_TPU_NO_CACHE") == "1":
+        d = os.path.join(
+            tempfile.gettempdir(), f"spark_examples_tpu_native_{os.getuid()}"
+        )
+    else:
+        d = os.path.join(
+            os.path.expanduser("~/.cache"), "spark_examples_tpu", "native"
+        )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _build(source_path: str) -> str:
+    """Compile one translation unit to a content-addressed .so; returns its
+    path (reusing a previous identical build when present)."""
+    compiler = _compiler()
+    if compiler is None:
+        raise RuntimeError("no C++ compiler on PATH")
+    with open(source_path, "rb") as f:
+        source = f.read()
+    tag = hashlib.sha256(
+        source + compiler.encode() + sys.version.encode()
+    ).hexdigest()[:16]
+    out = os.path.join(
+        _build_dir(),
+        f"{os.path.splitext(os.path.basename(source_path))[0]}-{tag}.so",
+    )
+    if os.path.exists(out):
+        return out
+    tmp = out + f".build-{os.getpid()}"
+    cmd = [
+        compiler, "-O3", "-shared", "-fPIC", "-std=c++17",
+        "-o", tmp, source_path,
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build failed ({' '.join(cmd)}):\n{proc.stderr[-2000:]}"
+        )
+    os.replace(tmp, out)  # atomic: concurrent builders race benignly
+    return out
+
+
+def vcf_library() -> Optional[ctypes.CDLL]:
+    """The compiled VCF parser, or ``None`` (with the reason recorded) when
+    it cannot be built — callers fall back to pure Python."""
+    global _lib, _lib_error
+    if _lib is not None or _lib_error is not None:
+        return _lib
+    try:
+        path = _build(os.path.join(_REPO_NATIVE, "vcfparse.cpp"))
+        lib = ctypes.CDLL(path)
+        lib.vcf_scan.restype = ctypes.c_int
+        lib.vcf_scan.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.vcf_parse.restype = ctypes.c_int64
+        lib.vcf_parse.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ]
+        _lib = lib
+    except Exception as e:  # no compiler / build failure: fall back
+        _lib_error = str(e)
+        return None
+    return _lib
+
+
+def native_unavailable_reason() -> Optional[str]:
+    vcf_library()
+    return _lib_error
+
+
+def parse_vcf_arrays(text: bytes) -> Optional[Tuple[np.ndarray, ...]]:
+    """One native pass over decompressed VCF text.
+
+    Returns ``(contigs (L,) object, positions (L,) i64, ends (L,) i64,
+    af (L,) f64 — NaN where INFO has no AF, has_variation (L, N) i8)``, or
+    ``None`` when the native library is unavailable. Raises ``ValueError``
+    on malformed input (the Python parser raises too — parity includes the
+    failure mode).
+    """
+    lib = vcf_library()
+    if lib is None:
+        return None
+    n_lines = ctypes.c_int64()
+    n_samples = ctypes.c_int64()
+    rc = lib.vcf_scan(
+        text, len(text), ctypes.byref(n_lines), ctypes.byref(n_samples)
+    )
+    if rc != 0:
+        raise ValueError("VCF has no #CHROM header row")
+    L, N = n_lines.value, n_samples.value
+    positions = np.empty(L, dtype=np.int64)
+    ends = np.empty(L, dtype=np.int64)
+    af = np.empty(L, dtype=np.float64)
+    has_variation = np.zeros((L, max(N, 1)), dtype=np.int8)
+    contig_off = np.empty(L, dtype=np.int64)
+    contig_len = np.empty(L, dtype=np.int64)
+    parsed = lib.vcf_parse(
+        text, len(text), N, positions, ends, af, has_variation,
+        contig_off, contig_len,
+    )
+    if parsed < 0:
+        raise ValueError(f"malformed VCF data line #{-parsed}")
+    if parsed != L:
+        raise ValueError(f"parsed {parsed} of {L} VCF data lines")
+    contigs = np.empty(L, dtype=object)
+    for i in range(L):
+        contigs[i] = text[
+            contig_off[i] : contig_off[i] + contig_len[i]
+        ].decode("utf-8")
+    return contigs, positions, ends, af, has_variation[:, :N]
+
+
+__all__ = [
+    "vcf_library",
+    "native_unavailable_reason",
+    "parse_vcf_arrays",
+]

@@ -36,6 +36,78 @@ def test_mix64_matches_host():
     np.testing.assert_array_equal(got, _mix(xs))
 
 
+def test_fmix32_matches_host_and_murmur3_vectors():
+    """Device fmix32 == host _fmix32 == the published murmur3 finalizer
+    (golden vectors pin the stream definition: any accidental drift in
+    either implementation breaks loudly, not as a silent cohort change)."""
+    from spark_examples_tpu.ops.devicegen import fmix32
+    from spark_examples_tpu.sources.synthetic import _fmix32
+
+    xs = np.array(
+        [0, 1, 2, 0xDEADBEEF, 0xFFFFFFFF, 0x9E3779B9], dtype=np.uint32
+    )
+    host = _fmix32(xs)
+    got = np.asarray(jax.device_get(fmix32(jax.numpy.asarray(xs))))
+    np.testing.assert_array_equal(got, host)
+    # murmur3 fmix32 reference values (h ^= h>>16; h*=0x85ebca6b;
+    # h ^= h>>13; h*=0xc2b2ae35; h ^= h>>16), independently computed.
+    def reference(h):
+        h ^= h >> 16
+        h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+        h ^= h >> 13
+        h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+        h ^= h >> 16
+        return h
+
+    np.testing.assert_array_equal(host, [reference(int(x)) for x in xs])
+
+
+def test_genotype_draw_pair_golden_vectors():
+    """The v2 genotype stream definition, pinned: fold-after-sample-xor of
+    the splitmix64 site state, one fmix32, multiplicative second allele.
+    These values changing means the synthetic cohort itself changed —
+    every recorded benchmark and parity artifact would silently shift."""
+    from spark_examples_tpu.sources.synthetic import _genotype_draw_pair
+
+    d1, d2 = _genotype_draw_pair(
+        np.uint64(0x123456789ABCDEF0),
+        np.array([100, 7300], dtype=np.int64),
+        3,
+    )
+    assert d1.shape == (2, 3) and d1.dtype == np.uint32
+    # Independently recomputed with the documented construction.
+    def expected(vs_key, pos, sample):
+        M = (1 << 64) - 1
+        P1, P2, P3, P4 = (
+            0x9E3779B97F4A7C15,
+            0xC2B2AE3D27D4EB4F,
+            0x165667B19E3779F9,
+            0xD6E8FEB86659FD93,
+        )
+
+        def mix(x):
+            x = (x + P1) & M
+            x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & M
+            x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & M
+            return x ^ (x >> 31)
+
+        h2 = mix(mix(vs_key ^ (pos * P2 & M)) ^ (100 * P3 & M))
+        x64 = h2 ^ (sample * P4 & M)
+        x = ((x64 >> 32) ^ x64) & 0xFFFFFFFF
+        x ^= x >> 16
+        x = (x * 0x85EBCA6B) & 0xFFFFFFFF
+        x ^= x >> 13
+        x = (x * 0xC2B2AE35) & 0xFFFFFFFF
+        first = x ^ (x >> 16)
+        second = ((first * 0x9E3779B9) & 0xFFFFFFFF) ^ 0x85EBCA6B
+        return first, second
+
+    for i, pos in enumerate((100, 7300)):
+        for s in range(3):
+            e1, e2 = expected(0x123456789ABCDEF0, pos, s)
+            assert (int(d1[i, s]), int(d2[i, s])) == (e1, e2)
+
+
 def _host_blocks(source, vsid, contig, **kw):
     return list(source.genotype_blocks(vsid, contig, block_size=512, **kw))
 

@@ -216,6 +216,114 @@ def test_sam_reads_roundtrip(tmp_path):
     assert [r["fragmentName"] for r in overlapping] == ["r002"]
 
 
+def test_native_vcf_parser_matches_python_fallback(tmp_path):
+    """The C++ parser (native/vcfparse.cpp) and the pure-Python fallback
+    produce identical packed views — positions, AF values (NaN for absent),
+    and has-variation rows — for every contig."""
+    from spark_examples_tpu.sharding.contig import Contig
+    from spark_examples_tpu.sources.files import _PackedVcf
+    from spark_examples_tpu.utils import native as native_mod
+
+    path = _write(tmp_path, "mini.vcf.gz", _VCF, compress=True)
+    if native_mod.vcf_library() is None:
+        pytest.skip(f"no native build: {native_mod.native_unavailable_reason()}")
+    native_view = _PackedVcf(path, "mini")
+    assert native_view.native
+    fallback = _PackedVcf.__new__(_PackedVcf)
+    # Force the Python path: probe says no library → the fallback parser.
+    original = native_mod.vcf_library
+    try:
+        native_mod.vcf_library = lambda: None
+        fallback.__init__(path, "mini")
+    finally:
+        native_mod.vcf_library = original
+    assert not fallback.native
+    assert set(native_view.by_contig) == set(fallback.by_contig)
+    for contig in native_view.by_contig:
+        pos_n, af_n, hv_n = native_view.by_contig[contig]
+        pos_p, af_p, hv_p = fallback.by_contig[contig]
+        np.testing.assert_array_equal(pos_n, pos_p)
+        np.testing.assert_array_equal(hv_n, hv_p)
+        np.testing.assert_array_equal(np.isnan(af_n), np.isnan(af_p))
+        np.testing.assert_array_equal(
+            af_n[~np.isnan(af_n)], af_p[~np.isnan(af_p)]
+        )
+    # Window semantics: STRICT slice by start.
+    window = native_view.window(Contig("17", 205, 310))
+    assert window[0].tolist() == [308]
+
+
+def test_short_sample_lines_zero_fill_in_both_parsers(tmp_path):
+    """A data line with fewer sample columns than the header zero-fills the
+    missing samples — identically in the native parser and the Python
+    fallback (the header is the cohort authority)."""
+    from spark_examples_tpu.sources.files import _PackedVcf, _python_vcf_arrays
+    from spark_examples_tpu.utils import native as native_mod
+
+    vcf = (
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS1\tS2\tS3\n"
+        "17\t101\t.\tA\tG\t1\t.\tAF=0.5\tGT\t0|1\t1|1\n"
+    )
+    path = _write(tmp_path, "short.vcf", vcf)
+    _, _, _, _, hv_py = _python_vcf_arrays(path, "short")
+    np.testing.assert_array_equal(hv_py, [[1, 1, 0]])
+    if native_mod.vcf_library() is not None:
+        arrays = native_mod.parse_vcf_arrays(vcf.encode())
+        np.testing.assert_array_equal(arrays[4], hv_py)
+    view = _PackedVcf(path, "short")
+    assert view.num_samples == 3
+
+
+def test_file_packed_ingest_matches_wire(tmp_path, capsys):
+    """--source file --ingest packed: same principal components AND the same
+    partition/request accounting as the wire path (variants count follows
+    the documented packed-vs-wire divergence: packed counts kept rows)."""
+    path = _write(tmp_path, "mini.vcf", _VCF)
+    argv = [
+        "--source", "file", "--input-files", path, "--references", "17:0:1000",
+        "--min-allele-frequency", "0.05",
+    ]
+
+    def run_and_stats(ingest):
+        lines = pca_driver.run(argv + ["--ingest", ingest])
+        out = capsys.readouterr().out
+        fields = {
+            line.split(": ")[0]: int(line.split(": ")[1])
+            for line in out.splitlines()
+            if line.startswith("# of")
+        }
+        return lines, fields
+
+    packed_lines, packed_stats = run_and_stats("packed")
+    wire_lines, wire_stats = run_and_stats("wire")
+    assert packed_lines == wire_lines
+    for key in ("# of partitions", "# of bases requested", "# of API requests"):
+        assert packed_stats[key] == wire_stats[key]
+    assert packed_stats["# of variants read"] <= wire_stats["# of variants read"]
+
+
+def test_file_packed_rejects_multi_set(tmp_path):
+    a = _write(tmp_path, "a.vcf", _VCF)
+    b = _write(tmp_path, "b.vcf", _VCF)
+    with pytest.raises(ValueError, match="single variant set"):
+        pca_driver.run(
+            [
+                "--source", "file", "--input-files", f"{a},{b}",
+                "--ingest", "packed", "--references", "17:0:1000",
+            ]
+        )
+
+
+def test_native_parser_rejects_malformed_vcf(tmp_path):
+    from spark_examples_tpu.utils import native as native_mod
+
+    if native_mod.vcf_library() is None:
+        pytest.skip("no native build")
+    bad = "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n17\tnotanumber\t.\tA\tG\t1\t.\tAF=0.1\n"
+    with pytest.raises(ValueError, match="data line #1"):
+        native_mod.parse_vcf_arrays(bad.encode())
+
+
 def test_missing_input_files_flag_raises():
     with pytest.raises(ValueError, match="input-files"):
         pca_driver.run(["--source", "file"])
