@@ -1,0 +1,273 @@
+"""Fused Mosaic generate→Gramian kernel vs the production XLA path.
+
+The measurement record behind DESIGN.md §7.1. The kernel generates the
+(sites × samples) {0,1} genotype tile directly in VMEM — the per-(site,
+sample) plane is pure u32 because the u64 fold commutes with xor
+(``fold(h2 ^ s·P4) = fold(h2) ^ fold(s·P4)``), so only O(sites) u64
+metadata stays in XLA — and accumulates ``XᵀX`` on the MXU into a
+VMEM-resident (NPAD, NPAD) Gramian across the whole site grid.
+
+Verified bit-identical to ``ops/devicegen.py``'s XLA program, and slower:
+the i1→i8 relayout into Mosaic's 4-way packed int8 vectors costs more
+than the int8 matmul it feeds, and the cast-free bf16 route pays ~3× on
+the Mosaic MXU path. Variants (``VARIANT`` env var; ``TB`` = sites per
+grid step, default 1024):
+
+- ``full``     int8 X, int32 G — parity + timing (default)
+- ``fullbf16`` bf16 X, f32 G (exact: per-dispatch partials < 2^24)
+- ``gen``      generation + i1→i8 cast + X assembly, no matmul
+- ``gen32``    generation only, no i8 cast (isolates the cast cost)
+- ``genbf16``  generation + i1→bf16 cast (shows the bf16 cast is free)
+- ``dot``      trivial generation + int8 matmul (isolates the MXU side)
+
+(A ``min(d1, d2)`` single-compare variant does not compile: Mosaic has no
+vector ``arith.minui`` lowering.)
+"""
+import os
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from spark_examples_tpu.ops.devicegen import (
+    _c64,
+    _P2,
+    _P3,
+    _P4,
+    _S_GENOTYPE,
+    fmix32,
+    mix64,
+    site_thresholds_on_device,
+    generate_has_variation,
+)
+
+N = 2504
+P = 4
+SPACING = 73
+REF_FRAC = 0.25
+SITE_KEY = 0x1234_5678_9ABC_DEF0
+VS_KEY = 0x0FED_CBA9_8765_4321
+TB = int(os.environ.get("TB", 1024))  # sites per pallas grid step
+TN = 128  # columns per tile
+VARIANT = os.environ.get("VARIANT", "full")
+
+# ---- static column layout: per-pop segments padded to TN multiples ----
+pops_np = (np.arange(N, dtype=np.int64) * P) // N
+tiles = []  # (pop, n_valid_in_tile) per TN-column tile
+col_map = []  # padded col -> real col (or -1)
+start = 0
+for p in range(P):
+    stop = int(np.searchsorted(pops_np, p + 1))
+    for r0 in range(start, stop, TN):
+        nv = min(TN, stop - r0)
+        tiles.append((p, nv))
+        col_map.extend(range(r0, r0 + nv))
+        col_map.extend([-1] * (TN - nv))
+    start = stop
+col_map = np.array(col_map)
+NPAD = len(col_map)
+M_TILES = NPAD // TN
+
+valid_mask = col_map >= 0
+real_cols = np.where(valid_mask)[0]  # padded indices of real columns
+
+# fsamp: fold(col * P4) per padded column
+cols_u64 = col_map.astype(np.uint64) * np.uint64(_P4 & (2**64 - 1))
+fsamp_np = ((cols_u64 >> np.uint64(32)) ^ cols_u64).astype(np.uint32)
+fsamp_np[~valid_mask] = 0
+mask_np = valid_mask.astype(np.int32)
+
+
+def tile_hv(fs, tq_ref, fsamp_ref, mask_ref, m):
+    """(TB, TN) i1 has-variation for column tile ``m`` — the in-kernel u32
+    half of ``devicegen._allele_pair`` plus the threshold compare (the u64
+    xor+fold is pre-folded into ``fs``/``fsamp``); padding columns masked."""
+    pop, nv = tiles[m]
+    x32 = fs ^ fsamp_ref[0:1, m * TN:(m + 1) * TN]
+    d1 = fmix32(x32)
+    d2 = (d1 * jnp.uint32(0x9E3779B9)) ^ jnp.uint32(0x85EBCA6B)
+    tf = tq_ref[:, pop:pop + 1]
+    hv = (d1 < tf) | (d2 < tf)
+    if nv < TN:
+        hv = hv & (mask_ref[0:1, m * TN:(m + 1) * TN] != 0)
+    return hv
+
+
+def make_kernel(variant):
+    x_dtype = jnp.bfloat16 if variant == "fullbf16" else jnp.int8
+
+    def kernel(fsite_ref, tq_ref, fsamp_ref, mask_ref, g_ref, rowany_ref):
+        k = pl.program_id(0)
+
+        @pl.when(k == 0)
+        def _():
+            g_ref[:] = jnp.zeros_like(g_ref)
+
+        fs = fsite_ref[:, 0:1]  # (TB, 1) u32
+        if variant == "gen32":  # no cast at all
+            acc = None
+            for m in range(M_TILES):
+                hv = tile_hv(fs, tq_ref, fsamp_ref, mask_ref, m).astype(jnp.int32)
+                acc = hv if acc is None else jnp.maximum(acc, hv)
+            rowany_ref[:] = jnp.max(acc, axis=1, keepdims=True)
+            return
+        if variant == "genbf16":  # bf16 cast, no matmul
+            acc = None
+            for m in range(M_TILES):
+                hvb = tile_hv(fs, tq_ref, fsamp_ref, mask_ref, m).astype(jnp.bfloat16)
+                a = jnp.max(hvb.astype(jnp.float32), axis=1, keepdims=True)
+                acc = a if acc is None else jnp.maximum(acc, a)
+            rowany_ref[:] = acc.astype(jnp.int32)
+            return
+
+        x_parts = []
+        anyv = None
+        for m in range(M_TILES):
+            if variant == "dot":  # trivial generation, isolate the MXU side
+                hvx = (fs ^ fsamp_ref[0:1, m * TN:(m + 1) * TN]
+                       < tq_ref[:, 0:1]).astype(x_dtype)
+            else:
+                hvx = tile_hv(fs, tq_ref, fsamp_ref, mask_ref, m).astype(x_dtype)
+                a = jnp.max(hvx.astype(jnp.int32), axis=1, keepdims=True)
+                anyv = a if anyv is None else jnp.maximum(anyv, a)
+            x_parts.append(hvx)
+        X = jnp.concatenate(x_parts, axis=1)  # (TB, NPAD)
+        rowany_ref[:] = (
+            anyv if anyv is not None
+            else jnp.max(X[:, 0:1].astype(jnp.int32), axis=1, keepdims=True)
+        )
+        if variant != "gen":
+            acc_dt = jnp.float32 if variant == "fullbf16" else jnp.int32
+            g_ref[:] += jax.lax.dot_general(
+                X, X, (((0,), (0,)), ((), ())),
+                preferred_element_type=acc_dt,
+            )
+
+    return kernel
+
+
+def pallas_gram(fsite, tq, n_site_blocks, variant=VARIANT):
+    g_dtype = jnp.float32 if variant == "fullbf16" else jnp.int32
+    return pl.pallas_call(
+        make_kernel(variant),
+        grid=(n_site_blocks,),
+        in_specs=[
+            pl.BlockSpec((TB, 1), lambda k: (k, 0 * k), memory_space=pltpu.VMEM),
+            pl.BlockSpec((TB, P), lambda k: (k, 0 * k), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, NPAD), lambda k: (0 * k, 0 * k), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, NPAD), lambda k: (0 * k, 0 * k), memory_space=pltpu.VMEM),
+        ],
+        out_shape=(
+            jax.ShapeDtypeStruct((NPAD, NPAD), g_dtype),
+            jax.ShapeDtypeStruct((n_site_blocks * TB, 1), jnp.int32),
+        ),
+        out_specs=(
+            pl.BlockSpec((NPAD, NPAD), lambda k: (0 * k, 0 * k), memory_space=pltpu.VMEM),
+            pl.BlockSpec((TB, 1), lambda k: (k, 0 * k), memory_space=pltpu.VMEM),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+    )(fsite, tq, jnp.asarray(fsamp_np)[None, :], jnp.asarray(mask_np)[None, :])
+
+
+def metadata(grid_offset, n_sites):
+    """(fsite (n,1) u32, tq (n,P) u32, T) for grid indices [offset, offset+n)."""
+    idx = grid_offset + jnp.arange(n_sites, dtype=jnp.int64)
+    positions = idx * SPACING
+    valid = jnp.ones((n_sites,), bool)
+    T = site_thresholds_on_device(
+        _c64(SITE_KEY), positions, valid, P, REF_FRAC, None
+    )
+    pos_term = positions.astype(jnp.uint64) * _c64(_P2)
+    h2 = mix64(mix64(_c64(VS_KEY) ^ pos_term) ^ _c64(_S_GENOTYPE * _P3))
+    fsite = ((h2 >> jnp.uint64(32)) ^ h2).astype(jnp.uint32)
+    return fsite[:, None], T.astype(jnp.uint32), T
+
+
+def main():
+    with jax.enable_x64(True):
+        # ---- parity check on a small batch (full variants only) ----
+        if VARIANT in ("full", "fullbf16"):
+            nb = 2
+            ns = nb * TB
+            fsite, tq, T = metadata(jnp.int64(0), ns)
+            Gp, rowany = pallas_gram(fsite, tq, nb)
+            Gp = np.asarray(Gp).astype(np.int64)[np.ix_(real_cols, real_cols)]
+
+            positions = jnp.arange(ns, dtype=jnp.int64) * SPACING
+            hv = generate_has_variation(
+                positions, T, jnp.asarray([np.uint64(VS_KEY)]),
+                jnp.asarray(pops_np.astype(np.int32)), None,
+            )
+            X = hv.astype(jnp.int8)
+            Gref = np.asarray(
+                jnp.einsum("bn,bm->nm", X, X, preferred_element_type=jnp.int32)
+            )
+            rowany_ref = np.asarray(jnp.any(hv, axis=1)).astype(np.int32)
+            ok_g = np.array_equal(Gp, Gref)
+            print("parity G:", ok_g, "rowany:",
+                  np.array_equal(np.asarray(rowany)[:, 0], rowany_ref))
+            if not ok_g:
+                bad = np.argwhere(Gp != Gref)
+                print("mismatches:", len(bad), bad[:5])
+                return
+
+        # ---- timing: one production-sized dispatch of sites ----
+        NSITES = 524288
+        NB = NSITES // TB
+
+        @jax.jit
+        def pallas_dispatch(offset):
+            fsite, tq, _ = metadata(offset, NSITES)
+            G, ra = pallas_gram(fsite, tq, NB)
+            return G.astype(jnp.int32), jnp.sum(ra)
+
+        # In-script XLA replica of the production scanned-einsum program
+        # (ops/devicegen.py:_fused_update) — same harness overhead as the
+        # Mosaic variants. NOTE: the production program itself measures
+        # ~51 ms/dispatch at this group size (DESIGN.md §7 roofline); the
+        # replica pays ~70 ms (extra per-block reductions + this harness's
+        # x64 tracing context), so compare Mosaic variants against BOTH.
+        B, K = 16384, 32
+
+        @jax.jit
+        def xla_dispatch(offset):
+            def body(carry, kk):
+                G = carry
+                idx = offset + kk * B + jnp.arange(B, dtype=jnp.int64)
+                positions = idx * SPACING
+                valid = jnp.ones((B,), bool)
+                T = site_thresholds_on_device(
+                    _c64(SITE_KEY), positions, valid, P, REF_FRAC, None)
+                hv = generate_has_variation(
+                    positions, T, jnp.asarray([np.uint64(VS_KEY)]),
+                    jnp.asarray(pops_np.astype(np.int32)), None)
+                X = hv.astype(jnp.int8)
+                G = G + jnp.einsum("bn,bm->nm", X, X,
+                                   preferred_element_type=jnp.int32)
+                return G, jnp.sum(jnp.any(hv, axis=1))
+            G0 = jnp.zeros((N, N), jnp.int32)
+            G, ras = jax.lax.scan(body, G0, jnp.arange(K, dtype=jnp.int64))
+            return G, jnp.sum(ras)
+
+        for name, fn in [(f"pallas[{VARIANT}]", pallas_dispatch),
+                         ("xla replica", xla_dispatch)]:
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(jnp.int64(0)))
+            compile_s = time.perf_counter() - t0
+            reps = 5
+            t0 = time.perf_counter()
+            for r in range(reps):
+                out = fn(jnp.int64(r * NSITES))
+            jax.block_until_ready(out)
+            _ = np.asarray(out[1])
+            dt = (time.perf_counter() - t0) / reps
+            print(f"{name}: compile {compile_s:.1f}s, {dt*1e3:.1f} ms/dispatch, "
+                  f"{NSITES/dt/1e6:.2f} M sites/s")
+
+
+if __name__ == "__main__":
+    main()

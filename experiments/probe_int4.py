@@ -1,0 +1,44 @@
+"""Does an int4 einsum beat int8 for the Gramian on v5e? X is {0,1}."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+N = 2504
+B = 16384
+K = 32
+
+
+def bench(dtype_name):
+    dt = getattr(jnp, dtype_name)
+
+    @jax.jit
+    def run(Xu32, G0):
+        def body(G, _):
+            X = (Xu32 & 1).astype(dt)
+            G = G + jnp.einsum("bn,bm->nm", X, X,
+                               preferred_element_type=jnp.int32)
+            return G, None
+        G, _ = jax.lax.scan(body, G0, jnp.arange(K))
+        return G
+
+    x = jnp.asarray(
+        np.random.randint(0, 2**31, (B, N), dtype=np.int64).astype(np.uint32))
+    G0 = jnp.zeros((N, N), jnp.int32)
+    out = run(x, G0)
+    _ = np.asarray(out[0, 0])
+    t0 = time.perf_counter()
+    reps = 5
+    for _ in range(reps):
+        out = run(x, out)
+    _ = np.asarray(out[0, 0])
+    dt_s = (time.perf_counter() - t0) / reps
+    macs = B * K * N * N
+    print(f"{dtype_name}: {dt_s*1e3:7.1f} ms  {macs/dt_s/1e12:6.1f} Tmac/s")
+
+
+for d in ["int8", "int4", "bfloat16"]:
+    try:
+        bench(d)
+    except Exception as e:
+        print(f"{d}: FAILED {str(e)[:200]}")

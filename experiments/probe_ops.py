@@ -1,0 +1,61 @@
+"""Microbench: per-op cost of u32 VPU ops in a Mosaic kernel."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+R = 64
+SH = (1024, 2560)
+
+
+def make(op_name):
+    def kernel(x_ref, o_ref):
+        def body(i, x):
+            if op_name == "xor":
+                return x ^ (x + jnp.uint32(i))
+            if op_name == "mul":
+                return x * jnp.uint32(0x85EBCA6B) + jnp.uint32(i)
+            if op_name == "mul_i32":
+                xi = x.astype(jnp.int32)
+                # -2048144789 == int32(0x85EBCA6B): same low-32 product bits.
+                return (xi * np.int32(-2048144789) + i).astype(jnp.uint32)
+            if op_name == "shiftxor":
+                return (x ^ (x >> jnp.uint32(16))) + jnp.uint32(i)
+            if op_name == "fmix32":
+                y = (x ^ (x >> jnp.uint32(16))) * jnp.uint32(0x85EBCA6B)
+                y = (y ^ (y >> jnp.uint32(13))) * jnp.uint32(0xC2B2AE35)
+                return (y ^ (y >> jnp.uint32(16))) + jnp.uint32(i)
+            if op_name == "cmp":
+                return x + (x < jnp.uint32(0x7FFFFFFF + i)).astype(jnp.uint32)
+            raise ValueError(op_name)
+        o_ref[:] = jax.lax.fori_loop(0, R, body, x_ref[:])
+    return kernel
+
+
+def run(op_name):
+    f = pl.pallas_call(
+        make(op_name),
+        out_shape=jax.ShapeDtypeStruct(SH, jnp.uint32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 << 20),
+    )
+    fj = jax.jit(f)
+    x = jnp.asarray(np.random.randint(0, 2**32, SH, dtype=np.uint64).astype(np.uint32))
+    out = fj(x)
+    _ = np.asarray(out[0, 0])
+    t0 = time.perf_counter()
+    reps = 20
+    for _ in range(reps):
+        out = fj(out)
+    _ = np.asarray(out[0, 0])
+    dt = (time.perf_counter() - t0) / reps
+    per_elem_op = dt / (R * SH[0] * SH[1])
+    print(f"{op_name:10s}: {dt*1e3:7.2f} ms  {per_elem_op*1e12:7.2f} ps/elem/iter "
+          f"({1/per_elem_op/1e9:6.1f} Gelem-iter/s)")
+
+
+for op in ["xor", "shiftxor", "cmp", "mul", "mul_i32", "fmix32"]:
+    run(op)

@@ -1,0 +1,30 @@
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+def try_scratch(mb):
+    rows = int(mb * 1024 * 1024 // 4) // 1024
+    def kernel(x_ref, o_ref, scratch):
+        scratch[0:8, :] = x_ref[:]
+        o_ref[:] = scratch[0:8, :]
+    try:
+        f = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 1024), jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[pltpu.VMEM((rows, 1024), jnp.float32)],
+        )
+        jax.block_until_ready(f(jnp.ones((8, 1024), jnp.float32)))
+        return True
+    except Exception as e:
+        print(f"  {mb}MB error tail: ...{str(e)[-400:]}")
+        return False
+
+import sys
+for mb in [1, 4, 8, 12, 16, 24, 32, 40, 48, 64, 96, 120]:
+    ok = try_scratch(mb)
+    print(f"VMEM scratch {mb}MB: {'OK' if ok else 'FAIL'}")
+    sys.stdout.flush()
+    if not ok:
+        break
