@@ -1,0 +1,79 @@
+"""Triangular (symmetry-exploiting) Gramian blocking: measured and REJECTED.
+
+G = XᵀX only needs its upper-triangle column-tile pairs (T(T+1)/2 of T²
+tiles) plus one mirror. Honest interleaved measurement (NP env var sets the
+padded cohort width; medians over round-robin rounds; per-scan-step-varying
+X so XLA cannot hoist the dot out of the scan — a first version measured an
+illusory 4× because the loop-invariant einsum WAS hoisted, timing one dot +
+K adds):
+
+    N=2560  T=4: -11%   N=12800 T=4: -22%   N=25088 T=2: -4%, T=4: +28%
+
+Midrange gains don't cover the production configs (2,504-sample headline:
+noise-level; 25,000-sample large-cohort: regression from G slice-update HBM
+traffic), so the accumulators keep the single full einsum."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+B = 16384
+K = 8
+import os
+NP = int(os.environ.get('NP', 2560))
+
+
+def make(T):
+    pad = NP // T
+
+    @jax.jit
+    def tri(Xu, G0):
+        def body(G, kk):
+            # kk-dependent X so XLA cannot hoist the dots out of the scan
+            X = ((Xu >> kk.astype(jnp.uint32)) & 1).astype(jnp.int8)
+            if T == 1:
+                return G + jnp.einsum("bn,bm->nm", X, X,
+                                      preferred_element_type=jnp.int32), None
+            for i in range(T):
+                Xi = jax.lax.slice_in_dim(X, i * pad, (i + 1) * pad, axis=1)
+                for j in range(i, T):
+                    Xj = jax.lax.slice_in_dim(X, j * pad, (j + 1) * pad, axis=1)
+                    blk = jax.lax.dot_general(
+                        Xi, Xj, (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.int32)
+                    G = jax.lax.dynamic_update_slice(
+                        G,
+                        jax.lax.dynamic_slice(G, (i * pad, j * pad), (pad, pad)) + blk,
+                        (i * pad, j * pad))
+            return G, None
+        G, _ = jax.lax.scan(body, G0, jnp.arange(K) % 8)
+        if T > 1:
+            G = jnp.triu(G) + jnp.triu(G, 1).T
+        return G
+    return tri
+
+
+variants = {T: make(T) for T in [1, 2, 4] if NP % T == 0}
+x = jnp.asarray(np.random.randint(0, 2**31, (B, NP), dtype=np.int64)
+                .astype(np.uint32))
+G0 = jnp.zeros((NP, NP), jnp.int32)
+for T, fn in variants.items():
+    out = fn(x, G0)
+    _ = np.asarray(out[0, 0])  # compile + settle
+
+CHAIN = 10
+times = {T: [] for T in variants}
+for rnd in range(6):
+    for T, fn in variants.items():
+        t0 = time.perf_counter()
+        out = G0
+        for _ in range(CHAIN):
+            out = fn(x, out)
+        _ = np.asarray(out[0, 0])
+        times[T].append((time.perf_counter() - t0) / CHAIN)
+
+for T, ts in times.items():
+    ts = sorted(ts)
+    med = ts[len(ts) // 2]
+    print(f"T={T}: median {med*1e3:7.1f} ms/call  min {ts[0]*1e3:7.1f}  "
+          f"max {ts[-1]*1e3:7.1f}", flush=True)
