@@ -151,9 +151,25 @@ int64_t vcf_parse(const char* buf, int64_t len, int64_t n_samples,
                 const char* vb = q + 3;
                 const char* ve = vb;
                 while (ve < fe && *ve != ';' && *ve != ',') ++ve;
+                // Shared AF grammar (sources/files.py:af_float must match
+                // bit for bit): trim ' '/'\t', then the value must be
+                // 1..63 chars drawn from [0-9eE+-.] and fully strtod-
+                // consumable. The charset gate closes every divergence
+                // between strtod and Python float() (hex forms, digit
+                // underscores, inf/nan words, exotic whitespace).
+                while (vb < ve && (*vb == ' ' || *vb == '\t')) ++vb;
+                while (ve > vb && (*(ve - 1) == ' ' || *(ve - 1) == '\t'))
+                    --ve;
                 char tmp[64];
                 size_t n = static_cast<size_t>(ve - vb);
-                if (n > 0 && n < sizeof(tmp)) {
+                bool charset_ok = n > 0;
+                for (const char* c = vb; charset_ok && c < ve; ++c) {
+                    char ch = *c;
+                    charset_ok = (ch >= '0' && ch <= '9') || ch == '.' ||
+                                 ch == '+' || ch == '-' || ch == 'e' ||
+                                 ch == 'E';
+                }
+                if (charset_ok && n < sizeof(tmp)) {
                     memcpy(tmp, vb, n);
                     tmp[n] = '\0';
                     char* endp = nullptr;

@@ -52,6 +52,7 @@ from spark_examples_tpu.pipeline.datasets import VariantsDataset, _parallel_shar
 from spark_examples_tpu.pipeline.stats import VariantsDatasetStats
 from spark_examples_tpu.sharding.partitioners import VariantsPartitioner
 from spark_examples_tpu.sources.base import GenomicsSource
+from spark_examples_tpu.sources.files import FileGenomicsSource, af_float
 from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource
 
 
@@ -103,8 +104,6 @@ def make_source(conf: PcaConf) -> GenomicsSource:
             ),
         )
     if conf.source == "file":
-        from spark_examples_tpu.sources.files import FileGenomicsSource
-
         return FileGenomicsSource(conf.input_files or [])
     from spark_examples_tpu.sources.base import get_access_token
     from spark_examples_tpu.sources.rest import RestGenomicsSource
@@ -175,6 +174,11 @@ class VariantsPcaDriver:
             return bool(
                 af_passes(float(af[0]), self.conf.min_allele_frequency)
             )
+        if isinstance(self.source, FileGenomicsSource):
+            # Same AF grammar as the packed/native ingest of the SAME file
+            # (unparseable → NaN → dropped): the two ingest modes must agree
+            # record for record.
+            return af_float(af[0]) > self.conf.min_allele_frequency
         return float(af[0]) > self.conf.min_allele_frequency
 
     # ----------------------------------------------------------------- calls

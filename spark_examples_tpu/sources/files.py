@@ -92,6 +92,30 @@ def file_set_ids(paths: Sequence[str]) -> List[str]:
     return ids
 
 
+_AF_CHARSET = frozenset("0123456789eE+-.")
+
+
+def af_float(value: Optional[str]) -> float:
+    """The file paths' AF grammar, shared bit for bit by the native parser
+    (``native/vcfparse.cpp``), the Python fallback, and the file-backed wire
+    filter: trim ``' '``/``'\\t'``, then the value must be 1..63 chars drawn
+    from ``[0-9eE+-.]`` and float()-parseable; anything else — including a
+    missing value — behaves as absent (NaN, which compares False against any
+    threshold). The charset gate closes every strtod↔float() divergence
+    (hex forms, digit underscores, inf/nan words, exotic whitespace). The
+    REST path keeps the reference's throwing ``float()``
+    (``VariantsPca.scala:136-148`` ``.toDouble``)."""
+    if value is None:
+        return float("nan")
+    value = value.strip(" \t")
+    if not value or len(value) >= 64 or not _AF_CHARSET.issuperset(value):
+        return float("nan")
+    try:
+        return float(value)
+    except ValueError:
+        return float("nan")
+
+
 def _open_text(path: str):
     return gzip.open(path, "rt") if path.endswith(".gz") else open(path, "rt")
 
@@ -401,7 +425,7 @@ def _python_vcf_arrays(path: str, set_id: str):
             positions.append(start)
             ends.append(int(record["end"]))
             af_values = record.get("info", {}).get("AF")
-            af.append(float(af_values[0]) if af_values else float("nan"))
+            af.append(af_float(af_values[0] if af_values else None))
             row = np.zeros(n_samples, dtype=np.int8)
             for i, call in enumerate(calls[:n_samples]):
                 if any(g > 0 for g in call.get("genotype", [])):
@@ -603,8 +627,9 @@ class FileGenomicsSource(GenomicsSource):
         positions, af, hv = self.packed(variant_set_id).window(contig)
         if min_allele_frequency is not None:
             # The reference's rule (``VariantsPca.scala:136-148``): strictly
-            # greater, first AF value, records without AF dropped (NaN here).
-            keep = np.nan_to_num(af, nan=-1.0) > min_allele_frequency
+            # greater, first AF value, records without AF dropped (NaN here;
+            # NaN > t is False, so absent/unparseable AF never passes).
+            keep = af > min_allele_frequency
             positions, af, hv = positions[keep], af[keep], hv[keep]
         for off in range(0, len(positions), block_size):
             hv_block = hv[off : off + block_size]
@@ -679,6 +704,7 @@ class FileGenomicsSource(GenomicsSource):
 __all__ = [
     "FileGenomicsSource",
     "FileClient",
+    "af_float",
     "file_set_id",
     "file_set_ids",
 ]

@@ -3,6 +3,7 @@ partitioner/STRICT machinery as every other backend (the real-data ingest
 path the reference lived on, ``rdd/VariantsRDD.scala:198-225``)."""
 
 import gzip
+import os
 import textwrap
 
 import numpy as np
@@ -392,3 +393,179 @@ def test_reads_example4_needs_two_files(tmp_path):
     path = _write(tmp_path, "only_one.sam", _SAM)
     with pytest.raises(ValueError, match="normal_readset, tumor_readset"):
         main(["search-reads-example-4", "--source", "file", "--input-files", path])
+
+
+# --------------------------------------------------------------------------
+# Property-based native/Python parser parity (the C++ data plane is the one
+# component where a parsing divergence or memory error would corrupt ingest
+# silently — fuzz the whole VCF grammar surface, not just handwritten files).
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+_gt_alleles = st.one_of(
+    st.just("."),
+    st.integers(min_value=0, max_value=12).map(str),
+)
+_gt_field = st.builds(
+    lambda alleles, sep: sep.join(alleles),
+    st.lists(_gt_alleles, min_size=1, max_size=3),
+    st.sampled_from(["/", "|"]),
+)
+_af_value = st.one_of(
+    st.just("0.5"),
+    st.floats(
+        min_value=0.0, max_value=1.0, allow_nan=False, allow_infinity=False
+    ).map(repr),
+    st.sampled_from(
+        [
+            "1e-3", ".5", "5.", "+0.25", "-0", "0,0.5", "junk", "",
+            "0.2_5", "0.5 ", " 0.5", "0x1A", "inf", "nan", "1e999",
+            "0." + "1" * 70, "0.5" + " " * 61,
+        ]
+    ),
+)
+_info_field = st.one_of(
+    st.just("."),
+    st.just("DB"),
+    st.just("NS=3;DP=14"),
+    _af_value.map(lambda v: f"AF={v}"),
+    _af_value.map(lambda v: f"NS=2;AF={v};DB"),
+    st.just("XAF=9"),  # must NOT match as AF
+)
+_format_field = st.sampled_from(["GT", "GT:DP", "DP:GT", "DP"])
+
+
+@st.composite
+def _vcf_documents(draw):
+    n_samples = draw(st.integers(min_value=0, max_value=5))
+    n_records = draw(st.integers(min_value=0, max_value=12))
+    crlf = draw(st.booleans())
+    lines = ["##fileformat=VCFv4.2"]
+    header = "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT" + "".join(
+        f"\tS{i}" for i in range(n_samples)
+    )
+    # A sample-free VCF has no FORMAT column either.
+    if n_samples == 0:
+        header = header[: header.rindex("\tFORMAT")]
+    lines.append(header)
+    for r in range(n_records):
+        contig = draw(st.sampled_from(["1", "17", "chr2", "X"]))
+        pos = draw(st.integers(min_value=1, max_value=10_000))
+        ref = draw(st.sampled_from(["A", "AT", "GCC"]))
+        fields = [
+            contig,
+            str(pos),
+            draw(st.sampled_from([".", f"rs{r}"])),
+            ref,
+            draw(st.sampled_from([".", "G", "G,T"])),
+            ".",
+            ".",
+            draw(_info_field),
+        ]
+        if n_samples:
+            fmt = draw(_format_field)
+            fields.append(fmt)
+            # Sometimes fewer sample columns than the header declares.
+            n_cols = draw(
+                st.sampled_from([n_samples, max(0, n_samples - 1)])
+            )
+            for _ in range(n_cols):
+                gt = draw(_gt_field)
+                subfields = {
+                    "GT": gt,
+                    "GT:DP": f"{gt}:7",
+                    "DP:GT": f"7:{gt}",
+                    "DP": "7",
+                }[fmt]
+                fields.append(subfields)
+        lines.append("\t".join(fields))
+    eol = "\r\n" if crlf else "\n"
+    return eol.join(lines) + eol
+
+
+def _group_by_contig(contigs, positions, ends, af, hv):
+    """{contig: (positions, ends, af, hv)} sorted by position (stable → file
+    order on ties) — the _PackedVcf grouping, applied to raw arrays."""
+    out = {}
+    for name in dict.fromkeys(contigs.tolist()):
+        mask = contigs == name
+        order = np.argsort(positions[mask], kind="stable")
+        out[str(name)] = (
+            positions[mask][order],
+            ends[mask][order],
+            af[mask][order],
+            hv[mask][order],
+        )
+    return out
+
+
+@settings(max_examples=40, deadline=None)
+@given(document=_vcf_documents())
+def test_fuzz_native_parser_matches_python(document):
+    import tempfile
+
+    from spark_examples_tpu.sources.files import _python_vcf_arrays
+    from spark_examples_tpu.utils import native as native_mod
+
+    if native_mod.vcf_library() is None:
+        pytest.skip(f"no native build: {native_mod.native_unavailable_reason()}")
+
+    native = native_mod.parse_vcf_arrays(document.encode())
+    fd, path = tempfile.mkstemp(suffix=".vcf")
+    try:
+        with os.fdopen(fd, "w", newline="") as f:
+            f.write(document)
+        python = _python_vcf_arrays(path, "fuzz")
+    finally:
+        os.unlink(path)
+
+    by_native = _group_by_contig(*native)
+    by_python = _group_by_contig(*python)
+    assert set(by_native) == set(by_python)
+    for contig in by_native:
+        pos_n, end_n, af_n, hv_n = by_native[contig]
+        pos_p, end_p, af_p, hv_p = by_python[contig]
+        np.testing.assert_array_equal(pos_n, pos_p)
+        np.testing.assert_array_equal(end_n, end_p)
+        np.testing.assert_array_equal(hv_n, hv_p)
+        np.testing.assert_array_equal(np.isnan(af_n), np.isnan(af_p))
+        np.testing.assert_array_equal(
+            af_n[~np.isnan(af_n)], af_p[~np.isnan(af_p)]
+        )
+
+
+def test_wire_and_packed_agree_on_unparseable_af(tmp_path, capsys):
+    """``--min-allele-frequency`` must drop junk/hex/absent AF identically in
+    BOTH ingest modes of the same file — the wire filter shares the packed
+    parsers' AF grammar (``af_float``) instead of the REST path's throwing
+    float()."""
+    from spark_examples_tpu.cli import main
+
+    vcf = (
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS0\tS1\tS2\n"
+        "17\t101\t.\tA\tG\t.\t.\tAF=0.5\tGT\t0|1\t0|0\t1|1\n"
+        "17\t205\t.\tAT\tG\t.\t.\tNS=2;AF=1e-3;DB\tGT\t0/0\t0/1\t1|1\n"
+        "17\t308\t.\tG\tC\t.\t.\tAF=junk\tGT\t1|1\t0|0\t0|1\n"
+        "17\t410\t.\tC\tT\t.\t.\tAF=0x1A\tGT\t0|1\t0|1\t0|0\n"
+        "17\t512\t.\tT\tA\t.\t.\tXAF=9\tGT\t0|0\t0|1\t1|1\n"
+    )
+    path = _write(tmp_path, "junk_af.vcf", vcf)
+    outputs = []
+    for ingest in ("wire", "packed"):
+        rc = main(
+            [
+                "variants-pca",
+                "--source", "file",
+                "--input-files", path,
+                "--ingest", ingest,
+                "--min-allele-frequency", "0.0001",
+                "--references", "17:0:1000",
+            ]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        outputs.append(
+            [l for l in out.splitlines() if l.startswith("S")]
+        )
+    assert outputs[0] == outputs[1]
+    assert len(outputs[0]) == 3  # all three samples emitted
