@@ -119,6 +119,40 @@ def child_check(
         X = np.asarray(block["has_variation"], dtype=np.int64)
         oracle += X.T @ X
 
+    # Second composition: RING ingest over a samples-only mesh spanning all
+    # processes — every slice generates ONLY its own sample-column block and
+    # the ``ppermute`` ring exchange (``ops/gramian.py:_ring_tiles``) crosses
+    # the process boundary on every hop, which the single-process suite and
+    # dryrun can never exercise for real.
+    from spark_examples_tpu.ops.devicegen import DeviceGenRingGramianAccumulator
+    from spark_examples_tpu.parallel.mesh import SAMPLES_AXIS, make_mesh
+
+    ring_mesh = make_mesh({SAMPLES_AXIS: jax.device_count()})
+    ring = DeviceGenRingGramianAccumulator(
+        num_samples=source.num_samples,
+        vs_key=source.genotype_stream_key(variant_set),
+        pops=source.populations,
+        site_key=source.site_key,
+        spacing=source.variant_spacing,
+        ref_block_fraction=source.ref_block_fraction,
+        mesh=ring_mesh,
+        min_af_micro=af_filter_micro(_MIN_AF),
+        block_size=64,
+        blocks_per_dispatch=2,
+        exact_int=True,
+        n_pops=source.n_pops,
+    )
+    ring.add_grid(k0, k1)
+    # One finalize reduction, probed for spans and fetched from the same
+    # array (``ring.finalize()`` would rebuild + re-run the sharded sum).
+    from spark_examples_tpu.parallel.mesh import host_value
+
+    ring_sharded = ring.finalize_sharded()
+    ring_spans = not bool(ring_sharded.is_fully_addressable)
+    with jax.enable_x64(True):
+        ring_full = host_value(ring_sharded)
+    ring_gramian = ring_full[: source.num_samples, : source.num_samples]
+
     return {
         "process_id": process_id,
         "num_processes": num_processes,
@@ -129,6 +163,11 @@ def child_check(
         "result_spans_processes": spans_processes,
         "gramian_ok": bool(np.array_equal(gramian.astype(np.int64), oracle)),
         "gramian_sum": int(gramian.sum()),
+        "ring_mesh_shape": dict(ring_mesh.shape),
+        "ring_spans_processes": ring_spans,
+        "ring_gramian_ok": bool(
+            np.array_equal(ring_gramian.astype(np.int64), oracle)
+        ),
         "variant_rows": [int(v) for v in per_set_rows],
         "kept_sites": int(kept_sites),
     }
@@ -216,9 +255,11 @@ def verify_multihost(
     """Spawn a real N-process ``jax.distributed`` run on localhost and verify
     it end to end; returns the machine-readable report.
 
-    Phase 1 — ``child_check`` in every process: data-parallel device ingest
-    over the global mesh, cross-slice finalize reduce, Gramian == host oracle
-    asserted per process.
+    Phase 1 — ``child_check`` in every process: (a) data-parallel device
+    ingest over the global mesh with the cross-slice finalize reduce, and
+    (b) RING ingest over a samples-only mesh whose ``ppermute`` hops cross
+    the process boundary; both Gramians == host oracle, asserted per
+    process.
 
     Phase 2 (``run_cli``) — the unmodified ``variants-pca`` CLI launched
     across a fresh set of coordinator-connected processes; all processes must
@@ -259,13 +300,18 @@ def verify_multihost(
     gramian_ok = all(c.get("gramian_ok") for c in children) and all(
         r.returncode == 0 for r in check_runs
     )
-    spans = all(c.get("result_spans_processes") for c in children)
+    ring_ok = all(c.get("ring_gramian_ok") for c in children)
+    spans = all(
+        c.get("result_spans_processes") and c.get("ring_spans_processes")
+        for c in children
+    )
 
     report: Dict[str, object] = {
         "num_processes": num_processes,
         "local_devices_per_process": local_devices,
         "children": children,
         "gramian_ok": gramian_ok,
+        "ring_gramian_ok": ring_ok,
         "result_spans_processes": spans,
     }
 
@@ -321,9 +367,11 @@ def verify_multihost(
             report["cli_errors"] = [
                 (run.stderr or "")[-2000:] for run in cli_runs if run.returncode
             ]
-        report["ok"] = bool(gramian_ok and spans and cli_ok and identical)
+        report["ok"] = bool(
+            gramian_ok and ring_ok and spans and cli_ok and identical
+        )
     else:
-        report["ok"] = bool(gramian_ok and spans)
+        report["ok"] = bool(gramian_ok and ring_ok and spans)
     return report
 
 
@@ -349,7 +397,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             args.coordinator_address, args.num_processes, args.process_id
         )
         print(_CHILD_TAG + json.dumps(verdict), flush=True)
-        return 0 if verdict["gramian_ok"] else 1
+        return 0 if verdict["gramian_ok"] and verdict["ring_gramian_ok"] else 1
 
     report = verify_multihost(
         num_processes=args.num_processes, local_devices=args.local_devices

@@ -104,9 +104,14 @@ def af_float(value: Optional[str]) -> float:
     threshold). The charset gate closes every strtod↔float() divergence
     (hex forms, digit underscores, inf/nan words, exotic whitespace). The
     REST path keeps the reference's throwing ``float()``
-    (``VariantsPca.scala:136-148`` ``.toDouble``)."""
+    (``VariantsPca.scala:136-148`` ``.toDouble``).
+
+    JSONL wire records may carry AF as a JSON number rather than a string
+    (``{"info": {"AF": [0.25]}}``) — numbers pass straight through."""
     if value is None:
         return float("nan")
+    if isinstance(value, (int, float)):
+        return float(value)
     value = value.strip(" \t")
     if not value or len(value) >= 64 or not _AF_CHARSET.issuperset(value):
         return float("nan")

@@ -569,3 +569,45 @@ def test_wire_and_packed_agree_on_unparseable_af(tmp_path, capsys):
         )
     assert outputs[0] == outputs[1]
     assert len(outputs[0]) == 3  # all three samples emitted
+
+
+def test_jsonl_numeric_af_filters_without_crashing(tmp_path, capsys):
+    """JSONL wire records carry AF as JSON numbers; the file-backed filter
+    must treat them like their string forms instead of crashing."""
+    import json as _json
+
+    from spark_examples_tpu.cli import main
+
+    records = [
+        {
+            "referenceName": "17",
+            "start": 100 + 10 * i,
+            "end": 101 + 10 * i,
+            "referenceBases": "A",
+            "alternateBases": ["G"],
+            "info": {"AF": [af]},
+            "calls": [
+                {
+                    "callSetId": f"j-{s}",
+                    "callSetName": f"S{s}",
+                    "genotype": [1, 0] if (i + s) % 2 else [0, 0],
+                }
+                for s in range(3)
+            ],
+        }
+        for i, af in enumerate([0.5, 0.002, 1e-9, "junk"])
+    ]
+    path = tmp_path / "numeric_af.jsonl"
+    path.write_text("".join(_json.dumps(r) + "\n" for r in records))
+    rc = main(
+        [
+            "variants-pca",
+            "--source", "file",
+            "--input-files", str(path),
+            "--min-allele-frequency", "0.001",
+            "--references", "17:0:1000",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert len([l for l in out.splitlines() if l.startswith("S")]) == 3

@@ -16,13 +16,16 @@ from spark_examples_tpu.parallel.multihost import verify_multihost
 
 
 def test_two_process_distributed_run():
-    """Phase 1: data-parallel device ingest over the global 2×4-device mesh,
-    cross-slice finalize reduce, Gramian == host oracle in both processes.
-    Phase 2: the unmodified variants-pca CLI across two coordinator-connected
-    processes prints byte-identical principal components."""
+    """Phase 1: (a) data-parallel device ingest over the global 2×4-device
+    mesh with the cross-slice finalize reduce, and (b) ring ingest over the
+    samples-only mesh whose ppermute hops cross the process boundary —
+    Gramians == host oracle in both processes. Phase 2: the unmodified
+    variants-pca CLI across two coordinator-connected processes prints
+    byte-identical principal components."""
     report = verify_multihost(num_processes=2, local_devices=4)
     assert report["gramian_ok"], json.dumps(report, indent=2)
-    # The global result must actually span both processes — otherwise this
+    assert report["ring_gramian_ok"], json.dumps(report, indent=2)
+    # The global results must actually span both processes — otherwise this
     # test would silently degrade into a single-controller run.
     assert report["result_spans_processes"], json.dumps(report, indent=2)
     for child in report["children"]:
