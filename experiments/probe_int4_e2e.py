@@ -1,0 +1,47 @@
+"""int4 vs int8 MXU operands in the REAL device-ingest bench configs.
+
+probe_int4.py showed int4 ≈ +18% on the isolated Gramian einsum; this
+runs the actual bench configs (full driver pipeline) with
+``_operand_dtypes`` patched to int4 on the exact path, to see what
+survives end to end.
+
+Outcome (v5e, 2026-07-31): nothing — large-cohort 8.73 s (int8) vs
+8.75 s (int4), whole-genome 4.36 vs 4.34; the isolated probe's +18% is
+an artifact of its cheaper ``(u32 & 1).astype`` cast. int8 stays.
+"""
+import sys
+
+import jax
+import jax.numpy as jnp
+
+import bench
+import spark_examples_tpu.ops.gramian as gramian
+
+
+def run(config, dtype_name):
+    orig = gramian._operand_dtypes
+
+    def patched(exact_int, mesh=None):
+        op, acc = orig(exact_int, mesh)
+        if dtype_name == "int4" and op == jnp.int8.dtype or dtype_name == "int4" and str(op) == "int8":
+            return jnp.int4, acc
+        return op, acc
+
+    gramian._operand_dtypes = patched if dtype_name == "int4" else orig
+    try:
+        payload = bench._run_config(config, jax.devices()[0])
+    finally:
+        gramian._operand_dtypes = orig
+    print(
+        f"{config} [{dtype_name}]: {payload['value']} s  "
+        f"({payload['details']['sites_per_sec_per_chip']} sites/s/chip, "
+        f"compile {payload['details']['compile_seconds_excluded']}s)",
+        file=sys.__stdout__, flush=True,
+    )
+
+
+import contextlib, io
+for config in ("large-cohort", "whole-genome"):
+    for dt in ("int8", "int4"):
+        with contextlib.redirect_stdout(io.StringIO()):
+            run(config, dt)
