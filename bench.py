@@ -28,6 +28,7 @@ Prints exactly one JSON line (driver stage prints are redirected to stderr).
 import argparse
 import contextlib
 import json
+import os
 import sys
 import time
 
@@ -39,8 +40,8 @@ BASELINE_SECONDS = 7200.0
 # Measured optimum on v5e (DESIGN.md "single-chip ingest roofline"): large
 # dispatch groups amortize per-dispatch overhead; contig remainders run
 # through the accumulator's ~K/8 tail program, so group padding stays <2%.
-BLOCK = 16384
-BLOCKS_PER_DISPATCH = 32
+BLOCK = int(os.environ.get("BENCH_BLOCK", 16384))
+BLOCKS_PER_DISPATCH = int(os.environ.get("BENCH_BLOCKS_PER_DISPATCH", 32))
 # Warmup covers BOTH compiled programs: one full main group plus one tail
 # group (main + block*K/8 sites).
 WARMUP_BASES = VARIANT_SPACING * (
@@ -202,6 +203,8 @@ def _run_config(name: str, device) -> dict:
             "sites_per_sec_per_chip": round(sites_scanned / wall / chips_used),
             "chips_used": chips_used,
             "device_dispatches": acc.dispatches,
+            "block_size": BLOCK,
+            "blocks_per_dispatch": BLOCKS_PER_DISPATCH,
             "compile_seconds_excluded": round(compile_seconds, 3),
             "gramian_dtype": str(np.dtype("int32")),
             "device": str(device),

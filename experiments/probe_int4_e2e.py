@@ -13,6 +13,7 @@ import sys
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 import bench
 import spark_examples_tpu.ops.gramian as gramian
@@ -23,9 +24,7 @@ def run(config, dtype_name):
 
     def patched(exact_int, mesh=None):
         op, acc = orig(exact_int, mesh)
-        if dtype_name == "int4" and op == jnp.int8.dtype or dtype_name == "int4" and str(op) == "int8":
-            return jnp.int4, acc
-        return op, acc
+        return (jnp.int4, acc) if op == np.int8 else (op, acc)
 
     gramian._operand_dtypes = patched if dtype_name == "int4" else orig
     try:
