@@ -147,7 +147,13 @@ def _dense_update(G, X_packed, operand_dtype, num_samples):
     remote-attached backends (measured on the v5e tunnel); one extra N×N
     buffer is cheap.
     """
-    Xc = _unpack_bits(X_packed, num_samples).astype(operand_dtype)
+    # Materialize the unpacked operand once: fused into the dot, the
+    # unpack+cast recomputes per output tile (same effect as the generation
+    # chain in ops/devicegen.py, scaled to the unpack's ~2 ops — measured
+    # ~5% on v5e).
+    Xc = jax.lax.optimization_barrier(
+        _unpack_bits(X_packed, num_samples).astype(operand_dtype)
+    )
     return G + jnp.einsum(
         "dbn,dbm->dnm", Xc, Xc, preferred_element_type=G.dtype
     )
