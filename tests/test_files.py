@@ -611,3 +611,39 @@ def test_jsonl_numeric_af_filters_without_crashing(tmp_path, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert len([l for l in out.splitlines() if l.startswith("S")]) == 3
+
+
+def test_reads_coverage_and_depth_cli_on_sam(tmp_path, capsys):
+    """Examples 2 (mean coverage) and 3 (per-base depth) run end to end on a
+    SAM file input — completing the file-backed CLI matrix the pileup and
+    tumor/normal tests already cover."""
+    from spark_examples_tpu.cli import main
+
+    sam = "@HD\tVN:1.6\n@SQ\tSN:21\tLN:48129895\n" + "".join(
+        f"r{i:03d}\t0\t21\t{1000 + 5 * i}\t60\t40M\t*\t0\t0\t{'ACGT' * 10}\t{'F' * 40}\n"
+        for i in range(20)
+    )
+    path = _write(tmp_path, "chr21.sam", sam)
+
+    rc = main(["search-reads-example-2", "--source", "file", "--input-files", path])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # 20 reads x 40 bases over the chr21 length (Examples.HUMAN_CHROMOSOMES).
+    assert "1.6621" in out.replace(",", "")  # 800 / 48129895 ~ 1.662e-05
+
+    out_path = str(tmp_path / "depth_out")
+    rc = main(
+        ["search-reads-example-3", "--source", "file", "--input-files", path,
+         "--output-path", out_path]
+    )
+    assert rc == 0
+    capsys.readouterr()
+    import glob
+
+    parts = glob.glob(out_path + "/coverage_21/part-*")
+    assert parts, out_path
+    combined = "".join(open(p).read() for p in parts)
+    # POS 1000 (1-based) -> 999 half-open 0-based; 40bp reads at 5bp stagger
+    # rise to a depth-8 plateau.
+    assert "(999,1)" in combined
+    assert ",8)" in combined
