@@ -647,3 +647,113 @@ def test_reads_coverage_and_depth_cli_on_sam(tmp_path, capsys):
     # rise to a depth-8 plateau.
     assert "(999,1)" in combined
     assert ",8)" in combined
+
+
+# SAM parser roundtrip property: generated SAM lines → _parse_sam wire dicts
+# → ReadBuilder → the original fields. There is no second SAM implementation
+# to diff against (unlike the VCF parsers), so the property pins the wire
+# contract: every SAM column must survive into the Read model byte-exactly.
+
+_cigar_ops = st.sampled_from(list("MIDNSHP=X"))
+_cigar_st = st.lists(
+    st.tuples(st.integers(min_value=1, max_value=250), _cigar_ops),
+    min_size=1,
+    max_size=4,
+).map(lambda units: "".join(f"{n}{op}" for n, op in units))
+
+
+@st.composite
+def _sam_records(draw):
+    length = draw(st.integers(min_value=1, max_value=60))
+    seq = draw(
+        st.one_of(
+            st.just("*"),
+            st.text(alphabet="ACGTN", min_size=length, max_size=length),
+        )
+    )
+    qual = (
+        "*"
+        if seq == "*" or draw(st.booleans())
+        else "".join(
+            chr(33 + q)
+            for q in draw(
+                st.lists(
+                    st.integers(min_value=0, max_value=60),
+                    min_size=len(seq),
+                    max_size=len(seq),
+                )
+            )
+        )
+    )
+    rnext = draw(st.sampled_from(["*", "=", "11"]))
+    pnext = 0 if rnext == "*" else draw(st.integers(min_value=1, max_value=10**6))
+    return {
+        "qname": draw(st.sampled_from(["r1", "frag.2", "x:y"])),
+        "flag": draw(st.integers(min_value=0, max_value=4095)),
+        "rname": draw(st.sampled_from(["17", "chr4"])),
+        "pos": draw(st.integers(min_value=1, max_value=10**7)),
+        "mapq": draw(st.integers(min_value=0, max_value=255)),
+        "cigar": draw(_cigar_st),
+        "rnext": rnext,
+        "pnext": pnext,
+        "tlen": draw(st.integers(min_value=-500, max_value=500)),
+        "seq": seq,
+        "qual": qual,
+    }
+
+
+@settings(max_examples=60, deadline=None)
+@given(records=st.lists(_sam_records(), min_size=0, max_size=8))
+def test_fuzz_sam_roundtrips_through_read_builder(records):
+    import tempfile
+
+    from spark_examples_tpu.models.read import ReadBuilder
+    from spark_examples_tpu.sources.files import _parse_sam
+
+    text = "@HD\tVN:1.6\n" + "".join(
+        "\t".join(
+            str(r[k])
+            for k in (
+                "qname", "flag", "rname", "pos", "mapq", "cigar",
+                "rnext", "pnext", "tlen", "seq", "qual",
+            )
+        )
+        + "\n"
+        for r in records
+    )
+    fd, path = tempfile.mkstemp(suffix=".sam")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+        _, tables = _parse_sam(path, "fuzz")
+    finally:
+        os.unlink(path)
+
+    parsed = {}
+    for contig, (starts, recs) in tables.items():
+        for wire in recs:
+            key, read = ReadBuilder.build(wire)
+            parsed[wire["id"]] = (key, read)
+    assert len(parsed) == len(records)
+
+    for i, r in enumerate(records):
+        key, read = parsed[f"fuzz:{i + 1}"]  # line 0 is the header
+        assert key.sequence == r["rname"]
+        assert read.position == r["pos"] - 1  # 1-based POS → 0-based
+        assert read.cigar == r["cigar"]  # letters survive the op round trip
+        assert read.mapping_quality == r["mapq"]
+        assert read.fragment_name == r["qname"]
+        assert read.fragment_length == r["tlen"]
+        assert read.aligned_sequence == ("" if r["seq"] == "*" else r["seq"])
+        if r["qual"] == "*":
+            assert read.aligned_quality == ()
+        else:
+            assert read.aligned_quality == tuple(
+                ord(c) - 33 for c in r["qual"]
+            )
+        if r["rnext"] == "*":
+            assert read.mate_position is None
+        else:
+            assert read.mate_position == r["pnext"] - 1
+            expected = r["rname"] if r["rnext"] == "=" else r["rnext"]
+            assert read.mate_reference_name == expected
