@@ -28,6 +28,7 @@
 #include <cstring>
 #include <cmath>
 #include <cstdlib>
+#include <locale.h>
 
 namespace {
 
@@ -59,6 +60,17 @@ inline bool field_span(const char* line, const char* line_end, int index,
     *fb = p;
     *fe = tab ? tab : line_end;
     return true;
+}
+
+// strtod honors LC_NUMERIC, so a host process that called setlocale() (e.g.
+// a GUI embedding) would flip the decimal point and make the
+// full-consumption check reject "0.5" — diverging from Python float() and
+// silently dropping every AF-filtered record. Parse against a cached "C"
+// locale instead; the grammar is then process-state-independent.
+inline double strtod_c(const char* s, char** endp) {
+    static locale_t c_loc = newlocale(LC_ALL_MASK, "C", nullptr);
+    if (c_loc) return strtod_l(s, endp, c_loc);
+    return strtod(s, endp);
 }
 
 inline int64_t parse_int(const char* b, const char* e, bool* ok) {
@@ -173,7 +185,7 @@ int64_t vcf_parse(const char* buf, int64_t len, int64_t n_samples,
                     memcpy(tmp, vb, n);
                     tmp[n] = '\0';
                     char* endp = nullptr;
-                    double v = strtod(tmp, &endp);
+                    double v = strtod_c(tmp, &endp);
                     if (endp == tmp + n) af[row] = v;
                 }
                 break;

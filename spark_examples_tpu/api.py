@@ -105,10 +105,20 @@ def center_matrix(similarity):
     """Gower double-centering on device, the counterpart of
     ``variants_pca.py:center_matrix`` (``:84-121``) — the row-sums collect,
     broadcast, and per-row centering collapse into one fused kernel
-    (``ops/centering.py``)."""
+    (``ops/centering.py``).
+
+    The input dtype is preserved into the kernel and the arithmetic runs in
+    float64, exactly like the driver path (``pipeline/pca_driver.py:
+    compute_pca`` dense branch): integer similarity counts center through
+    the ``ops/centering.py:_dtypes`` policy, so counts past f32's 2^24
+    exact range stay exact instead of being truncated by an up-front f32
+    cast. The output is f32 — the eigensolve's dtype — unless the caller
+    passed f64 in."""
+    import jax
     import jax.numpy as jnp
 
-    return gower_center(jnp.asarray(similarity, dtype=jnp.float32))
+    with jax.enable_x64(True):
+        return gower_center(jnp.asarray(similarity))
 
 
 def perform_pca(centered, num_pc: int = 2) -> np.ndarray:

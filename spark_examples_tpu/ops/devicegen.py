@@ -806,6 +806,8 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
         accumulators this is the one cross-slice reduce (the Spark
         ``reduceByKey`` shuffle become a single ``psum`` over ICI,
         ``VariantsPca.scala:230``)."""
+        from spark_examples_tpu.ops.gramian import data_axis_sum
+
         if self.data_parallel > 1:
             if not self.G.is_fully_addressable:
                 # Multi-controller: replicate so every process can fetch.
@@ -815,11 +817,11 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
                 # fetches read the local replica without a second gather.
                 from jax.sharding import NamedSharding, PartitionSpec
 
-                return jax.jit(
-                    lambda G: jnp.sum(G, axis=0),
+                return data_axis_sum(
+                    self.G,
                     out_shardings=NamedSharding(self.mesh, PartitionSpec()),
-                )(self.G)
-            return jnp.sum(self.G, axis=0)
+                )
+            return data_axis_sum(self.G)
         return self.G
 
     def finalize(self) -> np.ndarray:
@@ -1022,15 +1024,21 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
 
     def finalize_sharded(self) -> jax.Array:
         """(padded, padded) Gramian, row-sharded over ``samples`` — feeds
-        the sharded centering/eigensolve without ever gathering N×N."""
+        the sharded centering/eigensolve without ever gathering N×N.
+
+        The cross-data-slice sum promotes integer accumulators to int64
+        (``ops/gramian.py:data_axis_sum`` — the per-slice int32 accumulators
+        are each bounded by their own kept sites, but the total across
+        slices is not)."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from spark_examples_tpu.ops.gramian import data_axis_sum
         from spark_examples_tpu.parallel.mesh import SAMPLES_AXIS
 
-        return jax.jit(
-            lambda G: jnp.sum(G, axis=0),
+        return data_axis_sum(
+            self.G,
             out_shardings=NamedSharding(self.mesh, P(SAMPLES_AXIS, None)),
-        )(self.G)
+        )
 
     def _reduce_row_counts(self, rows: np.ndarray) -> np.ndarray:
         """Single set: per-data-slice row counts (already samples-replicated

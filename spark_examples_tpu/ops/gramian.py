@@ -159,6 +159,33 @@ def _dense_update(G, X_packed, operand_dtype, num_samples):
     )
 
 
+def data_axis_sum(G: jax.Array, out_shardings=None) -> jax.Array:
+    """Cross-data-slice reduce of a ``(D, ...)`` stacked accumulator.
+
+    With more than one slice, integer accumulators are promoted to int64 in
+    the reduce: each slice's int32 accumulator is bounded by its own
+    accumulated sites, but the TOTAL across D slices is not — it can pass
+    2^31 while every slice stays under it. Traced under x64 so the requested
+    dtype is honored regardless of the caller's config (outside x64 JAX
+    silently canonicalizes int64 back to int32). Single-slice reduces keep
+    the accumulator dtype — no promotion is needed where no cross-slice sum
+    happens. Shared by every accumulator's finalize (here and
+    ``ops/devicegen.py``) so the overflow policy lives in one place.
+    """
+    out_dtype = (
+        jnp.int64
+        if G.shape[0] > 1 and jnp.issubdtype(G.dtype, jnp.integer)
+        else G.dtype
+    )
+    with jax.enable_x64(True):
+        if out_shardings is not None:
+            return jax.jit(
+                lambda g: jnp.sum(g, axis=0, dtype=out_dtype),
+                out_shardings=out_shardings,
+            )(G)
+        return jnp.sum(G, axis=0, dtype=out_dtype)
+
+
 def _unpack_bits(packed: jax.Array, num_columns: int) -> jax.Array:
     """(..., ceil(N/8)) uint8 → (..., N) {0,1} uint8 (np.packbits big-endian
     bit order)."""
@@ -282,7 +309,7 @@ class GramianAccumulator:
         on remote-attached backends, poisons subsequent dispatch throughput
         (any device_get degrades later host→device traffic ~50×, measured)."""
         self._flush()
-        return jnp.sum(self.G, axis=0)
+        return data_axis_sum(self.G)
 
     def finalize(self) -> np.ndarray:
         """Host copy of :meth:`finalize_device` (tests / host backend)."""
@@ -445,7 +472,7 @@ class ShardedGramianAccumulator:
 
     def finalize(self) -> np.ndarray:
         self._flush()
-        total = jnp.sum(self.G, axis=0)
+        total = data_axis_sum(self.G)
         full = np.asarray(jax.device_get(total)).astype(np.float64)
         return full[: self.num_samples, : self.num_samples]
 
@@ -454,16 +481,16 @@ class ShardedGramianAccumulator:
         columns/rows (all zero). See :meth:`finalize_sharded` for the
         samples-sharded variant."""
         self._flush()
-        return jnp.sum(self.G, axis=0)
+        return data_axis_sum(self.G)
 
     def finalize_sharded(self) -> jax.Array:
         """Device-resident finalize: (padded N, padded N) row-sharded over
         ``samples`` — for cohorts where the host copy is undesirable."""
         self._flush()
-        return jax.jit(
-            lambda G: jnp.sum(G, axis=0),
+        return data_axis_sum(
+            self.G,
             out_shardings=NamedSharding(self.mesh, P(SAMPLES_AXIS, None)),
-        )(self.G)
+        )
 
 
 def accumulate_index_rows(
@@ -513,5 +540,6 @@ def gramian_reference(rows: np.ndarray) -> np.ndarray:
 __all__ = [
     "GramianAccumulator",
     "ShardedGramianAccumulator",
+    "data_axis_sum",
     "gramian_reference",
 ]

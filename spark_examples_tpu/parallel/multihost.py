@@ -107,9 +107,15 @@ def child_check(
     contig = Contig(name, int(start), int(end))
     k0, k1 = source.site_grid_range(contig)
     accumulator.add_grid(k0, k1)
-    gramian_device = accumulator.finalize_device()
-    spans_processes = not bool(gramian_device.is_fully_addressable)
-    gramian = accumulator.finalize()
+    from spark_examples_tpu.parallel.mesh import host_value
+
+    # One finalize reduction, probed for spans then fetched from the same
+    # array (``accumulator.finalize()`` would re-run the cross-slice sum);
+    # x64 so host_value's replicating jit keeps the promoted int64 result.
+    with jax.enable_x64(True):
+        gramian_device = accumulator.finalize_device()
+        spans_processes = not bool(gramian_device.is_fully_addressable)
+        gramian = host_value(gramian_device).astype(np.float64)
     per_set_rows, kept_sites = accumulator.ingest_counters()
 
     oracle = np.zeros((_NUM_SAMPLES, _NUM_SAMPLES), dtype=np.int64)
@@ -145,11 +151,13 @@ def child_check(
     ring.add_grid(k0, k1)
     # One finalize reduction, probed for spans and fetched from the same
     # array (``ring.finalize()`` would rebuild + re-run the sharded sum).
-    from spark_examples_tpu.parallel.mesh import host_value
-
-    ring_sharded = ring.finalize_sharded()
-    ring_spans = not bool(ring_sharded.is_fully_addressable)
+    # ``finalize_sharded`` promotes the int32 shard accumulators' cross-slice
+    # sum to int64 internally; the x64 block here is for ``host_value``,
+    # whose replicating jit would otherwise canonicalize the int64 result
+    # back to int32 on entry (matching ``finalize``'s own fetch).
     with jax.enable_x64(True):
+        ring_sharded = ring.finalize_sharded()
+        ring_spans = not bool(ring_sharded.is_fully_addressable)
         ring_full = host_value(ring_sharded)
     ring_gramian = ring_full[: source.num_samples, : source.num_samples]
 

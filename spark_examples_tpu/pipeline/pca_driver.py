@@ -538,10 +538,12 @@ class VariantsPcaDriver:
                 centered, sharded_mesh, self.conf.num_pc, n_true=n
             )
             # any() rather than sum() > 0: entries are non-negative counts,
-            # and int32 row sums would overflow at whole-genome scale.
-            nonzero = int(
-                jax.device_get(jnp.any(similarity != 0, axis=1).sum())
-            )
+            # and int32 row sums would overflow at whole-genome scale. Under
+            # x64 because the finalize reduce hands back an int64 Gramian.
+            with jax.enable_x64(True):
+                nonzero = int(
+                    jax.device_get(jnp.any(similarity != 0, axis=1).sum())
+                )
             print(f"Non zero rows in matrix: {nonzero} / {n}.")
             components = np.asarray(
                 jax.device_get(device_components), dtype=np.float64
@@ -564,8 +566,10 @@ class VariantsPcaDriver:
             )
             # All dispatches issued; fetching results is now safe. any()
             # rather than sum() > 0: int32 row sums would overflow at
-            # whole-genome scale.
-            nonzero = int(jax.device_get(jnp.any(S != 0, axis=1).sum()))
+            # whole-genome scale. Under x64 because S may be the int64
+            # result of the finalize reduce.
+            with jax.enable_x64(True):
+                nonzero = int(jax.device_get(jnp.any(S != 0, axis=1).sum()))
             print(f"Non zero rows in matrix: {nonzero} / {n}.")
             components = np.asarray(
                 jax.device_get(device_components), dtype=np.float64

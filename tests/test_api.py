@@ -56,6 +56,46 @@ def test_api_stages_match_driver():
     np.testing.assert_allclose(components, driver_components * signs, atol=5e-3)
 
 
+def test_center_matrix_exact_past_f32_range():
+    """center_matrix keeps integer counts past 2^24 exact (the driver's f64
+    centering policy), instead of truncating them with an up-front f32 cast."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_examples_tpu.ops.centering import gower_center
+
+    rng = np.random.default_rng(17)
+    base = rng.integers(0, 50, size=(6, 6))
+    # Symmetric, all entries odd and > 2^24: none are f32-representable, so
+    # a premature f32 cast visibly perturbs the centered result.
+    S = ((base + base.T) * 2 + (1 << 25) + 1).astype(np.int64)
+
+    centered = np.asarray(api.center_matrix(S))
+    assert centered.dtype == np.float32
+
+    # Bit-match the driver's dense centering path
+    # (pipeline/pca_driver.py:compute_pca): f64 arithmetic under x64, f32 out.
+    with jax.enable_x64(True):
+        driver_centered = gower_center(jnp.asarray(S))
+    driver_centered = np.asarray(driver_centered.astype(jnp.float32))
+    np.testing.assert_array_equal(centered, driver_centered)
+
+    # And match the literal f64 host oracle (rounded to f32 at the end).
+    Sf = S.astype(np.float64)
+    oracle = (
+        Sf
+        - Sf.mean(axis=1, keepdims=True)
+        - Sf.mean(axis=0, keepdims=True)
+        + Sf.mean()
+    ).astype(np.float32)
+    np.testing.assert_array_equal(centered, oracle)
+
+    # The pre-fix behavior (force-cast to f32 before centering) is measurably
+    # different on this input — the test would catch a regression.
+    truncated = np.asarray(gower_center(jnp.asarray(S, dtype=jnp.float32)))
+    assert not np.array_equal(centered, truncated)
+
+
 def test_api_pca_entrypoint():
     lines = api.pca(
         [

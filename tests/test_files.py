@@ -325,6 +325,42 @@ def test_native_parser_rejects_malformed_vcf(tmp_path):
         native_mod.parse_vcf_arrays(bad.encode())
 
 
+def test_native_parser_locale_independent():
+    """AF parsing must not shift under a host process's setlocale(): the
+    native parser uses a cached "C" locale (vcfparse.cpp:strtod_c), so a
+    comma-decimal LC_NUMERIC must not make it reject '0.5' and drop every
+    AF-filtered record. Skips when no comma-decimal locale is installed
+    (the fix is then unobservable on this system)."""
+    import locale
+
+    from spark_examples_tpu.utils import native as native_mod
+
+    if native_mod.vcf_library() is None:
+        pytest.skip("no native build")
+    comma_locale = None
+    for candidate in ("de_DE.UTF-8", "fr_FR.UTF-8", "de_DE", "fr_FR"):
+        try:
+            locale.setlocale(locale.LC_NUMERIC, candidate)
+        except locale.Error:
+            continue
+        if locale.localeconv()["decimal_point"] == ",":
+            comma_locale = candidate
+            break
+        locale.setlocale(locale.LC_NUMERIC, "C")
+    if comma_locale is None:
+        locale.setlocale(locale.LC_NUMERIC, "C")
+        pytest.skip("no comma-decimal locale installed")
+    try:
+        vcf = (
+            "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS1\n"
+            "17\t101\t.\tA\tG\t1\t.\tAF=0.5\tGT\t0|1\n"
+        )
+        arrays = native_mod.parse_vcf_arrays(vcf.encode())
+        np.testing.assert_array_equal(arrays[3], [0.5])
+    finally:
+        locale.setlocale(locale.LC_NUMERIC, "C")
+
+
 def test_missing_input_files_flag_raises():
     with pytest.raises(ValueError, match="input-files"):
         pca_driver.run(["--source", "file"])
