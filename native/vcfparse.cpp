@@ -118,6 +118,62 @@ int vcf_scan(const char* buf, int64_t len, int64_t* n_lines,
     return *n_samples >= 0 ? 0 : -1;
 }
 
+// Count data lines (non-empty, not starting with '#') in a buffer — the
+// allocation bound for a chunked parse, where the #CHROM header (and so
+// vcf_scan) lives in an earlier chunk.
+int64_t vcf_count_data_lines(const char* buf, int64_t len) {
+    const char* p = buf;
+    const char* end = buf + len;
+    int64_t n = 0;
+    while (p < end) {
+        const char* line_end = static_cast<const char*>(
+            memchr(p, '\n', static_cast<size_t>(end - p)));
+        if (!line_end) line_end = end;
+        const char* stripped_end = line_end;
+        if (stripped_end > p && *(stripped_end - 1) == '\r') --stripped_end;
+        if (stripped_end > p && p[0] != '#') ++n;
+        p = next_line(p, end);
+    }
+    return n;
+}
+
+// Site-only scan: CHROM + [start, end) per data line, no INFO/GT walk — the
+// cheap streaming pass behind lazy contig discovery (contig bounds for
+// --all-references without paying the per-sample genotype parse). Arrays
+// are caller-allocated with vcf_count_data_lines rows. Returns rows parsed,
+// or the negative 1-based ordinal of the first malformed data line.
+int64_t vcf_scan_sites(const char* buf, int64_t len, int64_t* positions,
+                       int64_t* ends, int64_t* contig_off,
+                       int64_t* contig_len) {
+    const char* p = buf;
+    const char* end = buf + len;
+    int64_t row = 0;
+    int64_t ordinal = 0;
+    while (p < end) {
+        const char* line_end = static_cast<const char*>(
+            memchr(p, '\n', static_cast<size_t>(end - p)));
+        if (!line_end) line_end = end;
+        const char* stripped_end = line_end;
+        if (stripped_end > p && *(stripped_end - 1) == '\r') --stripped_end;
+        if (stripped_end == p || p[0] == '#') { p = next_line(p, end); continue; }
+        ++ordinal;
+        const char *fb, *fe;
+        if (!field_span(p, stripped_end, 0, &fb, &fe)) return -ordinal;
+        contig_off[row] = fb - buf;
+        contig_len[row] = fe - fb;
+        if (!field_span(p, stripped_end, 1, &fb, &fe)) return -ordinal;
+        bool ok = false;
+        int64_t pos1 = parse_int(fb, fe, &ok);
+        if (!ok || pos1 < 1) return -ordinal;
+        positions[row] = pos1 - 1;
+        if (!field_span(p, stripped_end, 3, &fb, &fe)) return -ordinal;
+        ends[row] = positions[row] + (fe - fb);
+        ++row;
+        p = next_line(p, end);
+    }
+    return row;
+}
+
 // Parse all data lines. Arrays are caller-allocated with n_lines rows (from
 // vcf_scan): positions/ends int64, af double (NaN = absent),
 // has_variation int8 (n_lines * n_samples, row-major), contig_off/contig_len

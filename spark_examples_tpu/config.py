@@ -102,6 +102,19 @@ def _build_base_parser(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
         ),
     )
     parser.add_argument(
+        "--stream-chunk-bytes",
+        type=int,
+        default=None,
+        help=(
+            "Bounded-memory streaming ingest for --source file VCF inputs: "
+            "parse in chunks of this many decompressed bytes instead of "
+            "loading the file (one pass, coordinate-sorted VCFs only). "
+            "Unset = automatic (streams when the file exceeds the size "
+            "threshold); 0 = never stream; N > 0 = always stream with "
+            "N-byte chunks."
+        ),
+    )
+    parser.add_argument(
         "--num-samples",
         type=_num_samples_value,
         default="2504",
@@ -143,6 +156,7 @@ class GenomicsConf:
     )
     source: str = "synthetic"
     input_files: Optional[List[str]] = None
+    stream_chunk_bytes: Optional[int] = None
     num_samples: int = 2504
     num_samples_per_set: Optional[List[int]] = None
     seed: int = 42

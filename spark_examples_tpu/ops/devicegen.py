@@ -480,6 +480,17 @@ class _GridDispatchAccumulator:
             self._update, self.sites_per_dispatch, grid_offsets, n_valids
         )
 
+    def _maybe_poke(self) -> None:
+        """Poke once, at the moment a SECOND dispatch is about to be issued:
+        the poke exists to overlap the host dispatch loop with device
+        execution, so the first follow-up dispatch — in this grid walk or a
+        later one — is the earliest point where the overlap can pay. A
+        single-dispatch run never pokes (it would spend a pure round-trip on
+        an overlap it cannot use; the terminal fetch executes the lone
+        dispatch either way)."""
+        if self.dispatches == 1 and not self._poked:
+            self.poke()
+
     def _dispatch_ranges(self, update, cap, grid_offsets, n_valids) -> None:
         D = self.data_parallel
         grid_offsets = np.asarray(grid_offsets, dtype=np.int64)
@@ -492,6 +503,7 @@ class _GridDispatchAccumulator:
             # Negative grid indices would wrap to garbage uint64 positions on
             # device and silently corrupt the Gramian.
             raise ValueError("grid_offsets must be non-negative")
+        self._maybe_poke()
         with jax.enable_x64(True):
             self.G, self.variant_rows, self.kept_sites = update(
                 self.G,
@@ -528,9 +540,7 @@ class _GridDispatchAccumulator:
             self._update_tail = self._compile_update(key)
         return self._update_tail, self.block_size * self._tail_blocks
 
-    def _round_robin(
-        self, update, cap, starts, last_index: int, more_after: bool = False
-    ) -> None:
+    def _round_robin(self, update, cap, starts, last_index: int) -> None:
         D = self.data_parallel
         for i in range(0, len(starts), D):
             offsets = np.zeros(D, dtype=np.int64)
@@ -539,17 +549,6 @@ class _GridDispatchAccumulator:
                 offsets[d] = off
                 valids[d] = min(cap, last_index - off)
             self._dispatch_ranges(update, cap, offsets, valids)
-            # Poke once, at the first dispatch that has more work following
-            # it — in THIS grid walk or a later one (the flag spans
-            # add_grid calls, so a single-dispatch first contig does not
-            # suppress the poke for the rest of a multi-contig run). The
-            # poke exists to overlap the host dispatch loop with device
-            # execution; a run whose every region fits one group (the
-            # reference's default BRCA1 config) never pokes — it would pay
-            # a pure round-trip for an overlap it cannot use, and the
-            # terminal fetch executes the queue either way.
-            if not self._poked and (i + D < len(starts) or more_after):
-                self.poke()
 
     def add_grid(self, first_index: int, last_index: int) -> None:
         """Dispatch all groups for a contiguous grid index range
@@ -566,7 +565,6 @@ class _GridDispatchAccumulator:
             step,
             [first_index + i * step for i in range(n_main)],
             last_index,
-            more_after=rem_start < last_index,
         )
         if rem_start >= last_index:
             return
@@ -816,6 +814,7 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
         self._dispatch_single(self._update, grid_offset, n_valid)
 
     def _dispatch_single(self, update, grid_offset: int, n_valid: int) -> None:
+        self._maybe_poke()
         with jax.enable_x64(True):
             self.G, self.variant_rows, self.kept_sites = update(
                 self.G,
@@ -839,13 +838,6 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
         while last_index - off >= main:
             self.add_range(off, main)
             off += main
-            # Poke once, at the first dispatch with more work following
-            # (``_round_robin`` has the rationale): a single-group region
-            # must not pay a pure round-trip for an overlap it cannot use,
-            # and a single-group FIRST region must not suppress the poke
-            # for the rest of a multi-contig run.
-            if not self._poked and off < last_index:
-                self.poke()
         if off < last_index:
             tail_update, tail = self._tail_spec()
             while off < last_index:
@@ -853,8 +845,6 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
                     tail_update, off, min(tail, last_index - off)
                 )
                 off += tail
-                if not self._poked and off < last_index:
-                    self.poke()
 
     def finalize_device(self) -> jax.Array:
         """The accumulated Gramian, still on device; for data-parallel

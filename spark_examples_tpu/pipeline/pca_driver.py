@@ -143,7 +143,10 @@ def make_source(conf: PcaConf) -> GenomicsSource:
             ),
         )
     if conf.source == "file":
-        return FileGenomicsSource(conf.input_files or [])
+        return FileGenomicsSource(
+            conf.input_files or [],
+            stream_chunk_bytes=getattr(conf, "stream_chunk_bytes", None),
+        )
     from spark_examples_tpu.sources.base import get_access_token
     from spark_examples_tpu.sources.rest import RestGenomicsSource
 
@@ -711,6 +714,19 @@ def run(argv: Sequence[str]) -> List[str]:
         and not conf.input_path
         and conf.pca_backend == "tpu"
     )
+    source = make_source(conf) if conf.source != "rest" else None
+    if (
+        not use_packed
+        and conf.ingest == "auto"
+        and file_packed
+        and len(conf.variant_set_id) == 1
+        and isinstance(source, FileGenomicsSource)
+        and source.wants_streaming(conf.variant_set_id[0])
+    ):
+        # Auto-ingest for a large (or explicitly streamed) single-set VCF:
+        # the packed path with the bounded-memory streaming pass — the wire
+        # path would materialize the whole file as Python records.
+        use_packed = True
     if use_device and not (synthetic_tpu and device_ok):
         raise ValueError(
             "--ingest device requires --source synthetic, --pca-backend tpu, "
@@ -741,7 +757,7 @@ def run(argv: Sequence[str]) -> List[str]:
                 f"--ingest packed needs a .vcf[.gz] input; got {selected!r} "
                 "(use --ingest wire for JSONL/checkpoint inputs)"
             )
-    driver = VariantsPcaDriver(conf)
+    driver = VariantsPcaDriver(conf, source)
     from spark_examples_tpu.utils.tracing import StageTimes, device_trace
 
     times = StageTimes()
@@ -792,6 +808,41 @@ def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
         contigs = conf.get_contigs(source, conf.variant_set_id)
         partitioner = VariantsPartitioner(contigs, conf.bases_per_partition)
         partitions = partitioner.get_partitions(conf.variant_set_id[0])
+
+        if not synthetic and source.wants_streaming(conf.variant_set_id[0]):
+            # Bounded-memory ingest: ONE pass over the file serves every
+            # shard window in file order (G += XᵀX commutes), peak host
+            # memory O(chunk) instead of O(file) — the capability the
+            # reference's paging had by construction
+            # (``rdd/VariantsRDD.scala:198-225``). Stats are accumulated
+            # in-pass with the same per-shard page/variant accounting the
+            # random-access path computes.
+            from spark_examples_tpu.sources.files import StreamCounters
+
+            counters = StreamCounters(len(partitions))
+            set_id = conf.variant_set_id[0]
+            shard_windows = [p.contig for p in partitions]
+
+            def streamed_rows():
+                for block in source.stream_genotype_blocks(
+                    set_id,
+                    shard_windows,
+                    block_size=conf.block_size,
+                    min_allele_frequency=conf.min_allele_frequency,
+                    counters=counters,
+                ):
+                    yield block["has_variation"]
+
+            similarity = driver.get_similarity_rows(streamed_rows())
+            # get_similarity_rows consumed the stream; the counters are
+            # complete. Partition/request accounting matches the per-shard
+            # path: every shard contributes its range and ≥1 page.
+            if driver.io_stats is not None:
+                for part in partitions:
+                    driver.io_stats.add_partition(part.range)
+                driver.io_stats.requests += counters.requests()
+                driver.io_stats.add_variants(counters.variants)
+            return similarity
 
         def shard_blocks(part):
             blocks = list(

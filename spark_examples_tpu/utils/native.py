@@ -117,6 +117,17 @@ def vcf_library() -> Optional[ctypes.CDLL]:
             np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
         ]
+        lib.vcf_count_data_lines.restype = ctypes.c_int64
+        lib.vcf_count_data_lines.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.vcf_scan_sites.restype = ctypes.c_int64
+        lib.vcf_scan_sites.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ]
         _lib = lib
     except Exception as e:  # no compiler / build failure: fall back
         _lib_error = str(e)
@@ -171,8 +182,79 @@ def parse_vcf_arrays(text: bytes) -> Optional[Tuple[np.ndarray, ...]]:
     return contigs, positions, ends, af, has_variation[:, :N]
 
 
+def _contig_strings(text: bytes, contig_off, contig_len, rows: int):
+    """Per-row contig names decoded run-wise: coordinate-sorted VCFs have
+    long same-contig runs, so one bytes-compare per row replaces a per-row
+    ``.decode()`` (the decode happens once per run)."""
+    contigs = np.empty(rows, dtype=object)
+    current_bytes: bytes = b""
+    current_str = ""
+    for i in range(rows):
+        raw = text[contig_off[i] : contig_off[i] + contig_len[i]]
+        if raw != current_bytes:
+            current_bytes = raw
+            current_str = raw.decode("utf-8")
+        contigs[i] = current_str
+    return contigs
+
+
+def parse_vcf_chunk(text: bytes, n_samples: int):
+    """Native parse of ONE streamed chunk (no #CHROM header needed: the
+    caller learned ``n_samples`` from the header chunk; the chunk must end
+    at a line boundary — the streaming reader carries partial lines).
+
+    Returns the same array tuple as :func:`parse_vcf_arrays`, or ``None``
+    when the native library is unavailable. Raises ``ValueError`` on a
+    malformed data line (1-based ordinal WITHIN the chunk).
+    """
+    lib = vcf_library()
+    if lib is None:
+        return None
+    L = int(lib.vcf_count_data_lines(text, len(text)))
+    positions = np.empty(L, dtype=np.int64)
+    ends = np.empty(L, dtype=np.int64)
+    af = np.empty(L, dtype=np.float64)
+    has_variation = np.zeros((L, max(n_samples, 1)), dtype=np.int8)
+    contig_off = np.empty(L, dtype=np.int64)
+    contig_len = np.empty(L, dtype=np.int64)
+    parsed = lib.vcf_parse(
+        text, len(text), n_samples, positions, ends, af, has_variation,
+        contig_off, contig_len,
+    )
+    if parsed < 0:
+        raise ValueError(f"malformed VCF data line #{-parsed}")
+    if parsed != L:
+        raise ValueError(f"parsed {parsed} of {L} VCF data lines")
+    contigs = _contig_strings(text, contig_off, contig_len, L)
+    return contigs, positions, ends, af, has_variation[:, :n_samples]
+
+
+def scan_vcf_sites_chunk(text: bytes):
+    """Native site-only scan of one streamed chunk: ``(contigs, positions,
+    ends)`` without the per-sample genotype walk — the cheap pass behind
+    lazy contig discovery. ``None`` when the native library is unavailable.
+    """
+    lib = vcf_library()
+    if lib is None:
+        return None
+    L = int(lib.vcf_count_data_lines(text, len(text)))
+    positions = np.empty(L, dtype=np.int64)
+    ends = np.empty(L, dtype=np.int64)
+    contig_off = np.empty(L, dtype=np.int64)
+    contig_len = np.empty(L, dtype=np.int64)
+    parsed = lib.vcf_scan_sites(
+        text, len(text), positions, ends, contig_off, contig_len
+    )
+    if parsed < 0:
+        raise ValueError(f"malformed VCF data line #{-parsed}")
+    contigs = _contig_strings(text, contig_off, contig_len, L)
+    return contigs, positions, ends
+
+
 __all__ = [
     "vcf_library",
     "native_unavailable_reason",
     "parse_vcf_arrays",
+    "parse_vcf_chunk",
+    "scan_vcf_sites_chunk",
 ]

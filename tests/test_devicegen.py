@@ -276,6 +276,13 @@ def test_poke_gating_spans_grid_walks():
     assert not acc._poked
     acc.add_grid(10 * group, 13 * group)
     assert acc._poked
+    # Many contigs each fitting ONE group (decoy-heavy --all-references):
+    # the second dispatch — in a different add_grid — still pokes.
+    acc = make()
+    acc.add_grid(0, group)
+    assert not acc._poked
+    acc.add_grid(10 * group, 11 * group)
+    assert acc.dispatches == 2 and acc._poked
 
 
 def test_device_multiset_concatenates_per_set_genotypes():
