@@ -267,6 +267,7 @@ class PcaConf(GenomicsConf):
     similarity_strategy: str = "auto"
     num_workers: int = 8
     profile_dir: Optional[str] = None
+    save_variants: Optional[str] = None
 
     EXCLUDE_XY = SexChromosomeFilter.EXCLUDE_XY
 
@@ -359,6 +360,18 @@ class PcaConf(GenomicsConf):
                 "Write a jax.profiler device trace (TensorBoard-loadable) "
                 "here and print per-stage wall-clock timings — the Spark-UI "
                 "stand-in (utils/tracing.py)."
+            ),
+        )
+        parser.add_argument(
+            "--save-variants",
+            default=None,
+            metavar="PATH",
+            help=(
+                "Materialize the ingested variants as a checkpoint directory "
+                "at PATH while the analysis streams (one part file per "
+                "shard), for later --input-path resume without re-ingesting. "
+                "Wire ingest, single variant set (the writer the reference's "
+                "objectFile resume never had, VariantsPca.scala:112-113)."
             ),
         )
         ns = parser.parse_args(list(argv))

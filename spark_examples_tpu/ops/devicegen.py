@@ -261,28 +261,53 @@ def generate_has_variation(
 def generate_column_block(
     positions: jax.Array,  # (B,) int64
     thresholds: jax.Array,  # (B, P) uint64 Q32 thresholds, 0 = dropped
-    vs_key: jax.Array,  # scalar uint64 genotype stream key (one set)
-    pops_local: jax.Array,  # (N_local,) int32: this slice's sample pops
-    col_start: jax.Array,  # scalar int: first GLOBAL sample index
-    num_samples: int,
+    vs_key: jax.Array,  # (scalar | (S,)) uint64 genotype stream key(s)
+    pops_local: jax.Array,  # (N_local,) int32: this slice's column pops
+    col_start: jax.Array,  # scalar int: first GLOBAL column index
+    num_samples: int,  # total columns (Σ per-set sizes for multi-set)
+    set_sizes: Optional[Tuple[int, ...]] = None,
 ) -> jax.Array:
-    """(B, N_local) {0,1} has-variation for one SAMPLE-COLUMN slice: the
-    genotype draw is keyed by the global sample index, so a slice can
-    generate exactly its own columns of the cohort matrix (bitwise-equal to
-    the corresponding columns of :func:`generate_has_variation`); padded
+    """(B, N_local) {0,1} has-variation for one COLUMN slice: the genotype
+    draw is keyed by the set-local sample index, so a slice can generate
+    exactly its own columns of the cohort matrix (bitwise-equal to the
+    corresponding columns of :func:`generate_has_variation`); padded
     columns past ``num_samples`` come out all-zero. ``pops_local`` is traced
     (sliced by axis index inside shard_map), so this path keeps the
-    threshold gather."""
+    threshold gather.
+
+    Multi-set joint cohorts (``set_sizes`` + an (S,) ``vs_key`` array, the
+    reference's join/merge scenario ``VariantsPca.scala:155-188``): the
+    global column space is the concatenation of per-set cohorts, and a
+    slice's columns may span set boundaries — each set's draw plane is
+    computed for the whole slice and masked to its own columns (S× the
+    per-column u32 work; S is 2–3 in practice, and the alternative is the
+    orders-of-magnitude-slower host wire ingest). ``pops_local`` is then a
+    slice of the CONCATENATED per-set population vector."""
     n_local = pops_local.shape[0]
     cols = col_start + jnp.arange(n_local, dtype=jnp.int64)
-    samples = (cols.astype(jnp.uint64) * _c64(_P4))[None, :]
     pos_term = positions.astype(jnp.uint64) * _c64(_P2)
     t_full = jnp.take(thresholds, pops_local, axis=1).astype(jnp.uint32)
     t_full = jnp.where((cols < num_samples)[None, :], t_full, jnp.uint32(0))
-    h1 = mix64(vs_key ^ pos_term)  # (B,)
-    h2 = mix64(h1 ^ _c64(_S_GENOTYPE * _P3))[:, None]
-    d1, d2 = _allele_pair(h2, samples)
-    return (d1 < t_full) | (d2 < t_full)
+    if set_sizes is None:
+        samples = (cols.astype(jnp.uint64) * _c64(_P4))[None, :]
+        h1 = mix64(vs_key ^ pos_term)  # (B,)
+        h2 = mix64(h1 ^ _c64(_S_GENOTYPE * _P3))[:, None]
+        d1, d2 = _allele_pair(h2, samples)
+        return (d1 < t_full) | (d2 < t_full)
+    offsets = np.concatenate([[0], np.cumsum(set_sizes)])
+    hv = jnp.zeros((positions.shape[0], n_local), dtype=bool)
+    for s, size in enumerate(set_sizes):
+        lo, hi = int(offsets[s]), int(offsets[s + 1])
+        mask = (cols >= lo) & (cols < hi)
+        # Set-local sample index; clamped outside the mask so the uint64
+        # cast never sees a negative value.
+        local_idx = jnp.clip(cols - lo, 0, max(size - 1, 0))
+        samples = (local_idx.astype(jnp.uint64) * _c64(_P4))[None, :]
+        h1 = mix64(vs_key[s] ^ pos_term)
+        h2 = mix64(h1 ^ _c64(_S_GENOTYPE * _P3))[:, None]
+        d1, d2 = _allele_pair(h2, samples)
+        hv = hv | (mask[None, :] & ((d1 < t_full) | (d2 < t_full)))
+    return hv
 
 
 @functools.lru_cache(maxsize=32)
@@ -446,20 +471,6 @@ def _fused_update_mesh(
     )
 
 
-@functools.lru_cache(maxsize=16)
-def _pack_counters(mesh):
-    """Jitted counter-packing for mesh accumulators: flatten rows + kept
-    into one replicated vector so multi-controller fetches replicate once
-    and every process reads its local copy (memoized per mesh so repeated
-    runs reuse one compiled program)."""
-    from jax.sharding import NamedSharding, PartitionSpec
-
-    return jax.jit(
-        lambda r, k: jnp.concatenate([r.reshape(-1), k.reshape(-1)]),
-        out_shardings=NamedSharding(mesh, PartitionSpec()),
-    )
-
-
 class _GridDispatchAccumulator:
     """Shared dispatch machinery for the device-generation accumulators:
     validated (grid_offset, n_valid) group dispatch, data-axis round-robin,
@@ -615,27 +626,19 @@ class _GridDispatchAccumulator:
         stage also makes the stage's wall-clock honest on asynchronous
         backends (``utils/tracing.py``).
 
-        Both counters ride ONE transfer: each synchronous fetch on a
-        remote-attached backend pays a full tunnel round-trip, and the two
-        separate fetches here were a measurable share of small-region
-        wall-clock (VERDICT r4 weakness 1)."""
-        from spark_examples_tpu.parallel.mesh import host_value
+        Both counters ride ONE transfer (``parallel/mesh.py:
+        packed_host_fetch`` — each synchronous fetch on a remote-attached
+        backend pays a full tunnel round-trip, and the two separate fetches
+        here were a measurable share of small-region wall-clock, VERDICT r4
+        weakness 1)."""
+        from spark_examples_tpu.parallel.mesh import packed_host_fetch
 
         rows_shape = tuple(self.variant_rows.shape)
         rows_size = int(np.prod(rows_shape)) if rows_shape else 1
-        with jax.enable_x64(True):
-            if self._scalar_sharding is not None:
-                packed = _pack_counters(self.mesh)(
-                    self.variant_rows, self.kept_sites
-                )
-            else:
-                packed = jnp.concatenate(
-                    [
-                        self.variant_rows.reshape(-1),
-                        self.kept_sites.reshape(-1),
-                    ]
-                )
-            flat = np.asarray(host_value(packed))
+        flat = packed_host_fetch(
+            [self.variant_rows, self.kept_sites],
+            self.mesh if self._scalar_sharding is not None else None,
+        )
         rows = flat[:rows_size].reshape(rows_shape)
         kept = flat[rows_size:]
         return self._reduce_row_counts(rows), int(np.sum(kept))
@@ -878,7 +881,7 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
 
 @functools.lru_cache(maxsize=32)
 def _ring_update(
-    vs_key: int,
+    vs_keys: Tuple[int, ...],
     pops_bytes: bytes,
     site_key: int,
     spacing: int,
@@ -891,12 +894,16 @@ def _ring_update(
     padded: int,
     n_pops: int,
     mesh,
+    set_sizes: Optional[Tuple[int, ...]] = None,
 ):
     """Memoized scanned generate→ring-accumulate program for one static
     configuration (warmup and measured accumulators share one compiled
     program, like :func:`_fused_update`). Signature of the returned jit:
     ``(G, variant_rows, kept_sites, offsets, valids)``. ``n_pops`` is the
-    source's population count (see :func:`_fused_update`)."""
+    source's population count (see :func:`_fused_update`). ``set_sizes``
+    makes the column space a multi-set concatenation
+    (:func:`generate_column_block`); ``variant_rows`` is then per set —
+    a row counts for set s when ANY of set s's columns vary."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -910,16 +917,27 @@ def _ring_update(
     data_axis = DATA_AXIS if DATA_AXIS in mesh.shape else None
     g_spec = P(data_axis, SAMPLES_AXIS, None)
     s_spec = P(data_axis)
+    r_spec = P(data_axis, None)
+    n_sets = len(vs_keys)
+    set_bounds = (
+        np.concatenate([[0], np.cumsum(set_sizes)])
+        if set_sizes is not None
+        else np.array([0, num_samples])
+    )
 
     with jax.enable_x64(True):
-        vs_key_arr = _c64(vs_key)
+        vs_keys_arr = jnp.asarray(
+            np.array([k & _MASK64 for k in vs_keys], dtype=np.uint64)
+        )
         site_key_arr = _c64(site_key)
         pops_all = jnp.asarray(pops_padded)
 
         def per_device(g, rows, kept, offset, n_valid):
-            # g: (1, n_local, padded); offset/n_valid/kept/rows: (1,)
+            # g: (1, n_local, padded); offset/n_valid/kept: (1,);
+            # rows: (1, n_sets)
             s_idx = jax.lax.axis_index(SAMPLES_AXIS)
             col_start = (s_idx * n_local).astype(jnp.int64)
+            cols = col_start + jnp.arange(n_local, dtype=jnp.int64)
             pops_local = jax.lax.dynamic_slice(
                 pops_all, (s_idx * n_local,), (n_local,)
             )
@@ -939,12 +957,33 @@ def _ring_update(
                 )
                 kept_l += jnp.sum(jnp.any(T > 0, axis=1)).astype(kept_l.dtype)
                 hv = generate_column_block(
-                    positions, T, vs_key_arr, pops_local, col_start, num_samples
+                    positions,
+                    T,
+                    vs_keys_arr if set_sizes is not None else vs_keys_arr[0],
+                    pops_local,
+                    col_start,
+                    num_samples,
+                    set_sizes,
                 )
-                # A row "has variation" if ANY slice's columns do.
-                local_any = jnp.any(hv, axis=1).astype(jnp.int32)
-                total_any = jax.lax.psum(local_any, SAMPLES_AXIS)
-                rows_l += jnp.sum(total_any > 0).astype(rows_l.dtype)
+                # A row "has variation" for set s if ANY of set s's columns
+                # do, across every slice (matches the dense accumulator's
+                # per-set accounting).
+                per_set_local = jnp.stack(
+                    [
+                        jnp.any(
+                            hv
+                            & (
+                                (cols >= int(set_bounds[s]))
+                                & (cols < int(set_bounds[s + 1]))
+                            )[None, :],
+                            axis=1,
+                        ).astype(jnp.int32)
+                        for s in range(n_sets)
+                    ],
+                    axis=1,
+                )  # (B, n_sets)
+                total_any = jax.lax.psum(per_set_local, SAMPLES_AXIS)
+                rows_l += jnp.sum(total_any > 0, axis=0).astype(rows_l.dtype)
                 # Same materialization barrier as the dense update: the ring
                 # exchange dots the local column block against every rotated
                 # tile, so a fused generation chain would recompute per tile
@@ -964,8 +1003,8 @@ def _ring_update(
             shard_map(
                 per_device,
                 mesh=mesh,
-                in_specs=(g_spec, s_spec, s_spec, s_spec, s_spec),
-                out_specs=(g_spec, s_spec, s_spec),
+                in_specs=(g_spec, r_spec, s_spec, s_spec, s_spec),
+                out_specs=(g_spec, r_spec, s_spec),
                 # kept/rows are samples-replicated by construction
                 # (identical metadata / psum'd flags on every slice).
                 check_vma=False,
@@ -984,13 +1023,18 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
     ``VariantsPca.scala:216-217``) no device ever materializes the full
     N×N, no host→device data traffic exists at all, and the optional
     ``data`` axis adds Spark-executor-style grid parallelism on top.
-    Single variant set (the large-cohort use case).
+
+    Multi-set joint cohorts (``set_sizes`` + ``pops_per_set`` + a list
+    ``vs_key``) concatenate per-set column blocks exactly like the dense
+    accumulator — the join/merge scenario past the dense HBM rule
+    (``VariantsPca.scala:155-188``) stays on device instead of falling
+    back to host wire ingest.
     """
 
     def __init__(
         self,
         num_samples: int,
-        vs_key: int,
+        vs_key,
         pops: np.ndarray,
         site_key: int,
         spacing: int,
@@ -1001,6 +1045,8 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
         blocks_per_dispatch: int = 8,
         exact_int: bool = True,
         n_pops: Optional[int] = None,
+        set_sizes: Optional[Sequence[int]] = None,
+        pops_per_set: Optional[Sequence[np.ndarray]] = None,
     ):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -1011,10 +1057,46 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
             raise ValueError("ring device ingest needs a samples axis >= 2")
         self.mesh = mesh
         self.num_samples = int(num_samples)
+        vs_keys = (
+            tuple(int(k) for k in vs_key)
+            if isinstance(vs_key, (list, tuple))
+            else (int(vs_key),)
+        )
+        self.n_sets = len(vs_keys)
+        if set_sizes is not None:
+            self.set_sizes: Optional[Tuple[int, ...]] = tuple(
+                int(s) for s in set_sizes
+            )
+            if len(self.set_sizes) != self.n_sets:
+                raise ValueError(
+                    f"set_sizes has {len(self.set_sizes)} entries for "
+                    f"{self.n_sets} variant sets"
+                )
+            if pops_per_set is None or len(pops_per_set) != self.n_sets:
+                raise ValueError("set_sizes needs matching pops_per_set")
+            if any(
+                len(p) != s for p, s in zip(pops_per_set, self.set_sizes)
+            ):
+                raise ValueError("pops_per_set lengths must match set_sizes")
+            pops = np.concatenate(
+                [np.asarray(p, dtype=np.int32) for p in pops_per_set]
+            )
+            self.total_columns = sum(self.set_sizes)
+        elif self.n_sets > 1:
+            # Symmetric multi-set: every set shares the one cohort.
+            self.set_sizes = (self.num_samples,) * self.n_sets
+            pops = np.concatenate(
+                [np.asarray(pops, dtype=np.int32)] * self.n_sets
+            )
+            self.total_columns = self.num_samples * self.n_sets
+        else:
+            self.set_sizes = None
+            self.total_columns = self.num_samples
         self.samples_parallel = mesh.shape[SAMPLES_AXIS]
         self.data_parallel = mesh.shape.get(DATA_AXIS, 1)
         self.padded = (
-            -(-self.num_samples // self.samples_parallel) * self.samples_parallel
+            -(-self.total_columns // self.samples_parallel)
+            * self.samples_parallel
         )
         self.n_local = self.padded // self.samples_parallel
         self.block_size = int(block_size)
@@ -1027,7 +1109,7 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
 
         D = self.data_parallel
         pops_padded = np.zeros(self.padded, dtype=np.int32)
-        pops_padded[: self.num_samples] = np.asarray(pops, dtype=np.int32)
+        pops_padded[: self.total_columns] = np.asarray(pops, dtype=np.int32)
         data_axis = DATA_AXIS if DATA_AXIS in mesh.shape else None
         g_spec = P(data_axis, SAMPLES_AXIS, None)
         self._scalar_sharding = NamedSharding(mesh, P(data_axis))
@@ -1041,10 +1123,11 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
                 np.zeros((D,), np.int64), self._scalar_sharding
             )
             self.variant_rows = jax.device_put(
-                np.zeros((D,), np.int64), self._scalar_sharding
+                np.zeros((D, self.n_sets), np.int64),
+                NamedSharding(mesh, P(data_axis, None)),
             )
         self._update_key = (
-            int(vs_key),
+            vs_keys,
             pops_padded.tobytes(),
             int(site_key),
             self.spacing,
@@ -1053,12 +1136,13 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
             self.block_size,
             self.blocks_per_dispatch,
             np.dtype(operand_dtype).name,
-            self.num_samples,
+            self.total_columns,
             self.padded,
             int(n_pops)
             if n_pops is not None
             else int(np.asarray(pops, dtype=np.int32).max()) + 1,
             mesh,
+            self.set_sizes,
         )
         self._update = _ring_update(*self._update_key)
         self._tail_blocks = max(1, self.blocks_per_dispatch // 8)
@@ -1086,16 +1170,19 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
         )
 
     def _reduce_row_counts(self, rows: np.ndarray) -> np.ndarray:
-        """Single set: per-data-slice row counts (already samples-replicated
-        inside the shard_map) sum to one total."""
-        return np.asarray([rows.sum()])
+        """(n_sets,) per-set totals: data-parallel slices hold partial
+        per-set counts (disjoint grid spans, already samples-replicated
+        inside the shard_map) that sum elementwise."""
+        return rows.sum(axis=0) if rows.ndim > 1 else np.asarray([rows.sum()])
 
     def finalize(self) -> np.ndarray:
         from spark_examples_tpu.parallel.mesh import host_value
 
         with jax.enable_x64(True):
             full = host_value(self.finalize_sharded())
-        return full[: self.num_samples, : self.num_samples].astype(np.float64)
+        return full[: self.total_columns, : self.total_columns].astype(
+            np.float64
+        )
 
 
 __all__ = [

@@ -145,6 +145,36 @@ def host_value(x) -> np.ndarray:
     return np.asarray(jax.device_get(_replicator(sharding.mesh)(x)))
 
 
+@functools.lru_cache(maxsize=16)
+def _packed_fetch_jit(mesh: Optional[Mesh]):
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def pack(*arrays):
+        return jnp.concatenate([a.reshape(-1) for a in arrays])
+
+    if mesh is None:
+        return jax.jit(pack)
+    return jax.jit(pack, out_shardings=NamedSharding(mesh, PartitionSpec()))
+
+
+def packed_host_fetch(arrays, mesh: Optional[Mesh] = None) -> np.ndarray:
+    """ONE host transfer for several device arrays: flatten + concatenate on
+    device, fetch once, caller slices the flat result apart.
+
+    Each synchronous fetch on a remote-attached backend pays a full tunnel
+    round-trip, so end-of-run values (counters, components, scalars) should
+    ride together — this helper is the one audited home for the pattern
+    (replication for multi-controller fetches, x64 so int64 payloads are not
+    canonicalized to int32 at the jit boundary). Pass ``mesh`` when any
+    input may span non-addressable devices: the packed result is then
+    replicated and every process reads its local copy. Arrays should share
+    a dtype (mixed dtypes would silently promote).
+    """
+    with jax.enable_x64(True):
+        return np.asarray(host_value(_packed_fetch_jit(mesh)(*arrays)))
+
+
 def local_shard(x) -> np.ndarray:
     """One addressable shard of a global array — a process-local synchronous
     fetch that works in single- and multi-controller modes alike (used for
@@ -206,6 +236,7 @@ __all__ = [
     "distributed_init",
     "host_value",
     "local_shard",
+    "packed_host_fetch",
     "make_mesh",
     "default_mesh",
     "parse_mesh_shape",

@@ -21,21 +21,25 @@ from spark_examples_tpu.models.variant import Variant, VariantKey, VariantsBuild
 _MANIFEST = "_manifest.json"
 
 
-def save_variants(
-    path: str,
-    shards: Iterable[List[Tuple[VariantKey, Variant]]],
-) -> int:
-    """Write one gzip JSON-lines part file per shard; returns record count.
+class CheckpointWriter:
+    """Incremental checkpoint writer: one gzip JSON-lines part file per
+    shard as it streams, the manifest only on :meth:`close` — an abandoned
+    (partially written) checkpoint has no manifest and fails loudly on
+    load instead of silently resuming a truncated cohort.
 
     Records are the wire-format JSON of ``Variant.to_json`` plus the raw
     partition key, so the round trip preserves both members of the
     ``(VariantKey, Variant)`` pair the reference's objectFile held.
     """
-    os.makedirs(path, exist_ok=True)
-    total = 0
-    n_parts = 0
-    for index, records in enumerate(shards):
-        part_path = os.path.join(path, f"part-{index:05d}.jsonl.gz")
+
+    def __init__(self, path: str):
+        os.makedirs(path, exist_ok=True)
+        self.path = path
+        self.total = 0
+        self.parts = 0
+
+    def write_shard(self, records: List[Tuple[VariantKey, Variant]]) -> None:
+        part_path = os.path.join(self.path, f"part-{self.parts:05d}.jsonl.gz")
         with gzip.open(part_path, "wt") as f:
             for key, variant in records:
                 entry = {
@@ -43,11 +47,34 @@ def save_variants(
                     "variant": variant.to_json(),
                 }
                 f.write(json.dumps(entry) + "\n")
-                total += 1
-        n_parts += 1
-    with open(os.path.join(path, _MANIFEST), "w") as f:
-        json.dump({"parts": n_parts, "records": total, "format": "jsonl.gz/v1"}, f)
-    return total
+                self.total += 1
+        self.parts += 1
+
+    def close(self) -> None:
+        with open(os.path.join(self.path, _MANIFEST), "w") as f:
+            json.dump(
+                {
+                    "parts": self.parts,
+                    "records": self.total,
+                    "format": "jsonl.gz/v1",
+                },
+                f,
+            )
+
+
+def save_variants(
+    path: str,
+    shards: Iterable[List[Tuple[VariantKey, Variant]]],
+) -> int:
+    """Write one part file per shard (consumed lazily); returns the record
+    count. The driver's streaming save (``--save-variants``) uses
+    :class:`CheckpointWriter` directly to interleave writing with the
+    analysis pass."""
+    writer = CheckpointWriter(path)
+    for records in shards:
+        writer.write_shard(records)
+    writer.close()
+    return writer.total
 
 
 class CheckpointDataset:
@@ -93,4 +120,9 @@ def load_variants(path: str) -> CheckpointDataset:
     return CheckpointDataset(path)
 
 
-__all__ = ["save_variants", "load_variants", "CheckpointDataset"]
+__all__ = [
+    "CheckpointWriter",
+    "save_variants",
+    "load_variants",
+    "CheckpointDataset",
+]

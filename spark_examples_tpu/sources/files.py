@@ -730,8 +730,11 @@ class _StreamedVcf:
             for chunk in _iter_vcf_chunks(self.path, self.chunk_bytes):
                 scanned = scan_vcf_sites_chunk(chunk)
                 if scanned is None:
+                    # Site-only on the fallback too: an empty sample list
+                    # skips the per-sample genotype walk entirely
+                    # (contig/position/end are sample-independent).
                     contigs, positions, ends = _python_chunk_arrays(
-                        chunk, self.path, self.set_id, self.samples
+                        chunk, self.path, self.set_id, []
                     )[:3]
                 else:
                     contigs, positions, ends = scanned

@@ -36,6 +36,24 @@ def test_two_process_distributed_run():
     assert report["cli_pc_lines"] == 24, json.dumps(report, indent=2)
 
 
+def test_three_process_distributed_run_non_power_of_two():
+    """Three coordinator-connected processes, 2 devices each — a 6-device
+    global fleet. Non-power-of-two process counts exercise the shapes the
+    2×4 run cannot: the data-axis round-robin hands UNEVEN dispatch counts
+    to the slices (7 grid groups over 6 slices), and the ring exchange runs
+    6 ppermute hops with 4 of every 6 crossing a process boundary."""
+    report = verify_multihost(num_processes=3, local_devices=2)
+    assert report["gramian_ok"], json.dumps(report, indent=2)
+    assert report["ring_gramian_ok"], json.dumps(report, indent=2)
+    assert report["result_spans_processes"], json.dumps(report, indent=2)
+    for child in report["children"]:
+        assert child["global_devices"] == 6, child
+        assert child["local_devices"] == 2, child
+    assert report["cli_ok"], json.dumps(report, indent=2)
+    assert report["cli_outputs_identical"], json.dumps(report, indent=2)
+    assert report["cli_pc_lines"] == 24, json.dumps(report, indent=2)
+
+
 def test_child_cli_exits_nonzero_on_bad_coordinator():
     """A child whose coordinator is unreachable must fail loudly within its
     initialization timeout — not hang, not fall back to single-process."""

@@ -174,6 +174,81 @@ def test_checkpoint_round_trip(tmp_path):
     assert calls_resumed == calls_fresh
 
 
+def test_cli_save_variants_round_trip(tmp_path, capsys):
+    """--save-variants end to end: ingest → save while streaming → resume
+    via --input-path produces identical principal components, with no
+    Python in between (the writer the reference's objectFile resume never
+    had, VariantsPca.scala:112-113)."""
+    ckpt = str(tmp_path / "saved-variants")
+    base = [
+        "--references", "17:0:30000",
+        "--variant-set-id", "vs",
+        "--num-samples", "12",
+        "--seed", "5",
+        "--block-size", "32",
+        "--min-allele-frequency", "0.05",
+    ]
+    saved_lines = pca_driver.run(base + ["--save-variants", ckpt])
+    out = capsys.readouterr().out
+    assert "Saved " in out and ckpt in out
+    # The checkpoint holds UNFILTERED records (filters re-apply on resume):
+    # more records than AF-kept rows.
+    total = sum(1 for _ in load_variants(ckpt))
+    assert total > 0
+
+    resumed_lines = pca_driver.run(base + ["--input-path", ckpt])
+    capsys.readouterr()
+    assert resumed_lines == saved_lines
+
+    # A different threshold still works against the saved (unfiltered) data.
+    loose = pca_driver.run(
+        [a for a in base if a not in ("--min-allele-frequency", "0.05")]
+        + ["--input-path", ckpt]
+    )
+    capsys.readouterr()
+    fresh_loose = pca_driver.run(
+        [a for a in base if a not in ("--min-allele-frequency", "0.05")]
+    )
+    capsys.readouterr()
+    assert loose == fresh_loose
+
+
+def test_save_variants_refuses_streaming_scale_file(tmp_path):
+    """A VCF the auto logic would STREAM must not silently revert to the
+    O(file) wire parse because --save-variants was added."""
+    vcf = (
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS1\n"
+        "17\t101\t.\tA\tG\t1\t.\tAF=0.5\tGT\t0|1\n"
+    )
+    path = tmp_path / "tiny.vcf"
+    path.write_text(vcf)
+    with pytest.raises(ValueError, match="streaming-scale"):
+        pca_driver.run(
+            [
+                "--source", "file", "--input-files", str(path),
+                "--stream-chunk-bytes", "1",  # force streaming eligibility
+                "--save-variants", str(tmp_path / "ckpt"),
+                "--references", "17:0:1000",
+            ]
+        )
+
+
+def test_save_variants_flag_guards():
+    for argv, message in [
+        (["--save-variants", "/tmp/x", "--ingest", "device"], "wire"),
+        (["--save-variants", "/tmp/x", "--input-path", "/tmp/y"], "re-save"),
+        (
+            [
+                "--save-variants", "/tmp/x",
+                "--variant-set-id", "vs-a,vs-b",
+            ],
+            "single variant set",
+        ),
+    ]:
+        with pytest.raises(ValueError, match=message):
+            pca_driver.run(argv)
+
+
 def test_emit_result_formats(tmp_path, capsys):
     conf = _conf(output_path=str(tmp_path / "out"))
     driver = VariantsPcaDriver(conf, _source(conf))
@@ -450,6 +525,40 @@ def test_sharded_device_ingest_run_matches_dense_run():
         return np.array([[float(x) for x in l.split("\t")[2:]] for l in lines])
 
     A, B = parse(dense), parse(sharded)
+    signs = np.sign((A * B).sum(axis=0))
+    signs[signs == 0] = 1
+    np.testing.assert_allclose(A, B * signs, atol=5e-3)
+
+
+def test_merged_sharded_run_stays_on_device_and_matches_wire(capsys):
+    """The VERDICT-r4 cliff, closed: a merged (asymmetric 2-set) config
+    under the SHARDED strategy — the joint-cohort-past-the-dense-HBM-rule
+    scenario (``VariantsPca.scala:155-168``) — now runs the multi-set ring
+    device path instead of silently falling back to wire ingest, and its
+    principal components match the wire oracle."""
+    argv = [
+        "--references", "17:0:30000",
+        "--variant-set-id", "vs-a,vs-b",
+        "--num-samples", "13,6",
+        "--seed", "5",
+        "--block-size", "32",
+    ]
+    wire = pca_driver.run(argv + ["--ingest", "wire"])
+    capsys.readouterr()
+    sharded = pca_driver.run(
+        argv + ["--similarity-strategy", "sharded", "--mesh-shape", "1,8"]
+    )
+    out = capsys.readouterr().out
+    # Loud-fallback guard: the run must NOT have taken the wire path.
+    assert "using wire ingest" not in out
+
+    def parse(lines):
+        return np.array([[float(x) for x in l.split("\t")[2:]] for l in lines])
+
+    assert [l.split("\t")[0] for l in wire] == [
+        l.split("\t")[0] for l in sharded
+    ]
+    A, B = parse(wire), parse(sharded)
     signs = np.sign((A * B).sum(axis=0))
     signs[signs == 0] = 1
     np.testing.assert_allclose(A, B * signs, atol=5e-3)
