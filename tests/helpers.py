@@ -1,0 +1,20 @@
+"""Shared test helpers."""
+
+import numpy as np
+
+
+def parse_pc_lines(lines):
+    """Emitted TSV lines (``name<TAB>dataset<TAB>pc...``) → (N, num_pc)."""
+    return np.array([[float(x) for x in l.split("\t")[2:]] for l in lines])
+
+
+def assert_pcs_match(a_lines, b_lines, atol=5e-3):
+    """Two runs' emitted PC lines agree: same callset order, components
+    equal up to the eigenvector sign ambiguity."""
+    assert [l.split("\t")[0] for l in a_lines] == [
+        l.split("\t")[0] for l in b_lines
+    ]
+    A, B = parse_pc_lines(a_lines), parse_pc_lines(b_lines)
+    signs = np.sign((A * B).sum(axis=0))
+    signs[signs == 0] = 1
+    np.testing.assert_allclose(A, B * signs, atol=atol)

@@ -6,6 +6,7 @@ import os
 
 import numpy as np
 import pytest
+from helpers import assert_pcs_match
 
 from spark_examples_tpu.config import PcaConf
 from spark_examples_tpu.pipeline import pca_driver
@@ -490,12 +491,7 @@ def test_sharded_strategy_end_to_end_matches_dense(tmp_path):
     sharded = pca_driver.run(
         argv + ["--similarity-strategy", "sharded", "--mesh-shape", "1,8"]
     )
-    def parse(lines):
-        return np.array([[float(x) for x in l.split("\t")[2:]] for l in lines])
-    A, B = parse(dense), parse(sharded)
-    signs = np.sign((A * B).sum(axis=0))
-    signs[signs == 0] = 1
-    np.testing.assert_allclose(A, B * signs, atol=5e-3)
+    assert_pcs_match(dense, sharded)
 
 
 def test_sharded_strategy_guard_without_mesh():
@@ -520,14 +516,7 @@ def test_sharded_device_ingest_run_matches_dense_run():
     sharded = pca_driver.run(
         argv + ["--similarity-strategy", "sharded", "--mesh-shape", "1,8"]
     )
-
-    def parse(lines):
-        return np.array([[float(x) for x in l.split("\t")[2:]] for l in lines])
-
-    A, B = parse(dense), parse(sharded)
-    signs = np.sign((A * B).sum(axis=0))
-    signs[signs == 0] = 1
-    np.testing.assert_allclose(A, B * signs, atol=5e-3)
+    assert_pcs_match(dense, sharded)
 
 
 def test_merged_sharded_run_stays_on_device_and_matches_wire(capsys):
@@ -551,17 +540,7 @@ def test_merged_sharded_run_stays_on_device_and_matches_wire(capsys):
     out = capsys.readouterr().out
     # Loud-fallback guard: the run must NOT have taken the wire path.
     assert "using wire ingest" not in out
-
-    def parse(lines):
-        return np.array([[float(x) for x in l.split("\t")[2:]] for l in lines])
-
-    assert [l.split("\t")[0] for l in wire] == [
-        l.split("\t")[0] for l in sharded
-    ]
-    A, B = parse(wire), parse(sharded)
-    signs = np.sign((A * B).sum(axis=0))
-    signs[signs == 0] = 1
-    np.testing.assert_allclose(A, B * signs, atol=5e-3)
+    assert_pcs_match(wire, sharded)
 
 
 def test_io_stats_parity_across_ingest_paths(capsys):

@@ -275,6 +275,32 @@ def test_cli_streamed_run_matches_in_memory(tmp_path, capsys):
     assert streamed_out == packed_out
 
 
+def test_cli_streamed_sharded_strategy_matches_wire(tmp_path, capsys):
+    """Streamed file ingest composed with the SHARDED similarity strategy:
+    the streamed blocks feed the row-tile-sharded Gramian + sharded
+    centering/eigensolve and the principal components match the wire run."""
+    path = _make_vcf(tmp_path, n_samples=6, rows_per_contig=90)
+    base = [
+        "--source", "file", "--input-files", path,
+        "--references", "17:0:3000",
+        "--block-size", "32",
+    ]
+    from helpers import assert_pcs_match
+
+    wire = pca_driver.run(base + ["--ingest", "wire", "--stream-chunk-bytes", "0"])
+    capsys.readouterr()
+    streamed_sharded = pca_driver.run(
+        base
+        + [
+            "--stream-chunk-bytes", "1",
+            "--similarity-strategy", "sharded",
+            "--mesh-shape", "1,8",
+        ]
+    )
+    capsys.readouterr()
+    assert_pcs_match(wire, streamed_sharded)
+
+
 def test_streamed_ingest_memory_is_bounded_by_chunk(tmp_path):
     """The capability claim, measured: peak traced host allocations during a
     full streamed ingest stay a small multiple of the chunk size — far under
