@@ -566,7 +566,10 @@ def _iter_vcf_chunks(path: str, chunk_bytes: int) -> Iterator[bytes]:
     """Stream a (possibly gzipped) text file in ~``chunk_bytes`` pieces that
     end at line boundaries (the partial last line carries into the next
     chunk), holding one chunk in memory at a time."""
-    chunk_bytes = max(1 << 12, int(chunk_bytes))
+    # Floor guards 0/negative; tiny explicit values are honored (tests fuzz
+    # chunk boundaries with chunks smaller than one line — the carry handles
+    # lines longer than the chunk).
+    chunk_bytes = max(64, int(chunk_bytes))
     opener = (
         gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
     )

@@ -8,10 +8,16 @@ in-memory parser.
 """
 
 import gzip
+import os
+import tempfile
 import tracemalloc
 
 import numpy as np
 import pytest
+from helpers import assert_pcs_match
+from hypothesis import given, settings
+from hypothesis import strategies as st
+from test_files import _vcf_documents
 
 from spark_examples_tpu.pipeline import pca_driver
 from spark_examples_tpu.sharding.contig import Contig
@@ -250,6 +256,66 @@ def test_noncontiguous_contig_fails_loudly(tmp_path):
         )
 
 
+def _coordinate_sort(document: str) -> str:
+    """A streaming-legal equivalent of a fuzzed VCF document: contigs made
+    contiguous (first-seen order), positions sorted stably within each —
+    exactly the layout `bcftools sort` would emit."""
+    eol = "\r\n" if "\r\n" in document else "\n"
+    lines = [l for l in document.split(eol) if l]
+    head = [l for l in lines if l.startswith("#")]
+    groups: dict = {}
+    for line in lines:
+        if line.startswith("#"):
+            continue
+        groups.setdefault(line.split("\t")[0], []).append(line)
+    for group in groups.values():
+        group.sort(key=lambda l: int(l.split("\t")[1]))
+    data = [line for name in groups for line in groups[name]]
+    return eol.join(head + data) + eol
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    document=_vcf_documents(),
+    chunk=st.integers(min_value=64, max_value=512),
+    min_af=st.sampled_from([None, 0.05]),
+)
+def test_fuzz_streamed_matches_in_memory(document, chunk, min_af):
+    """Property: for ANY (sorted) fuzzed VCF document and ANY chunk size —
+    including chunks smaller than one line — the streamed pass produces the
+    same blocks and the same contig bounds as the in-memory parser. This is
+    the chunk-boundary/carry torture test."""
+    doc = _coordinate_sort(document)
+    fd, path = tempfile.mkstemp(suffix=".vcf")
+    try:
+        with os.fdopen(fd, "w", newline="") as f:
+            f.write(doc)
+        plain = FileGenomicsSource([path], stream_chunk_bytes=0)
+        streamed = FileGenomicsSource([path], stream_chunk_bytes=chunk)
+        set_id = plain.set_ids[0]
+        plain_contigs = plain.get_contigs(set_id)
+        streamed_contigs = streamed.get_contigs(set_id)
+        assert [
+            (c.reference_name, c.start, c.end) for c in streamed_contigs
+        ] == [(c.reference_name, c.start, c.end) for c in plain_contigs]
+        for c in plain_contigs:
+            window = Contig(c.reference_name, 0, 1 << 40)
+            want = _blocks_concat(
+                plain.genotype_blocks(
+                    set_id, window, block_size=4, min_allele_frequency=min_af
+                )
+            )
+            got = _blocks_concat(
+                streamed.genotype_blocks(
+                    set_id, window, block_size=4, min_allele_frequency=min_af
+                )
+            )
+            for w, g in zip(want, got):
+                np.testing.assert_array_equal(w, g)
+    finally:
+        os.unlink(path)
+
+
 def test_cli_streamed_run_matches_in_memory(tmp_path, capsys):
     """variants-pca end to end: the streamed run (auto-selected packed via
     --stream-chunk-bytes) prints byte-identical output — PCs AND I/O stats —
@@ -285,8 +351,6 @@ def test_cli_streamed_sharded_strategy_matches_wire(tmp_path, capsys):
         "--references", "17:0:3000",
         "--block-size", "32",
     ]
-    from helpers import assert_pcs_match
-
     wire = pca_driver.run(base + ["--ingest", "wire", "--stream-chunk-bytes", "0"])
     capsys.readouterr()
     streamed_sharded = pca_driver.run(
