@@ -40,12 +40,14 @@ BASELINE_SECONDS = 7200.0
 # Measured optimum on v5e (DESIGN.md "single-chip ingest roofline"): large
 # dispatch groups amortize per-dispatch overhead; contig remainders run
 # through the accumulator's ~K/8 tail program, so group padding stays <2%.
+# BLOCKS_PER_DISPATCH defaults to the driver's constant-work auto rule
+# (small cohorts get longer scans — ops/devicegen.py:auto_blocks_per_dispatch,
+# platinum ~2× faster: 1.03 → 0.53 s); BENCH_BLOCKS_PER_DISPATCH pins it.
 BLOCK = int(os.environ.get("BENCH_BLOCK", 16384))
-BLOCKS_PER_DISPATCH = int(os.environ.get("BENCH_BLOCKS_PER_DISPATCH", 32))
-# Warmup covers BOTH compiled programs: one full main group plus one tail
-# group (main + block*K/8 sites).
-WARMUP_BASES = VARIANT_SPACING * (
-    BLOCK * BLOCKS_PER_DISPATCH + BLOCK * max(1, BLOCKS_PER_DISPATCH // 8)
+BLOCKS_PER_DISPATCH = (
+    int(os.environ["BENCH_BLOCKS_PER_DISPATCH"])
+    if "BENCH_BLOCKS_PER_DISPATCH" in os.environ
+    else None
 )
 
 # The BASELINE.json benchmark configs (plus a beyond-reference large-cohort
@@ -139,16 +141,28 @@ def _run_config(name: str, device) -> dict:
         (cohort_sizes or {}).get(s, n_samples) for s in config["sets"]
     ]
     total_columns = sum(per_set_sizes)
+    from spark_examples_tpu.ops.devicegen import auto_blocks_per_dispatch
+
+    # Resolve the scan length the driver will use (explicit env pin, or the
+    # constant-work auto rule) — the warmup region must cover one full
+    # group of the SAME length or the measured run compiles cold.
+    k_resolved = BLOCKS_PER_DISPATCH or auto_blocks_per_dispatch(
+        total_columns, BLOCK
+    )
+    warmup_bases = VARIANT_SPACING * (
+        BLOCK * k_resolved + BLOCK * max(1, k_resolved // 8)
+    )
     base_args = [
         "--variant-set-id", ",".join(config["sets"]),
         "--ingest", "device",
         "--block-size", str(BLOCK),
-        "--blocks-per-dispatch", str(BLOCKS_PER_DISPATCH),
         "--num-pc", "2",
         # Per-set cohort sizes; the dense/sharded strategy is left on auto —
         # the HBM-derived rule decides (ops/gramian.py:dense_strategy_fits).
         "--num-samples", ",".join(str(s) for s in per_set_sizes),
     ]
+    if BLOCKS_PER_DISPATCH is not None:
+        base_args += ["--blocks-per-dispatch", str(BLOCKS_PER_DISPATCH)]
     source = SyntheticGenomicsSource(
         num_samples=n_samples,
         seed=42,
@@ -159,7 +173,7 @@ def _run_config(name: str, device) -> dict:
     # Warmup: identical shapes (one dispatch group + full-cohort finalize),
     # so every jit in the measured run is compile-cache warm.
     warm_start = time.perf_counter()
-    warm_refs = ";".join([f"1:0:{WARMUP_BASES}"] * n_sets)
+    warm_refs = ";".join([f"1:0:{warmup_bases}"] * n_sets)
     conf_w, driver_w = _make_driver(
         base_args + ["--references", warm_refs], source
     )
@@ -204,7 +218,7 @@ def _run_config(name: str, device) -> dict:
             "chips_used": chips_used,
             "device_dispatches": acc.dispatches,
             "block_size": BLOCK,
-            "blocks_per_dispatch": BLOCKS_PER_DISPATCH,
+            "blocks_per_dispatch": k_resolved,
             "compile_seconds_excluded": round(compile_seconds, 3),
             "gramian_dtype": str(np.dtype("int32")),
             "device": str(device),

@@ -262,7 +262,7 @@ class PcaConf(GenomicsConf):
     mesh_shape: Optional[str] = None
     block_size: int = 1024
     ingest: str = "auto"
-    blocks_per_dispatch: int = 32
+    blocks_per_dispatch: Optional[int] = None
     exact_similarity: bool = False
     similarity_strategy: str = "auto"
     num_workers: int = 8
@@ -318,11 +318,13 @@ class PcaConf(GenomicsConf):
         parser.add_argument(
             "--blocks-per-dispatch",
             type=int,
-            default=32,
+            default=None,
             help=(
                 "Device-ingest blocks fused per dispatch (lax.scan length); "
                 "higher amortizes per-dispatch overhead on remote-attached "
-                "backends."
+                "backends. Default: auto — constant device work per "
+                "dispatch, so small cohorts get longer scans "
+                "(ops/devicegen.py:auto_blocks_per_dispatch)."
             ),
         )
         parser.add_argument(

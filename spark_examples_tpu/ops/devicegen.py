@@ -310,6 +310,27 @@ def generate_column_block(
     return hv
 
 
+# Measured v5e sweet spot: 524,288-site dispatch groups at 2,504 columns
+# (~40 ms of device work per dispatch). Per-dispatch overhead (host loop +
+# tunnel) is fixed, so the per-dispatch SITE budget scales inversely with
+# the cohort's column count: the 17-column deep-call cohort runs ~2× faster
+# at K=512 than at the large-N optimum K=32 (platinum whole-genome
+# 1.03 → 0.53 s, matched tunnel conditions — DESIGN.md §7.3); past ~512
+# the gain plateaus, and at ≥2,504 columns larger K measurably regresses
+# (tail padding × 22 contigs).
+_TARGET_COLUMN_SITES = 524_288 * 2504
+
+
+def auto_blocks_per_dispatch(total_columns: int, block_size: int) -> int:
+    """Dispatch-group length (``lax.scan`` steps) for a cohort: constant
+    device work per dispatch across cohort sizes, clamped to the measured
+    [32, 512] sweet range and rounded to a multiple of 8 (the tail program
+    is K/8 blocks)."""
+    k = _TARGET_COLUMN_SITES // max(int(total_columns), 1)
+    k //= max(int(block_size), 1)
+    return int(min(512, max(32, (k // 8) * 8)))
+
+
 @functools.lru_cache(maxsize=32)
 def _fused_update(
     vs_keys: Tuple[int, ...],
@@ -1188,6 +1209,7 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
 __all__ = [
     "DeviceGenGramianAccumulator",
     "DeviceGenRingGramianAccumulator",
+    "auto_blocks_per_dispatch",
     "generate_column_block",
     "generate_has_variation",
     "mix64",

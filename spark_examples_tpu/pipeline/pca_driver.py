@@ -450,12 +450,18 @@ class VariantsPcaDriver:
         from spark_examples_tpu.ops.devicegen import (
             DeviceGenGramianAccumulator,
             DeviceGenRingGramianAccumulator,
+            auto_blocks_per_dispatch,
         )
         from spark_examples_tpu.sources.synthetic import af_filter_micro
 
         source: SyntheticGenomicsSource = self.source  # type: ignore[assignment]
         conf = self.conf
         mesh = self._make_mesh()
+        # Dispatch-group length: explicit flag, or constant-work auto rule
+        # (small cohorts get longer scans — per-dispatch overhead is fixed).
+        blocks_per_dispatch = conf.blocks_per_dispatch or auto_blocks_per_dispatch(
+            len(self.indexes), conf.block_size
+        )
         use_ring = self._resolve_sharded(None, mesh)
         if use_ring and len(conf.variant_set_id) > 1:
             # Sharded multi-set: the joint cohort's concatenated per-set
@@ -476,7 +482,7 @@ class VariantsPcaDriver:
                 mesh=mesh,
                 min_af_micro=af_filter_micro(conf.min_allele_frequency),
                 block_size=conf.block_size,
-                blocks_per_dispatch=conf.blocks_per_dispatch,
+                blocks_per_dispatch=blocks_per_dispatch,
                 exact_int=True,
                 n_pops=source.n_pops,
                 set_sizes=sizes,
@@ -498,7 +504,7 @@ class VariantsPcaDriver:
                 mesh=mesh,
                 min_af_micro=af_filter_micro(conf.min_allele_frequency),
                 block_size=conf.block_size,
-                blocks_per_dispatch=conf.blocks_per_dispatch,
+                blocks_per_dispatch=blocks_per_dispatch,
                 exact_int=True,
                 n_pops=source.n_pops,
             )
@@ -518,7 +524,7 @@ class VariantsPcaDriver:
                 ref_block_fraction=source.ref_block_fraction,
                 min_af_micro=af_filter_micro(conf.min_allele_frequency),
                 block_size=conf.block_size,
-                blocks_per_dispatch=conf.blocks_per_dispatch,
+                blocks_per_dispatch=blocks_per_dispatch,
                 exact_int=True,
                 mesh=mesh,
                 n_pops=source.n_pops,

@@ -240,6 +240,21 @@ def test_fused_accumulator_min_af_matches_host():
     np.testing.assert_array_equal(acc.finalize(), gramian_reference(host_rows))
 
 
+def test_auto_blocks_per_dispatch_scales_with_cohort():
+    """Constant device work per dispatch: the tuned large-N geometry stays
+    put, small cohorts get longer scans (platinum whole-genome ~2× faster,
+    1.03 → 0.53 s — DESIGN.md §7.3), clamped to the measured [32, 512]
+    range and a multiple of 8 (the tail program is K/8 blocks)."""
+    from spark_examples_tpu.ops.devicegen import auto_blocks_per_dispatch
+
+    assert auto_blocks_per_dispatch(2504, 16384) == 32  # the tuned optimum
+    assert auto_blocks_per_dispatch(2504, 1024) == 512  # same group sites
+    assert auto_blocks_per_dispatch(17, 16384) == 512  # clamp high
+    assert auto_blocks_per_dispatch(25_000, 16384) == 32  # clamp low
+    k = auto_blocks_per_dispatch(500, 16384)
+    assert 32 <= k <= 512 and k % 8 == 0
+
+
 def test_poke_gating_spans_grid_walks():
     """The eager-mode poke fires exactly once, at the first dispatch with
     more work following — including work in a LATER add_grid call: a
