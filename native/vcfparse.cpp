@@ -88,8 +88,11 @@ inline int64_t parse_int(const char* b, const char* e, bool* ok) {
 
 extern "C" {
 
-// Count data lines and samples. Returns 0 on success, negative on error:
-// -1 no #CHROM header. Outputs: n_lines (data lines), n_samples.
+// Count data lines and samples. Always returns 0; a buffer with no #CHROM
+// header yields n_samples = 0 — the Python wire parser tolerates headerless
+// (sites-only) VCFs as an empty cohort, and the native path must not reject
+// what the oracle accepts (malformed DATA lines still fail in vcf_parse).
+// Outputs: n_lines (data lines), n_samples.
 int vcf_scan(const char* buf, int64_t len, int64_t* n_lines,
              int64_t* n_samples) {
     const char* p = buf;
@@ -115,7 +118,8 @@ int vcf_scan(const char* buf, int64_t len, int64_t* n_lines,
         }
         p = next_line(p, end);
     }
-    return *n_samples >= 0 ? 0 : -1;
+    if (*n_samples < 0) *n_samples = 0;
+    return 0;
 }
 
 // Count data lines (non-empty, not starting with '#') in a buffer — the
@@ -142,6 +146,9 @@ int64_t vcf_count_data_lines(const char* buf, int64_t len) {
 // --all-references without paying the per-sample genotype parse). Arrays
 // are caller-allocated with vcf_count_data_lines rows. Returns rows parsed,
 // or the negative 1-based ordinal of the first malformed data line.
+// Malformedness matches the Python parser exactly: a data line with fewer
+// than 8 fields is rejected even though this scan only reads three of them
+// (the fallback must not accept less than the native path, or vice versa).
 int64_t vcf_scan_sites(const char* buf, int64_t len, int64_t* positions,
                        int64_t* ends, int64_t* contig_off,
                        int64_t* contig_len) {
@@ -168,10 +175,28 @@ int64_t vcf_scan_sites(const char* buf, int64_t len, int64_t* positions,
         positions[row] = pos1 - 1;
         if (!field_span(p, stripped_end, 3, &fb, &fe)) return -ordinal;
         ends[row] = positions[row] + (fe - fb);
+        if (!field_span(p, stripped_end, 7, &fb, &fe)) return -ordinal;
         ++row;
         p = next_line(p, end);
     }
     return row;
+}
+
+// flags[i] = 1 iff row i's contig span differs in CONTENT from row i-1's
+// (flags[0] = 1 when rows > 0). Lets the host decode one contig string per
+// run instead of per row — the run detection is where the per-row Python
+// cost was (rows are ~100% same-contig runs in sorted VCFs).
+void vcf_mark_contig_changes(const char* buf, const int64_t* off,
+                             const int64_t* len, int64_t rows,
+                             int8_t* flags) {
+    for (int64_t i = 0; i < rows; ++i) {
+        if (i == 0) { flags[i] = 1; continue; }
+        flags[i] = (len[i] != len[i - 1] ||
+                    memcmp(buf + off[i], buf + off[i - 1],
+                           static_cast<size_t>(len[i])) != 0)
+                       ? 1
+                       : 0;
+    }
 }
 
 // Parse all data lines. Arrays are caller-allocated with n_lines rows (from

@@ -540,16 +540,26 @@ class _PackedVcf:
 #: Decompressed bytes per streamed parse chunk (default; ``_StreamedVcf``).
 STREAM_CHUNK_BYTES = 32 << 20
 
-#: Files larger than this (on-disk bytes) stream by default when no
-#: explicit ``--stream-chunk-bytes`` is given. The reference's paging
-#: architecture held one page per executor (``rdd/VariantsRDD.scala:
-#: 198-225``); whole-file parsing only wins below this scale.
+#: DECOMPRESSED bytes above which a VCF streams by default when no explicit
+#: ``--stream-chunk-bytes`` is given. The reference's paging architecture
+#: held one page per executor (``rdd/VariantsRDD.scala:198-225``);
+#: whole-file parsing only wins below this scale.
 STREAM_THRESHOLD_BYTES = 128 << 20
+
+#: Conservative gzip ratio for VCF text (GT matrices compress 10-30×): the
+#: auto-streaming decision compares a ``.gz`` file's on-disk size × this
+#: against the decompressed threshold, so the standard compressed 1000
+#: Genomes distribution streams instead of silently expanding to multi-GB
+#: host arrays under the raw-size test.
+_GZ_RATIO_ESTIMATE = 10
 
 
 def _read_vcf_header_samples(path: str) -> List[str]:
     """Sample names from the ``#CHROM`` header row alone — O(header) work
-    and memory, so callset discovery never pays a data parse."""
+    and memory, so callset discovery never pays a data parse. A headerless
+    VCF (a data line before any ``#CHROM`` row) yields the empty cohort,
+    exactly like the whole-file wire parser (``_parse_vcf``) — header-only
+    discovery must not reject files the data parse would accept."""
     with _open_text(path) as f:
         for line in f:
             line = line.rstrip("\r\n")
@@ -558,8 +568,8 @@ def _read_vcf_header_samples(path: str) -> List[str]:
             if line.startswith("#CHROM"):
                 columns = line.split("\t")
                 return columns[9:] if len(columns) > 9 else []
-            break  # a data line before #CHROM: headerless
-    raise ValueError(f"{path}: VCF has no #CHROM header row")
+            break  # a data line before #CHROM: headerless, no cohort
+    return []
 
 
 def _iter_vcf_chunks(path: str, chunk_bytes: int) -> Iterator[bytes]:
@@ -931,12 +941,16 @@ class FileGenomicsSource(GenomicsSource):
             return False
         if self.stream_chunk_bytes is not None:
             return self.stream_chunk_bytes > 0
+        path = self._by_id[set_id]
         try:
-            return (
-                os.path.getsize(self._by_id[set_id]) > STREAM_THRESHOLD_BYTES
-            )
+            size = os.path.getsize(path)
         except OSError:
             return False
+        if path.endswith(".gz"):
+            # The threshold is in DECOMPRESSED bytes; estimate from the
+            # compressed size (exact sizing would require reading the file).
+            size *= _GZ_RATIO_ESTIMATE
+        return size > STREAM_THRESHOLD_BYTES
 
     def streamed(self, set_id: str) -> _StreamedVcf:
         """The streaming view of one VCF input (header parsed once; data

@@ -128,6 +128,14 @@ def vcf_library() -> Optional[ctypes.CDLL]:
             np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
         ]
+        lib.vcf_mark_contig_changes.restype = None
+        lib.vcf_mark_contig_changes.argtypes = [
+            ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
+        ]
         _lib = lib
     except Exception as e:  # no compiler / build failure: fall back
         _lib_error = str(e)
@@ -154,11 +162,11 @@ def parse_vcf_arrays(text: bytes) -> Optional[Tuple[np.ndarray, ...]]:
         return None
     n_lines = ctypes.c_int64()
     n_samples = ctypes.c_int64()
-    rc = lib.vcf_scan(
+    # A headerless (sites-only) VCF scans as an empty cohort — the wire
+    # parser's behavior; malformed data lines still raise from vcf_parse.
+    lib.vcf_scan(
         text, len(text), ctypes.byref(n_lines), ctypes.byref(n_samples)
     )
-    if rc != 0:
-        raise ValueError("VCF has no #CHROM header row")
     L, N = n_lines.value, n_samples.value
     positions = np.empty(L, dtype=np.int64)
     ends = np.empty(L, dtype=np.int64)
@@ -183,10 +191,32 @@ def parse_vcf_arrays(text: bytes) -> Optional[Tuple[np.ndarray, ...]]:
 
 
 def _contig_strings(text: bytes, contig_off, contig_len, rows: int):
-    """Per-row contig names decoded run-wise: coordinate-sorted VCFs have
-    long same-contig runs, so one bytes-compare per row replaces a per-row
-    ``.decode()`` (the decode happens once per run)."""
+    """Per-row contig names decoded run-wise: the native
+    ``vcf_mark_contig_changes`` finds run boundaries in C (one memcmp per
+    row), so the Python side decodes ONE string per run and ``np.repeat``s
+    it — no per-row interpreter work on the streaming hot path. Falls back
+    to a per-row loop when the library is unavailable (callers on the
+    native path always have it)."""
     contigs = np.empty(rows, dtype=object)
+    if rows == 0:
+        return contigs
+    lib = vcf_library()
+    if lib is not None:
+        flags = np.empty(rows, dtype=np.int8)
+        lib.vcf_mark_contig_changes(text, contig_off, contig_len, rows, flags)
+        starts = np.flatnonzero(flags)
+        names = np.array(
+            [
+                text[contig_off[i] : contig_off[i] + contig_len[i]].decode(
+                    "utf-8"
+                )
+                for i in starts
+            ],
+            dtype=object,
+        )
+        reps = np.diff(np.append(starts, rows))
+        contigs[:] = np.repeat(names, reps)
+        return contigs
     current_bytes: bytes = b""
     current_str = ""
     for i in range(rows):

@@ -100,7 +100,7 @@ def _blocks_concat(blocks):
 def test_chunk_iterator_reassembles_exactly(tmp_path):
     path = _make_vcf(tmp_path, rows_per_contig=40)
     raw = open(path, "rb").read()
-    chunks = list(_iter_vcf_chunks(path, 1))  # clamps to the 4 KiB floor
+    chunks = list(_iter_vcf_chunks(path, 1))  # clamps to the 64-byte floor
     assert len(chunks) > 1
     assert b"".join(chunks) == raw
     for chunk in chunks[:-1]:
@@ -223,6 +223,50 @@ def test_header_only_callsets(tmp_path):
     callsets = source.search_callsets(source.set_ids)
     assert [c["name"] for c in callsets] == ["S000", "S001", "S002", "S003"]
     assert source._tables == {}  # no wire parse happened
+
+
+def test_gz_auto_threshold_accounts_for_compression(tmp_path, monkeypatch):
+    """The auto-streaming threshold is defined in DECOMPRESSED bytes: a
+    compressed .gz whose on-disk size is below the raw threshold but whose
+    expansion clearly is not must stream (the standard compressed 1000
+    Genomes distribution), while the same on-disk size uncompressed need
+    not."""
+    gz = _make_vcf(tmp_path, name="a.vcf", compress=True)
+    plain = _make_vcf(tmp_path, name="b.vcf", compress=False)
+    source = FileGenomicsSource([gz, plain])  # auto mode
+    fake = 20 << 20  # 20 MB on disk: > 128 MB decompressed only if .gz
+    monkeypatch.setattr(
+        "spark_examples_tpu.sources.files.os.path.getsize", lambda p: fake
+    )
+    assert source.wants_streaming(source.set_ids[0])  # .gz → ~200 MB text
+    assert not source.wants_streaming(source.set_ids[1])
+
+
+def test_headerless_vcf_keeps_working(tmp_path):
+    """A VCF with no #CHROM row (sites-only) still runs: header-only
+    callset discovery yields the empty cohort exactly like the wire parser,
+    instead of rejecting a file the data parse accepts."""
+    vcf = "17\t101\t.\tA\tG\t50\tPASS\tAF=0.5\n17\t205\t.\tT\tC\t50\tPASS\tAF=0.3\n"
+    path = tmp_path / "headerless.vcf"
+    path.write_text(vcf)
+    for chunk_bytes in (0, 1):  # in-memory and streamed
+        source = FileGenomicsSource([str(path)], stream_chunk_bytes=chunk_bytes)
+        assert source.search_callsets(source.set_ids) == []
+        contigs = source.get_contigs(source.set_ids[0])
+        # POS 205 (1-based) → start 204, end = 204 + len("T") = 205.
+        assert [(c.reference_name, c.end) for c in contigs] == [("17", 205)]
+
+
+def test_native_site_scan_rejects_short_lines_like_python(tmp_path):
+    """vcf_scan_sites must reject <8-field data lines exactly like the
+    Python fallback — contig discovery must not be environment-dependent."""
+    from spark_examples_tpu.utils import native as native_mod
+
+    if native_mod.vcf_library() is None:
+        pytest.skip("no native build")
+    short = b"17\t101\t.\tA\tG\n"
+    with pytest.raises(ValueError, match="data line #1"):
+        native_mod.scan_vcf_sites_chunk(short)
 
 
 def test_unsorted_vcf_fails_loudly_in_streaming_mode(tmp_path):
