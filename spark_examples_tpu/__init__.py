@@ -18,7 +18,8 @@ Package layout:
 
 - ``models``    — serializable Variant/Call/Read data models + builders
 - ``sharding``  — contig windows, split policies, partitioners
-- ``sources``   — genomics backends (synthetic, REST) + client counters
+- ``sources``   — genomics backends (synthetic, REST, local VCF/JSONL/SAM
+  files with bounded-memory streaming) + client counters
 - ``parallel``  — device mesh construction and the Spark-shuffle → XLA-collective mapping
 - ``ops``       — device compute: gramian, centering, pca, read depth
 - ``pipeline``  — datasets, stats, PCA driver, checkpointing
@@ -28,7 +29,7 @@ Package layout:
   center → pca), mirroring ``src/main/python/variants_pca.py:19-152``
 """
 
-__version__ = "0.2.0"
+__version__ = "0.5.0"
 
 from spark_examples_tpu.models.variant import Call, Variant, VariantKey, VariantsBuilder
 from spark_examples_tpu.models.read import Read, ReadKey, ReadBuilder

@@ -96,6 +96,19 @@ def test_center_matrix_exact_past_f32_range():
     assert not np.array_equal(centered, truncated)
 
 
+def test_package_version_matches_pyproject():
+    """__version__ and pyproject agree (it drifted once)."""
+    import os
+    import tomllib
+
+    import spark_examples_tpu
+
+    root = os.path.dirname(os.path.dirname(spark_examples_tpu.__file__))
+    with open(os.path.join(root, "pyproject.toml"), "rb") as f:
+        declared = tomllib.load(f)["project"]["version"]
+    assert spark_examples_tpu.__version__ == declared
+
+
 def test_api_pca_entrypoint():
     lines = api.pca(
         [
