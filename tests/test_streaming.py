@@ -440,3 +440,46 @@ def test_streamed_ingest_memory_is_bounded_by_chunk(tmp_path):
         f"(file is {file_bytes} bytes)"
     )
     assert peak < file_bytes // 2
+
+
+def test_cli_streamed_run_memory_is_bounded(tmp_path):
+    """The VERDICT-r4 'Done' criterion, literally: a VCF ingested THROUGH
+    ``variants-pca --source file`` with streaming on keeps peak traced host
+    memory far below the file size (tracemalloc sees every chunk buffer and
+    parse array; device buffers are O(N²), not O(file)). The wire path on
+    the same file allocates a multiple of the file size in Python records —
+    asserted as the contrast so the bound stays meaningful."""
+    path = _make_vcf(
+        tmp_path, n_samples=30, rows_per_contig=4000, contigs=("1", "2")
+    )
+    file_bytes = os.path.getsize(path)
+    assert file_bytes > 1_000_000
+    chunk = 1 << 16
+    argv = [
+        "--source", "file", "--input-files", path,
+        "--all-references",
+        "--block-size", "64",
+    ]
+
+    streamed_argv = argv + ["--stream-chunk-bytes", str(chunk)]
+    # Warm pass: jit tracing allocates ~20 MB of one-time Python objects
+    # that tracemalloc would otherwise attribute to the measured run; the
+    # second identical run reuses the compiled programs, so its peak is the
+    # parse memory this test is about.
+    pca_driver.run(streamed_argv)
+    tracemalloc.start()
+    tracemalloc.reset_peak()
+    streamed_lines = pca_driver.run(streamed_argv)
+    _, streamed_peak = tracemalloc.get_traced_memory()
+    tracemalloc.reset_peak()
+    wire_lines = pca_driver.run(
+        argv + ["--stream-chunk-bytes", "0", "--ingest", "wire"]
+    )
+    _, wire_peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+
+    assert streamed_lines == wire_lines
+    assert streamed_peak < file_bytes // 2, (
+        f"streamed CLI peak {streamed_peak} vs file {file_bytes}"
+    )
+    assert wire_peak > file_bytes  # the bound distinguishes the two paths
