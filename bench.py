@@ -28,6 +28,7 @@ Prints exactly one JSON line (driver stage prints are redirected to stderr).
 import argparse
 import contextlib
 import json
+import shutil
 import os
 import sys
 import time
@@ -110,6 +111,143 @@ CONFIGS = {
         "baseline_seconds": None,
     },
 }
+
+
+# ---------------------------------------------------------------- ingest bench
+# The file-ingest data plane (chunk-parallel native parse + prefetch +
+# double-buffered device feed) is benchmarked apart from the device configs:
+# it is host-side, deterministic, and the one stage the 2h/40-core baseline
+# was actually bound by (SURVEY.md §7 — ingest, not math).
+
+INGEST_FIXTURE_SAMPLES = 64
+INGEST_FIXTURE_ROWS = 40_000  # × ~3-400 B/row ≈ 12 MB decompressed
+
+
+def _write_ingest_fixture(path: str) -> None:
+    rng = np.random.default_rng(20_24)
+    gt_choices = np.array(["0|0", "0|1", "1|1", ".|."])
+    with open(path, "w") as f:
+        f.write("##fileformat=VCFv4.2\n")
+        f.write(
+            "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\t"
+            + "\t".join(f"S{i:03d}" for i in range(INGEST_FIXTURE_SAMPLES))
+            + "\n"
+        )
+        gts = gt_choices[
+            rng.integers(0, len(gt_choices),
+                         (INGEST_FIXTURE_ROWS, INGEST_FIXTURE_SAMPLES))
+        ]
+        for k in range(INGEST_FIXTURE_ROWS):
+            info = f"AF={rng.random():.4f}" if k % 4 else "NS=2"
+            f.write(
+                f"17\t{100 + 37 * k}\t.\tAC\tG\t.\t.\t{info}\tGT\t"
+                + "\t".join(gts[k])
+                + "\n"
+            )
+
+
+def _run_ingest_config(device) -> dict:
+    import tempfile
+
+    tmpdir = tempfile.mkdtemp(prefix="ingest_bench_")
+    try:
+        return _run_ingest_measurements(tmpdir, device)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _run_ingest_measurements(tmpdir: str, device) -> dict:
+    from spark_examples_tpu.ops.gramian import GramianAccumulator
+    from spark_examples_tpu.pipeline.datasets import PrefetchIterator
+    from spark_examples_tpu.sources.files import (
+        _PackedVcf,
+        _StreamedVcf,
+        default_ingest_workers,
+    )
+    from spark_examples_tpu.utils.native import native_unavailable_reason
+
+    path = os.path.join(tmpdir, "bench.vcf")
+    _write_ingest_fixture(path)
+    size_mb = os.path.getsize(path) / 1e6
+
+    # Parse throughput vs worker count, best of 2 (first run also pays the
+    # one-time native build; the repeat isolates steady-state parse).
+    counts = sorted({0, 1, 2, 4, default_ingest_workers()})
+    seconds = {}
+    for workers in counts:
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            view = _PackedVcf(path, "bench", ingest_workers=workers)
+            best = min(best, time.perf_counter() - t0)
+        seconds[workers] = best
+    native = view.native
+    per_worker = {
+        str(w): {
+            "seconds": round(s, 3),
+            "mb_per_s": round(size_mb / s, 1),
+            "speedup_vs_serial": round(seconds[0] / s, 2),
+        }
+        for w, s in seconds.items()
+    }
+
+    # Ingest/compute overlap: the streamed fixture through the bounded
+    # prefetch queue into the double-buffered Gramian feed — the exact
+    # driver wiring (pipeline/pca_driver.py:_similarity_stage), measured at
+    # the component seam so the numbers are profiler-free.
+    view = _StreamedVcf(
+        path, "bench", chunk_bytes=1 << 20,
+        ingest_workers=default_ingest_workers(),
+    )
+    acc = GramianAccumulator(
+        INGEST_FIXTURE_SAMPLES, block_size=2048, pipeline_depth=2
+    )
+    t0 = time.perf_counter()
+    prefetch = PrefetchIterator(
+        (hv for _, _, _, _, hv in view.iter_chunk_arrays()), depth=2
+    )
+    try:
+        for hv in prefetch:
+            acc.add_rows(hv)
+        wall = time.perf_counter() - t0
+        acc.finalize_device()
+    finally:
+        prefetch.close()
+    overlap = {
+        "wall_seconds": round(wall, 3),
+        "parse_busy_seconds": round(prefetch.producer_seconds, 3),
+        "parse_blocked_on_feed_seconds": round(
+            prefetch.producer_blocked_seconds, 3
+        ),
+        "feeder_waited_on_parse_seconds": round(
+            prefetch.consumer_wait_seconds, 3
+        ),
+        "blocks": prefetch.items,
+        "report": prefetch.overlap_report(),
+    }
+
+    best_workers = min(seconds, key=seconds.get)
+    return {
+        "metric": (
+            f"chunk-parallel native VCF parse ({size_mb:.1f} MB, "
+            f"{INGEST_FIXTURE_ROWS} rows × {INGEST_FIXTURE_SAMPLES} samples)"
+        ),
+        "value": per_worker[str(best_workers)]["mb_per_s"],
+        "unit": "MB/s",
+        "vs_baseline": per_worker[str(best_workers)]["speedup_vs_serial"],
+        "details": {
+            "native_parser": native,
+            "native_unavailable_reason": (
+                None if native else native_unavailable_reason()
+            ),
+            "host_cpus": os.cpu_count(),
+            "default_ingest_workers": default_ingest_workers(),
+            "parse_by_workers": per_worker,
+            "ingest_compute_overlap": overlap,
+            "baseline": "serial oracle path (--ingest-workers 0), same host",
+            "device": str(device),
+        },
+    }
 
 
 def _autosome_references() -> str:
@@ -250,7 +388,7 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--config",
-        choices=sorted(CONFIGS),
+        choices=sorted(CONFIGS) + ["ingest"],
         default=None,
         help=(
             "Run ONE benchmark config. Default: run ALL configs and print "
@@ -271,7 +409,11 @@ def main() -> None:
 
     if args.config is not None:
         with contextlib.redirect_stdout(sys.stderr):
-            payload = _run_config(args.config, device)
+            payload = (
+                _run_ingest_config(device)
+                if args.config == "ingest"
+                else _run_config(args.config, device)
+            )
         print(json.dumps(payload))
         return
 
@@ -302,6 +444,18 @@ def main() -> None:
             "compile_seconds_excluded": r["details"]["compile_seconds_excluded"],
         }
         for name, r in results.items()
+    }
+    # The host-side file-ingest data plane rides along: parse scaling by
+    # worker count + ingest/compute overlap (see _run_ingest_config).
+    with contextlib.redirect_stdout(sys.stderr):
+        ingest = _run_ingest_config(device)
+    payload["details"]["configs"]["ingest"] = {
+        "metric": ingest["metric"],
+        "value": ingest["value"],
+        "unit": ingest["unit"],
+        "vs_baseline": ingest["vs_baseline"],
+        "parse_by_workers": ingest["details"]["parse_by_workers"],
+        "ingest_compute_overlap": ingest["details"]["ingest_compute_overlap"],
     }
     print(json.dumps(payload))
 

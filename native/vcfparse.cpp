@@ -141,6 +141,27 @@ int64_t vcf_count_data_lines(const char* buf, int64_t len) {
     return n;
 }
 
+// Span variant of vcf_count_data_lines: counts within [buf+begin, buf+end_off)
+// — the per-chunk allocation bound of the chunk-parallel parse, which splits
+// ONE shared buffer into line-aligned spans instead of copying per-thread
+// slices.
+int64_t vcf_count_data_lines_span(const char* buf, int64_t begin,
+                                  int64_t end_off) {
+    const char* p = buf + begin;
+    const char* end = buf + end_off;
+    int64_t n = 0;
+    while (p < end) {
+        const char* line_end = static_cast<const char*>(
+            memchr(p, '\n', static_cast<size_t>(end - p)));
+        if (!line_end) line_end = end;
+        const char* stripped_end = line_end;
+        if (stripped_end > p && *(stripped_end - 1) == '\r') --stripped_end;
+        if (stripped_end > p && p[0] != '#') ++n;
+        p = next_line(p, end);
+    }
+    return n;
+}
+
 // Site-only scan: CHROM + [start, end) per data line, no INFO/GT walk — the
 // cheap streaming pass behind lazy contig discovery (contig bounds for
 // --all-references without paying the per-sample genotype parse). Arrays
@@ -199,18 +220,20 @@ void vcf_mark_contig_changes(const char* buf, const int64_t* off,
     }
 }
 
-// Parse all data lines. Arrays are caller-allocated with n_lines rows (from
-// vcf_scan): positions/ends int64, af double (NaN = absent),
-// has_variation int8 (n_lines * n_samples, row-major), contig_off/contig_len
-// int64 byte spans of the CHROM field within buf (Python decodes the
-// strings). Returns the number of parsed lines, or the negative (1-based)
-// line ordinal of the first malformed data line.
-int64_t vcf_parse(const char* buf, int64_t len, int64_t n_samples,
-                  int64_t* positions, int64_t* ends, double* af,
-                  int8_t* has_variation, int64_t* contig_off,
-                  int64_t* contig_len) {
-    const char* p = buf;
-    const char* end = buf + len;
+}  // extern "C"
+
+namespace {
+
+// Shared data-line parse core over [p, end): `base` anchors the emitted
+// contig_off byte offsets (== p for a whole-buffer parse; the buffer start
+// for a span parse, so every worker's offsets index ONE shared text and the
+// host-side contig decode needs no per-span translation). Runs with the GIL
+// released (ctypes CDLL), so concurrent span parses scale across cores.
+int64_t parse_data_lines(const char* base, const char* p, const char* end,
+                         int64_t n_samples, int64_t* positions, int64_t* ends,
+                         double* af, int8_t* has_variation,
+                         int64_t* contig_off, int64_t* contig_len) {
+    const char* buf = base;
     int64_t row = 0;
     int64_t ordinal = 0;
     while (p < end) {
@@ -342,6 +365,39 @@ int64_t vcf_parse(const char* buf, int64_t len, int64_t n_samples,
         p = next_line(p, end);
     }
     return row;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse all data lines. Arrays are caller-allocated with n_lines rows (from
+// vcf_scan): positions/ends int64, af double (NaN = absent),
+// has_variation int8 (n_lines * n_samples, row-major), contig_off/contig_len
+// int64 byte spans of the CHROM field within buf (Python decodes the
+// strings). Returns the number of parsed lines, or the negative (1-based)
+// line ordinal of the first malformed data line.
+int64_t vcf_parse(const char* buf, int64_t len, int64_t n_samples,
+                  int64_t* positions, int64_t* ends, double* af,
+                  int8_t* has_variation, int64_t* contig_off,
+                  int64_t* contig_len) {
+    return parse_data_lines(buf, buf, buf + len, n_samples, positions, ends,
+                            af, has_variation, contig_off, contig_len);
+}
+
+// Chunk-span entry point of the SAME core: parse the data lines of
+// [buf+begin, buf+end_off) — a line-aligned span of one shared buffer. The
+// chunk-parallel ingest engine calls this from a thread pool (the ctypes
+// call releases the GIL), one span per worker, zero per-span copies;
+// contig_off stays absolute into buf. The negative malformed-line ordinal
+// is 1-based WITHIN the span.
+int64_t vcf_parse_span(const char* buf, int64_t begin, int64_t end_off,
+                       int64_t n_samples, int64_t* positions, int64_t* ends,
+                       double* af, int8_t* has_variation, int64_t* contig_off,
+                       int64_t* contig_len) {
+    return parse_data_lines(buf, buf + begin, buf + end_off, n_samples,
+                            positions, ends, af, has_variation, contig_off,
+                            contig_len);
 }
 
 }  // extern "C"

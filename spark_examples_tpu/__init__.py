@@ -31,6 +31,12 @@ Package layout:
 
 __version__ = "0.5.0"
 
+# jax-version shims (shard_map location, jax.enable_x64) — imported first so
+# every submodule and test sees one resolved API surface. jax itself is
+# already resident in this image (the sitecustomize PJRT hook imports it at
+# interpreter start), so this adds no import weight.
+from spark_examples_tpu.utils import compat as _compat  # noqa: F401
+
 from spark_examples_tpu.models.variant import Call, Variant, VariantKey, VariantsBuilder
 from spark_examples_tpu.models.read import Read, ReadKey, ReadBuilder
 from spark_examples_tpu.sharding.contig import Contig, SexChromosomeFilter

@@ -7,6 +7,14 @@ with the reference flag grammar (``GenomicsConf.scala:29-98``):
     python -m spark_examples_tpu search-variants-klotho
     python -m spark_examples_tpu search-variants-brca1
     python -m spark_examples_tpu search-reads-example-1 .. -4
+
+File-backed runs (``--source file``) parse VCF inputs through the
+chunk-parallel native ingest engine; ``--ingest-workers N`` sizes its thread
+pool (default min(8, cpu_count); ``0`` = the serial oracle path, identical
+output):
+
+    python -m spark_examples_tpu variants-pca --source file \\
+        --input-files cohort.vcf.gz --ingest-workers 8
 """
 
 from __future__ import annotations

@@ -115,6 +115,19 @@ def _build_base_parser(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
         ),
     )
     parser.add_argument(
+        "--ingest-workers",
+        type=int,
+        default=None,
+        help=(
+            "Parse threads for the chunk-parallel file ingest engine "
+            "(--source file VCF inputs): the decompressed text is split "
+            "into line-aligned chunks parsed concurrently through the "
+            "GIL-releasing native parser, with an order-preserving merge. "
+            "Default: min(8, cpu_count). 0 = the serial oracle path "
+            "(byte-identical output, kept as the parity reference)."
+        ),
+    )
+    parser.add_argument(
         "--num-samples",
         type=_num_samples_value,
         default="2504",
@@ -157,6 +170,7 @@ class GenomicsConf:
     source: str = "synthetic"
     input_files: Optional[List[str]] = None
     stream_chunk_bytes: Optional[int] = None
+    ingest_workers: Optional[int] = None
     num_samples: int = 2504
     num_samples_per_set: Optional[List[int]] = None
     seed: int = 42
@@ -203,6 +217,21 @@ class GenomicsConf:
                 raise ValueError("--num-samples needs at least one value")
             conf.num_samples = sizes[0]
             conf.num_samples_per_set = sizes if len(sizes) > 1 else None
+        if conf.ingest_workers is not None and conf.ingest_workers < 0:
+            raise ValueError(
+                f"--ingest-workers must be >= 0 (0 = serial oracle path), "
+                f"got {conf.ingest_workers}"
+            )
+        # --blocks-per-dispatch is PcaConf-only; validated here so every
+        # parse path shares it. An explicit value must be positive: 0 is not
+        # a documented auto spelling (leave the flag unset for auto), and
+        # treating it as falsy-auto silently ignored the user's input.
+        bpd = getattr(conf, "blocks_per_dispatch", None)
+        if bpd is not None and bpd <= 0:
+            raise ValueError(
+                f"--blocks-per-dispatch must be a positive dispatch-group "
+                f"length, got {bpd} (omit the flag for the auto rule)"
+            )
         if conf.num_samples_per_set:
             if conf.source != "synthetic":
                 # Cohort sizing only exists for the synthetic source; files

@@ -11,8 +11,9 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_examples_tpu.utils.compat import shard_map
 
 from spark_examples_tpu.parallel.mesh import SAMPLES_AXIS
 

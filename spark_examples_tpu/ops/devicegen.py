@@ -42,6 +42,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from spark_examples_tpu.parallel.mesh import device_put_global
+
 # splitmix64 constants — must match sources/synthetic.py exactly.
 _P1 = 0x9E3779B97F4A7C15
 _P2 = 0xC2B2AE3D27D4EB4F
@@ -455,7 +457,7 @@ def _fused_update_mesh(
     """The data-parallel (shard_map) wrapper of :func:`_fused_update`,
     memoized on (config, mesh) so warmup and measured accumulators share one
     traced/compiled program, like the single-slice path."""
-    from jax import shard_map
+    from spark_examples_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from spark_examples_tpu.parallel.mesh import DATA_AXIS
@@ -541,8 +543,8 @@ class _GridDispatchAccumulator:
                 self.G,
                 self.variant_rows,
                 self.kept_sites,
-                jax.device_put(grid_offsets, self._scalar_sharding),
-                jax.device_put(n_valids, self._scalar_sharding),
+                device_put_global(grid_offsets, self._scalar_sharding),
+                device_put_global(n_valids, self._scalar_sharding),
             )
         self.dispatches += 1
 
@@ -781,18 +783,18 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
                 r_spec = P(DATA_AXIS, None)
                 s_spec = P(DATA_AXIS)
                 self._scalar_sharding = NamedSharding(mesh, s_spec)
-                self.G = jax.device_put(
+                self.G = device_put_global(
                     np.zeros(
                         (D, self.total_columns, self.total_columns),
                         np.dtype(accum_dtype),
                     ),
                     NamedSharding(mesh, g_spec),
                 )
-                self.variant_rows = jax.device_put(
+                self.variant_rows = device_put_global(
                     np.zeros((D, self.n_sets), np.int64),
                     NamedSharding(mesh, r_spec),
                 )
-                self.kept_sites = jax.device_put(
+                self.kept_sites = device_put_global(
                     np.zeros((D,), np.int64), NamedSharding(mesh, s_spec)
                 )
                 self._update = _fused_update_mesh(*update_key, mesh)
@@ -925,7 +927,7 @@ def _ring_update(
     makes the column space a multi-set concatenation
     (:func:`generate_column_block`); ``variant_rows`` is then per set —
     a row counts for set s when ANY of set s's columns vary."""
-    from jax import shard_map
+    from spark_examples_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from spark_examples_tpu.ops.gramian import _ring_tiles
@@ -1136,14 +1138,14 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
         self._scalar_sharding = NamedSharding(mesh, P(data_axis))
 
         with jax.enable_x64(True):
-            self.G = jax.device_put(
+            self.G = device_put_global(
                 np.zeros((D, self.padded, self.padded), np.dtype(accum_dtype)),
                 NamedSharding(mesh, g_spec),
             )
-            self.kept_sites = jax.device_put(
+            self.kept_sites = device_put_global(
                 np.zeros((D,), np.int64), self._scalar_sharding
             )
-            self.variant_rows = jax.device_put(
+            self.variant_rows = device_put_global(
                 np.zeros((D, self.n_sets), np.int64),
                 NamedSharding(mesh, P(data_axis, None)),
             )

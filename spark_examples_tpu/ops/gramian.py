@@ -41,10 +41,15 @@ import jax.numpy as jnp
 import ml_dtypes
 import numpy as np
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_examples_tpu.parallel.mesh import DATA_AXIS, SAMPLES_AXIS
+from spark_examples_tpu.utils.compat import axis_size, shard_map
+
+from spark_examples_tpu.parallel.mesh import (
+    DATA_AXIS,
+    SAMPLES_AXIS,
+    device_put_global,
+)
 
 
 def _operand_dtypes(exact_int: bool, mesh: Optional[Mesh] = None):
@@ -211,6 +216,7 @@ class GramianAccumulator:
         block_size: int = 1024,
         exact_int: bool = False,
         sync_every: int = 1,
+        pipeline_depth: Optional[int] = None,
     ):
         self.num_samples = int(num_samples)
         self.mesh = mesh
@@ -221,8 +227,20 @@ class GramianAccumulator:
         self.data_parallel = mesh.shape[DATA_AXIS] if mesh is not None else 1
         # Bound the async dispatch queue: an unboundedly deep chain of
         # in-flight updates degrades sustained throughput ~30× on
-        # remote-attached backends (measured). Block on G every few flushes.
+        # remote-attached backends (measured). Two policies:
+        # - sync_every (legacy): block on the CURRENT G every few flushes —
+        #   zero host/device overlap at the default of 1;
+        # - pipeline_depth d: block on the G from d flushes AGO, so up to d
+        #   updates stay in flight and flush k+1's pack + device_put overlap
+        #   flush k's matmul — the double-buffered device feed of the
+        #   chunk-parallel ingest engine (d=2 is classic double buffering).
+        #   Updates do NOT donate G (see _dense_update), so holding the
+        #   older references is safe.
         self.sync_every = max(1, int(sync_every))
+        self.pipeline_depth = (
+            None if pipeline_depth is None else max(1, int(pipeline_depth))
+        )
+        self._in_flight: list = []
         self._flushes = 0
 
         rows = self.data_parallel * self.block_size
@@ -234,7 +252,7 @@ class GramianAccumulator:
         if mesh is not None:
             self._g_sharding = NamedSharding(mesh, P(DATA_AXIS, None, None))
             self._x_sharding = NamedSharding(mesh, P(DATA_AXIS, None, None))
-            self.G = jax.device_put(
+            self.G = device_put_global(
                 np.zeros(g_shape, dtype=np.dtype(self.accum_dtype)), self._g_sharding
             )
         else:
@@ -280,9 +298,18 @@ class GramianAccumulator:
         )
         if max_count > 1:
             # Count-valued rows (same-set joins) can't be bit-packed; ship
-            # them unpacked through the counts kernel.
+            # them unpacked through the counts kernel. Under pipeline_depth
+            # the flush returns with the dispatch still in flight, and a
+            # full-block `shaped` is a VIEW of the reused _staging buffer —
+            # which jnp.asarray/device_put may alias zero-copy on the CPU
+            # backend — so the next add_rows would overwrite an in-flight
+            # operand; copy before shipping. (The bit-packed branch is safe:
+            # np.packbits allocates fresh. The legacy sync-per-flush path is
+            # safe: nothing is in flight when add_rows resumes.)
+            if self.pipeline_depth is not None and block is self._staging:
+                shaped = shaped.copy()
             Xd = (
-                jax.device_put(shaped, self._x_sharding)
+                device_put_global(shaped, self._x_sharding)
                 if self._x_sharding is not None
                 else jnp.asarray(shaped)
             )
@@ -290,7 +317,7 @@ class GramianAccumulator:
         else:
             X = np.packbits(shaped, axis=-1)
             Xd = (
-                jax.device_put(X, self._x_sharding)
+                device_put_global(X, self._x_sharding)
                 if self._x_sharding is not None
                 else jnp.asarray(X)
             )
@@ -299,7 +326,14 @@ class GramianAccumulator:
             )
         self._fill = 0
         self._flushes += 1
-        if self._flushes % self.sync_every == 0:
+        if self.pipeline_depth is not None:
+            # Double-buffered feed: wait only for the update issued
+            # `pipeline_depth` flushes ago, leaving the most recent
+            # transfers/dispatches in flight behind this block's compute.
+            self._in_flight.append(self.G)
+            if len(self._in_flight) > self.pipeline_depth:
+                jax.block_until_ready(self._in_flight.pop(0))
+        elif self._flushes % self.sync_every == 0:
             jax.block_until_ready(self.G)
 
     def finalize_device(self) -> jax.Array:
@@ -309,6 +343,7 @@ class GramianAccumulator:
         on remote-attached backends, poisons subsequent dispatch throughput
         (any device_get degrades later host→device traffic ~50×, measured)."""
         self._flush()
+        self._in_flight.clear()  # release held buffers from the pipeline
         return data_axis_sum(self.G)
 
     def finalize(self) -> np.ndarray:
@@ -325,7 +360,7 @@ def _ring_tiles(G_local, X_cols, samples_axis: str, operand_dtype):
     cast to the MXU operand dtype per step. Each of the D steps computes one
     (N_local, N_local) output tile while the next column block is in flight.
     """
-    D = lax.axis_size(samples_axis)
+    D = axis_size(samples_axis)
     i = lax.axis_index(samples_axis)
     n_local = X_cols.shape[1]
     x_mine_t = X_cols.astype(operand_dtype).T  # (N_local, B)
@@ -399,7 +434,7 @@ class ShardedGramianAccumulator:
         x_spec = P(data_axis, None, SAMPLES_AXIS)
         self._g_sharding = NamedSharding(mesh, g_spec)
         self._x_sharding = NamedSharding(mesh, x_spec)
-        self.G = jax.device_put(
+        self.G = device_put_global(
             jnp.zeros(
                 (self.data_parallel, self._padded, self._padded), self.accum_dtype
             ),
@@ -464,7 +499,7 @@ class ShardedGramianAccumulator:
             self._update = self._build_update(self.operand_dtype)
         self._entry_bound = next_bound
         X = block.reshape(self.data_parallel, self.block_size, self._padded)
-        self.G = self._update(self.G, jax.device_put(X, self._x_sharding))
+        self.G = self._update(self.G, device_put_global(X, self._x_sharding))
         self._fill = 0
         self._flushes += 1
         if self._flushes % self.sync_every == 0:

@@ -121,7 +121,7 @@ def principal_components_subspace_sharded(
     (all-zero after :func:`gower_center_sharded` with ``n_true``) contribute
     nothing and the returned components simply carry zero rows for padding.
     """
-    from jax import shard_map
+    from spark_examples_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from spark_examples_tpu.parallel.mesh import SAMPLES_AXIS

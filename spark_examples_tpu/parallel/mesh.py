@@ -89,6 +89,17 @@ def distributed_init(
     given = (coordinator_address, num_processes, process_id)
     if all(v is None for v in given):
         return
+    # The CPU backend runs cross-process collectives only through an
+    # explicit collectives implementation; without this the first
+    # multi-process dispatch dies with "Multiprocess computations aren't
+    # implemented on the CPU backend". TPU/GPU ignore the flag, and it must
+    # land before the backend client exists — i.e. here, alongside the
+    # rest of distributed init. Best-effort: ancient jaxlibs without the
+    # flag keep their previous behavior.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     if coordinator_address is None or num_processes is None:
         # A partially-specified cluster launch must not silently fall back
         # to a single-process run over 1/N of the fleet.
@@ -150,12 +161,29 @@ def _packed_fetch_jit(mesh: Optional[Mesh]):
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec
 
-    def pack(*arrays):
-        return jnp.concatenate([a.reshape(-1) for a in arrays])
-
     if mesh is None:
-        return jax.jit(pack)
-    return jax.jit(pack, out_shardings=NamedSharding(mesh, PartitionSpec()))
+        return jax.jit(
+            lambda *arrays: jnp.concatenate([a.reshape(-1) for a in arrays])
+        )
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def pack(*arrays):
+        # Replicate EACH operand before the concatenate, not just the
+        # output: lowering `concatenate(sharded...)` straight into a
+        # replicated output makes the SPMD partitioner reshard via a
+        # masked sum, and operands that are replicated along an unmentioned
+        # mesh axis (e.g. P('data') counters on a data×samples mesh) get
+        # every replica summed in — the fetched counters came back
+        # multiplied by the samples-axis size. Per-operand replication
+        # lowers to plain all-gathers, after which the concat is local.
+        return jnp.concatenate(
+            [
+                jax.lax.with_sharding_constraint(a.reshape(-1), replicated)
+                for a in arrays
+            ]
+        )
+
+    return jax.jit(pack, out_shardings=replicated)
 
 
 def packed_host_fetch(arrays, mesh: Optional[Mesh] = None) -> np.ndarray:
@@ -173,6 +201,28 @@ def packed_host_fetch(arrays, mesh: Optional[Mesh] = None) -> np.ndarray:
     """
     with jax.enable_x64(True):
         return np.asarray(host_value(_packed_fetch_jit(mesh)(*arrays)))
+
+
+def device_put_global(x, sharding):
+    """``jax.device_put`` that stays valid when ``sharding`` spans
+    non-addressable devices (multi-controller runs).
+
+    This jax's ``device_put`` of a host array onto a non-addressable
+    sharding first runs ``multihost_utils.assert_equal`` — a REAL collective
+    that (a) costs a cross-process round trip per call and (b) is
+    unimplemented on the CPU backend, so the multihost rehearsal
+    (``parallel/multihost.py``) crashed before ever dispatching. The ingest
+    paths are SPMD by construction — every process computes identical host
+    operands — so the equality collective buys nothing:
+    ``make_array_from_callback`` assembles the global array from each
+    process's local copy directly. Fully-addressable shardings (and bare
+    devices / None) keep the plain fast path."""
+    if sharding is None or getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(x, sharding)
+    x = np.asarray(x)
+    return jax.make_array_from_callback(
+        x.shape, sharding, lambda idx: x[idx]
+    )
 
 
 def local_shard(x) -> np.ndarray:

@@ -17,6 +17,9 @@ normalization drop), stats accounting, and a prefetching parallel iterator.
 from __future__ import annotations
 
 import concurrent.futures
+import queue
+import threading
+import time
 from typing import Callable, Iterator, List, Optional, Sequence, Tuple, TypeVar
 
 from spark_examples_tpu.models.read import Read, ReadBuilder, ReadKey
@@ -62,6 +65,118 @@ def _parallel_shards(
                 futures[next_submit] = pool.submit(compute, partitions[next_submit])
                 next_submit += 1
             yield part, futures.pop(i).result()
+
+
+class PrefetchIterator:
+    """Bounded background-thread prefetch of an iterator — the hand-off
+    between the chunk-parallel parse engine (producer) and the device feeder
+    (consumer), so the host keeps parsing block *k+1* while block *k*'s
+    ``device_put`` + Gramian dispatch are in flight.
+
+    Backpressure is a hard bound: the queue holds at most ``depth`` items
+    (plus the one the producer is computing), so a slow device feeder stalls
+    the parse instead of letting parsed blocks pile up in host memory.
+    Exceptions from the source iterator re-raise at the consuming position.
+    Overlap accounting (``producer_seconds``, ``producer_blocked_seconds``,
+    ``consumer_wait_seconds``) feeds the ingest-overlap report in
+    ``bench.py`` and ``--profile-dir`` stage timings: producer-blocked time
+    means the device is the bottleneck, consumer-wait time means parse is.
+    """
+
+    _DONE = object()
+
+    def __init__(self, iterable, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.depth = int(depth)
+        self._queue: queue.Queue = queue.Queue(maxsize=self.depth)
+        self._error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self.producer_seconds = 0.0
+        self.producer_blocked_seconds = 0.0
+        self.consumer_wait_seconds = 0.0
+        self.items = 0
+        self._thread = threading.Thread(
+            target=self._run, args=(iter(iterable),), daemon=True
+        )
+        self._thread.start()
+
+    def _run(self, it) -> None:
+        try:
+            while not self._stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
+                t1 = time.perf_counter()
+                self.producer_seconds += t1 - t0
+                self._put(item)
+                self.producer_blocked_seconds += time.perf_counter() - t1
+        except BaseException as e:  # surfaced from __next__
+            self._error = e
+        finally:
+            # close() may have filled the queue already; drop the sentinel
+            # rather than deadlock on a full queue nobody will drain.
+            try:
+                self._queue.put_nowait(self._DONE)
+            except queue.Full:
+                pass
+
+    def _put(self, item) -> None:
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def __iter__(self) -> "PrefetchIterator":
+        return self
+
+    def __next__(self):
+        t0 = time.perf_counter()
+        while True:
+            try:
+                item = self._queue.get(timeout=0.1)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    # The producer exited — possibly AFTER our get() timed
+                    # out but BEFORE this liveness check, with its last
+                    # item (or the sentinel) now sitting in the queue.
+                    # Thread termination happens-after its final put, so
+                    # one non-blocking drain here sees everything; only a
+                    # truly empty queue means the stream really ended
+                    # (otherwise the final genotype block would be
+                    # silently dropped — a truncated Gramian).
+                    try:
+                        item = self._queue.get_nowait()
+                    except queue.Empty:
+                        item = self._DONE
+                    break
+        self.consumer_wait_seconds += time.perf_counter() - t0
+        if item is self._DONE:
+            if self._error is not None:
+                error, self._error = self._error, None
+                raise error
+            raise StopIteration
+        self.items += 1
+        return item
+
+    def close(self) -> None:
+        """Stop the producer and release its thread (idempotent)."""
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def overlap_report(self) -> str:
+        """One line of ingest/compute overlap accounting."""
+        return (
+            f"ingest overlap: parse {self.producer_seconds:.3f}s busy, "
+            f"{self.producer_blocked_seconds:.3f}s blocked on device feed "
+            f"(backpressure); feeder waited {self.consumer_wait_seconds:.3f}s "
+            f"on parse; {self.items} blocks through a depth-{self.depth} queue"
+        )
 
 
 class VariantsDataset:
@@ -158,4 +273,4 @@ class ReadsDataset:
             yield read
 
 
-__all__ = ["VariantsDataset", "ReadsDataset"]
+__all__ = ["PrefetchIterator", "VariantsDataset", "ReadsDataset"]

@@ -135,6 +135,7 @@ def make_source(conf: PcaConf) -> GenomicsSource:
         return FileGenomicsSource(
             conf.input_files or [],
             stream_chunk_bytes=getattr(conf, "stream_chunk_bytes", None),
+            ingest_workers=getattr(conf, "ingest_workers", None),
         )
     from spark_examples_tpu.sources.base import get_access_token
     from spark_examples_tpu.sources.rest import RestGenomicsSource
@@ -406,9 +407,18 @@ class VariantsPcaDriver:
         return acc.finalize_sharded()
 
     def get_similarity_rows(
-        self, blocks: Iterable[np.ndarray], sharded: Optional[bool] = None
+        self,
+        blocks: Iterable[np.ndarray],
+        sharded: Optional[bool] = None,
+        pipeline_depth: Optional[int] = None,
     ) -> np.ndarray:
-        """Packed fast path: feed dense uint8 row blocks directly."""
+        """Packed fast path: feed dense uint8 row blocks directly.
+
+        ``pipeline_depth`` (dense accumulator only) keeps that many flushed
+        device updates in flight instead of syncing per flush — the
+        double-buffered feed that overlaps block *k+1*'s host pack +
+        ``device_put`` with block *k*'s Gramian dispatch
+        (``ops/gramian.py``)."""
         n = len(self.indexes)
         if self.conf.pca_backend == "host":
             # Host oracle on the packed rows (same result surface as
@@ -427,7 +437,11 @@ class VariantsPcaDriver:
             )
         else:
             acc = GramianAccumulator(
-                n, mesh, block_size=self.conf.block_size, exact_int=exact
+                n,
+                mesh,
+                block_size=self.conf.block_size,
+                exact_int=exact,
+                pipeline_depth=pipeline_depth,
             )
         for block in blocks:
             acc.add_rows(block)
@@ -459,8 +473,13 @@ class VariantsPcaDriver:
         mesh = self._make_mesh()
         # Dispatch-group length: explicit flag, or constant-work auto rule
         # (small cohorts get longer scans — per-dispatch overhead is fixed).
-        blocks_per_dispatch = conf.blocks_per_dispatch or auto_blocks_per_dispatch(
-            len(self.indexes), conf.block_size
+        # `is None`, not falsy-or: config validation rejects non-positive
+        # explicit values, and a falsy test would silently remap them to
+        # auto if that gate were ever bypassed.
+        blocks_per_dispatch = (
+            conf.blocks_per_dispatch
+            if conf.blocks_per_dispatch is not None
+            else auto_blocks_per_dispatch(len(self.indexes), conf.block_size)
         )
         use_ring = self._resolve_sharded(None, mesh)
         if use_ring and len(conf.variant_set_id) > 1:
@@ -877,8 +896,39 @@ def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
         return driver.get_similarity_device_gen(contigs)
     if use_packed:
         # Packed fast path: dense genotype blocks straight onto the device
-        # — synthetic generation, or VCF arrays from the native parser
-        # (``sources/files.py``; pure-Python fallback, identical output).
+        # — synthetic generation, or VCF arrays from the chunk-parallel
+        # native parser (``sources/files.py``; pure-Python fallback,
+        # identical output). With ingest workers enabled, the block stream
+        # rides a bounded prefetch queue (parse runs ahead of the feeder)
+        # and the dense accumulator double-buffers its device feed
+        # (``pipeline_depth=2``): parse, H2D transfer, and Gramian dispatch
+        # of consecutive blocks overlap instead of serializing.
+        from spark_examples_tpu.pipeline.datasets import PrefetchIterator
+        from spark_examples_tpu.sources.files import _resolve_ingest_workers
+
+        # The ONE resolution of --ingest-workers (None→default, 0=serial),
+        # shared with the parse pool inside FileGenomicsSource — the
+        # prefetch/double-buffer decision must not drift from it.
+        ingest_workers = _resolve_ingest_workers(conf.ingest_workers)
+        pipeline_depth = 2 if ingest_workers > 0 else None
+
+        def feed_rows(row_stream):
+            """Run the row stream through the prefetch queue (when enabled)
+            and the double-buffered accumulator; report ingest overlap
+            under --profile-dir."""
+            prefetch = None
+            if ingest_workers > 0:
+                row_stream = prefetch = PrefetchIterator(row_stream, depth=2)
+            try:
+                return driver.get_similarity_rows(
+                    row_stream, pipeline_depth=pipeline_depth
+                )
+            finally:
+                if prefetch is not None:
+                    prefetch.close()
+                    if conf.profile_dir:
+                        print(prefetch.overlap_report())
+
         source = driver.source
         synthetic = isinstance(source, SyntheticGenomicsSource)
         contigs = conf.get_contigs(source, conf.variant_set_id)
@@ -909,7 +959,7 @@ def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
                 ):
                     yield block["has_variation"]
 
-            similarity = driver.get_similarity_rows(streamed_rows())
+            similarity = feed_rows(streamed_rows())
             # get_similarity_rows consumed the stream; the counters are
             # complete. Partition/request accounting matches the per-shard
             # path: every shard contributes its range and ≥1 page.
@@ -953,7 +1003,7 @@ def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
                 for block in blocks:
                     yield block["has_variation"]
 
-        return driver.get_similarity_rows(block_stream())
+        return feed_rows(block_stream())
     data = driver.get_data()
     calls = driver.iter_calls(data)
     return driver.get_similarity_matrix(calls)

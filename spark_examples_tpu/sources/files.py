@@ -28,12 +28,14 @@ sanitized stem — e.g. ``/data/chr17.vcf.gz`` → ``chr17`` — with callset id
 
 from __future__ import annotations
 
+import concurrent.futures
 import gzip
 import json
 import os
 import re
 import threading
 from bisect import bisect_left, bisect_right
+from collections import deque
 
 import numpy as np
 from typing import Dict, Iterator, List, Mapping, Optional, Sequence, Tuple
@@ -125,6 +127,81 @@ def _open_text(path: str):
     return gzip.open(path, "rt") if path.endswith(".gz") else open(path, "rt")
 
 
+def default_ingest_workers() -> int:
+    """Default parse worker count for the chunk-parallel ingest engine:
+    ``min(8, cpu_count)`` — past ~8 threads the native parser is host
+    memory-bandwidth-bound, and tiny containers should not oversubscribe."""
+    return max(1, min(8, os.cpu_count() or 1))
+
+
+def _resolve_ingest_workers(ingest_workers: Optional[int]) -> int:
+    """``None`` = auto (:func:`default_ingest_workers`), ``0`` = the serial
+    oracle path, ``N >= 1`` = exactly N parse threads."""
+    if ingest_workers is None:
+        return default_ingest_workers()
+    workers = int(ingest_workers)
+    if workers < 0:
+        raise ValueError(f"ingest workers must be >= 0, got {workers}")
+    return workers
+
+
+def _ordered_pool_map(fn, items, workers: int, window: Optional[int] = None):
+    """Map ``fn`` over ``items`` on a thread pool, yielding results in INPUT
+    order with a bounded in-flight window — the order-preserving merge of the
+    chunk-parallel ingest engine.
+
+    Backpressure is structural: at most ``window`` results exist at once
+    (pending futures + the one being yielded), and the source iterator is
+    only advanced when a slot frees, so a slow consumer bounds both the pool
+    queue AND how far a streaming reader runs ahead. ``workers <= 1``
+    degrades to the serial loop (the oracle path — no pool, no reordering
+    risk, bitwise-identical by construction). Exceptions surface at the
+    failed item's position in the output order.
+    """
+    if workers <= 1:
+        for item in items:
+            yield fn(item)
+        return
+    window = int(window or workers + 2)
+    pending: deque = deque()
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=workers)
+    try:
+        for item in items:
+            pending.append(pool.submit(fn, item))
+            if len(pending) >= window:
+                yield pending.popleft().result()
+        while pending:
+            yield pending.popleft().result()
+    finally:
+        for future in pending:
+            future.cancel()
+        pool.shutdown(wait=True)
+
+
+def _line_aligned_spans(
+    text: bytes, n_spans: int
+) -> List[Tuple[int, int]]:
+    """Split ``[0, len(text))`` into at most ``n_spans`` contiguous spans
+    whose boundaries sit just past a ``'\\n'`` — the unit of work of the
+    chunk-parallel parse. Concatenating the spans reproduces the buffer
+    exactly; a final unterminated line stays whole in the last span."""
+    size = len(text)
+    if size == 0:
+        return []
+    n_spans = max(1, int(n_spans))
+    target = -(-size // n_spans)
+    spans: List[Tuple[int, int]] = []
+    begin = 0
+    while begin < size:
+        cut = min(begin + target, size)
+        if cut < size:
+            nl = text.find(b"\n", cut - 1)
+            cut = size if nl < 0 else nl + 1
+        spans.append((begin, cut))
+        begin = cut
+    return spans
+
+
 def _parse_vcf_info(text: str) -> Dict[str, List[str]]:
     """``AF=0.02,0.1;DB;NS=60`` → ``{"AF": ["0.02", "0.1"], "DB": [], ...}``."""
     info: Dict[str, List[str]] = {}
@@ -211,11 +288,15 @@ def _parse_vcf(path: str, set_id: str):
             line = line.rstrip("\n")
             if not line:
                 continue
-            if line.startswith("##"):
-                continue
-            if line.startswith("#CHROM"):
-                columns = line.split("\t")
-                samples = columns[9:] if len(columns) > 9 else []
+            if line.startswith("#"):
+                # '##' meta lines, the '#CHROM' column row, and any other
+                # '#'-prefixed comment line are all header noise, never
+                # data — matching the native parser (vcfparse.cpp skips
+                # every '#' line), so the wire oracle and the packed paths
+                # agree on comment-bearing files.
+                if line.startswith("#CHROM"):
+                    columns = line.split("\t")
+                    samples = columns[9:] if len(columns) > 9 else []
                 continue
             chrom, start, record = _vcf_line_record(line, path, set_id, samples)
             by_contig.setdefault(chrom, []).append((start, record))
@@ -473,13 +554,68 @@ def _python_vcf_arrays(path: str, set_id: str):
     )
 
 
+def _native_parallel_vcf_arrays(text: bytes, workers: int):
+    """Chunk-parallel native parse of one decompressed VCF buffer: split into
+    line-aligned spans, parse spans concurrently through the GIL-releasing
+    C-ABI parser (``utils/native.py:parse_vcf_span``), and reassemble the
+    per-span arrays in file order. Byte-identical to the serial
+    ``parse_vcf_arrays`` by construction: the cohort comes from the same
+    whole-buffer ``vcf_scan``, every span runs the same per-line core, and
+    concatenation in span order IS file order. ``None`` when the native
+    library is unavailable."""
+    from spark_examples_tpu.utils.native import (
+        parse_vcf_span,
+        scan_vcf_counts,
+    )
+
+    from spark_examples_tpu.utils.native import MalformedVcfLine
+
+    counts = scan_vcf_counts(text)
+    if counts is None:
+        return None
+    _, n_samples = counts
+    # More spans than workers so a comment/header-dense span cannot straggle
+    # the whole pool; spans stay multi-MB for real inputs.
+    spans = _line_aligned_spans(text, workers * 4)
+    if not spans:
+        from spark_examples_tpu.utils.native import parse_vcf_arrays
+
+        return parse_vcf_arrays(text)
+    parts = []
+    rows_before = 0
+    try:
+        for arrays in _ordered_pool_map(
+            lambda span: parse_vcf_span(text, span[0], span[1], n_samples),
+            spans,
+            workers,
+        ):
+            if arrays is None:  # library vanished mid-flight
+                return None
+            parts.append(arrays)
+            rows_before += len(arrays[1])
+    except MalformedVcfLine as e:
+        # Results merge in span order, so every span BEFORE the failing one
+        # has already been counted — the span-relative ordinal translates
+        # to the file-level data-line number the serial parse reports.
+        raise MalformedVcfLine(rows_before + e.ordinal) from None
+    return tuple(
+        np.concatenate([part[i] for part in parts]) for i in range(5)
+    )
+
+
 class _PackedVcf:
     """Column-oriented view of one VCF: per-contig start-sorted arrays
     (positions, AF, has-variation rows) feeding the packed ingest path —
-    parsed by the native C++ parser when available (``native/vcfparse.cpp``),
-    by Python otherwise, with identical output (tested)."""
+    parsed by the native C++ parser when available (``native/vcfparse.cpp``,
+    chunk-parallel across ``ingest_workers`` threads), by Python otherwise,
+    with identical output (tested)."""
 
-    def __init__(self, path: str, set_id: str):
+    def __init__(
+        self,
+        path: str,
+        set_id: str,
+        ingest_workers: Optional[int] = None,
+    ):
         from spark_examples_tpu.utils.native import (
             parse_vcf_arrays,
             vcf_library,
@@ -487,6 +623,7 @@ class _PackedVcf:
 
         self.path = path
         self.native = False
+        workers = _resolve_ingest_workers(ingest_workers)
         lowered = path[:-3] if path.endswith(".gz") else path
         if not lowered.endswith(".vcf"):
             raise ValueError(
@@ -500,7 +637,10 @@ class _PackedVcf:
                 raw = f.read()
             if path.endswith(".gz"):
                 raw = gzip.decompress(raw)
-            arrays = parse_vcf_arrays(raw)
+            if workers >= 2:
+                arrays = _native_parallel_vcf_arrays(raw, workers)
+            else:
+                arrays = parse_vcf_arrays(raw)
             self.native = arrays is not None
         else:
             arrays = None
@@ -563,11 +703,17 @@ def _read_vcf_header_samples(path: str) -> List[str]:
     with _open_text(path) as f:
         for line in f:
             line = line.rstrip("\r\n")
-            if not line or line.startswith("##"):
+            if not line:
                 continue
             if line.startswith("#CHROM"):
                 columns = line.split("\t")
                 return columns[9:] if len(columns) > 9 else []
+            if line.startswith("#"):
+                # Any other '#'-prefixed line ('##' meta or a bare comment)
+                # is header noise, not data: keep scanning for #CHROM. A
+                # single-'#' comment before #CHROM previously ended the
+                # scan here and silently yielded a 0-sample cohort.
+                continue
             break  # a data line before #CHROM: headerless, no cohort
     return []
 
@@ -705,11 +851,16 @@ class _StreamedVcf:
     """
 
     def __init__(
-        self, path: str, set_id: str, chunk_bytes: int = STREAM_CHUNK_BYTES
+        self,
+        path: str,
+        set_id: str,
+        chunk_bytes: int = STREAM_CHUNK_BYTES,
+        ingest_workers: Optional[int] = None,
     ):
         self.path = path
         self.set_id = set_id
         self.chunk_bytes = int(chunk_bytes)
+        self.ingest_workers = _resolve_ingest_workers(ingest_workers)
         self.samples = _read_vcf_header_samples(path)
         self.num_samples = len(self.samples)
         self.callsets = [
@@ -719,15 +870,33 @@ class _StreamedVcf:
         self._bounds: Optional[Dict[str, int]] = None
 
     def iter_chunk_arrays(self):
-        """→ ``(contigs, positions, ends, af, hv)`` per chunk, file order."""
-        from spark_examples_tpu.utils.native import parse_vcf_chunk
+        """→ ``(contigs, positions, ends, af, hv)`` per chunk, file order.
 
-        for chunk in _iter_vcf_chunks(self.path, self.chunk_bytes):
+        With ``ingest_workers >= 2`` and the native library available,
+        chunks decode CONCURRENTLY on a thread pool (the C-ABI parse
+        releases the GIL) while this generator yields them in file order —
+        the streaming face of the chunk-parallel ingest engine. The
+        in-flight window is bounded (``_ordered_pool_map``), so peak host
+        memory grows from O(chunk) to O(workers × chunk), still independent
+        of file size, and a slow consumer backpressures the reader. The
+        pure-Python fallback stays serial: it holds the GIL, so a pool
+        would only add overhead around the same single-core parse."""
+        from spark_examples_tpu.utils.native import (
+            parse_vcf_chunk,
+            vcf_library,
+        )
+
+        def decode(chunk: bytes):
             arrays = parse_vcf_chunk(chunk, self.num_samples)
             if arrays is None:
                 arrays = _python_chunk_arrays(
                     chunk, self.path, self.set_id, self.samples
                 )
+            return arrays
+
+        workers = self.ingest_workers if vcf_library() is not None else 0
+        chunks = _iter_vcf_chunks(self.path, self.chunk_bytes)
+        for arrays in _ordered_pool_map(decode, chunks, workers):
             if len(arrays[1]):
                 yield arrays
 
@@ -893,6 +1062,7 @@ class FileGenomicsSource(GenomicsSource):
         self,
         paths: Sequence[str],
         stream_chunk_bytes: Optional[int] = None,
+        ingest_workers: Optional[int] = None,
     ):
         if not paths:
             raise ValueError("--source file needs --input-files")
@@ -905,6 +1075,12 @@ class FileGenomicsSource(GenomicsSource):
         #: ``None`` = auto (stream VCFs past ``STREAM_THRESHOLD_BYTES``),
         #: ``0`` = never stream, ``> 0`` = always stream with this chunk.
         self.stream_chunk_bytes = stream_chunk_bytes
+        #: Chunk-parallel ingest threads (``--ingest-workers``): ``None`` =
+        #: auto (:func:`default_ingest_workers`), ``0`` = the serial oracle
+        #: path. Validated here so a bad value fails at construction, not
+        #: from a worker thread mid-parse.
+        self.ingest_workers = ingest_workers
+        _resolve_ingest_workers(ingest_workers)
         self._lock = threading.Lock()
 
     def _table(self, set_id: str) -> _FileTable:
@@ -966,6 +1142,7 @@ class FileGenomicsSource(GenomicsSource):
                     self._by_id[set_id],
                     set_id,
                     chunk_bytes=self.stream_chunk_bytes or STREAM_CHUNK_BYTES,
+                    ingest_workers=self.ingest_workers,
                 )
                 self._streamed[set_id] = view
             return view
@@ -1000,7 +1177,11 @@ class FileGenomicsSource(GenomicsSource):
                     raise KeyError(
                         f"unknown set id {set_id!r}; inputs are {self.set_ids}"
                     )
-                view = _PackedVcf(self._by_id[set_id], set_id)
+                view = _PackedVcf(
+                    self._by_id[set_id],
+                    set_id,
+                    ingest_workers=self.ingest_workers,
+                )
                 self._packed[set_id] = view
             return view
 
@@ -1130,6 +1311,7 @@ __all__ = [
     "FileClient",
     "StreamCounters",
     "af_float",
+    "default_ingest_workers",
     "file_set_id",
     "file_set_ids",
 ]

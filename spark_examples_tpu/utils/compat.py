@@ -1,0 +1,62 @@
+"""JAX version compatibility shims.
+
+One import site for APIs that moved between jax releases, so every ops
+module keys off the same resolution instead of pinning a jax version the
+image may not have.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the top-level
+``jax`` namespace in jax 0.6; this repo targets both (the seed image ships
+0.4.37, where only the experimental path exists).
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.6
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # jax 0.4/0.5
+    from jax.experimental.shard_map import (  # type: ignore[no-redef]
+        shard_map as _shard_map,
+    )
+
+import inspect as _inspect
+
+if "check_vma" in _inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+
+    def shard_map(*args, **kwargs):
+        """0.4's shard_map with the modern ``check_vma`` kwarg translated to
+        its old name ``check_rep`` (same semantics: skip the per-output
+        replication/varying-axes check)."""
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
+try:  # newer jax ships lax.axis_size
+    from jax.lax import axis_size  # type: ignore[attr-defined]
+except ImportError:  # jax 0.4
+
+    def axis_size(axis_name):  # type: ignore[no-redef]
+        """Static size of a named mesh axis inside shard_map: ``psum`` of a
+        Python literal constant-folds to a concrete int at trace time (the
+        long-standing jax idiom), so callers can drive ``range``/``fori_loop``
+        bounds with it exactly like the modern ``lax.axis_size``."""
+        from jax import lax
+
+        return lax.psum(1, axis_name)
+
+
+try:  # newer jax: top-level context manager
+    from jax import enable_x64  # type: ignore[attr-defined]
+except ImportError:  # jax 0.4: experimental only
+    from jax.experimental import enable_x64  # type: ignore[no-redef]
+
+    # Heal the modern spelling for every call site (ops, pipeline, tests all
+    # write ``with jax.enable_x64(True)``): one alias here instead of a
+    # version guard at ~30 sites. Loaded from the package __init__, so the
+    # alias exists before any module body that uses it runs.
+    jax.enable_x64 = enable_x64
+
+__all__ = ["axis_size", "enable_x64", "shard_map"]

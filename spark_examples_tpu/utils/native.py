@@ -37,6 +37,18 @@ _lib: Optional[ctypes.CDLL] = None
 _lib_error: Optional[str] = None
 
 
+class MalformedVcfLine(ValueError):
+    """A malformed VCF data line. ``ordinal`` is the 1-based position among
+    the DATA lines of the buffer (or span) that was being parsed — span
+    parsers raise it span-relative, and the chunk-parallel merge
+    (``sources/files.py``) translates it to the file-level ordinal so the
+    error matches what the serial path reports for the same file."""
+
+    def __init__(self, ordinal: int):
+        super().__init__(f"malformed VCF data line #{int(ordinal)}")
+        self.ordinal = int(ordinal)
+
+
 def _compiler() -> Optional[str]:
     for name in ("g++", "clang++", "c++"):
         path = shutil.which(name)
@@ -97,6 +109,11 @@ def vcf_library() -> Optional[ctypes.CDLL]:
         return _lib
     try:
         path = _build(os.path.join(_REPO_NATIVE, "vcfparse.cpp"))
+        # CDLL, never PyDLL: ctypes releases the GIL around CDLL foreign
+        # calls, which is what lets the chunk-parallel ingest engine
+        # (sources/files.py) run vcf_parse_span concurrently on a thread
+        # pool. PyDLL would hold the GIL and serialize every worker
+        # (tests/test_ingest_parallel.py pins the loader class).
         lib = ctypes.CDLL(path)
         lib.vcf_scan.restype = ctypes.c_int
         lib.vcf_scan.argtypes = [
@@ -119,6 +136,25 @@ def vcf_library() -> Optional[ctypes.CDLL]:
         ]
         lib.vcf_count_data_lines.restype = ctypes.c_int64
         lib.vcf_count_data_lines.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.vcf_count_data_lines_span.restype = ctypes.c_int64
+        lib.vcf_count_data_lines_span.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        lib.vcf_parse_span.restype = ctypes.c_int64
+        lib.vcf_parse_span.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ]
         lib.vcf_scan_sites.restype = ctypes.c_int64
         lib.vcf_scan_sites.argtypes = [
             ctypes.c_char_p,
@@ -179,7 +215,7 @@ def parse_vcf_arrays(text: bytes) -> Optional[Tuple[np.ndarray, ...]]:
         contig_off, contig_len,
     )
     if parsed < 0:
-        raise ValueError(f"malformed VCF data line #{-parsed}")
+        raise MalformedVcfLine(-parsed)
     if parsed != L:
         raise ValueError(f"parsed {parsed} of {L} VCF data lines")
     contigs = np.empty(L, dtype=object)
@@ -252,7 +288,60 @@ def parse_vcf_chunk(text: bytes, n_samples: int):
         contig_off, contig_len,
     )
     if parsed < 0:
-        raise ValueError(f"malformed VCF data line #{-parsed}")
+        raise MalformedVcfLine(-parsed)
+    if parsed != L:
+        raise ValueError(f"parsed {parsed} of {L} VCF data lines")
+    contigs = _contig_strings(text, contig_off, contig_len, L)
+    return contigs, positions, ends, af, has_variation[:, :n_samples]
+
+
+def scan_vcf_counts(text: bytes) -> Optional[Tuple[int, int]]:
+    """One native header/line scan: ``(n_data_lines, n_samples)`` for the
+    whole buffer (the serial pass the chunk-parallel parse shares with
+    :func:`parse_vcf_arrays`, so both resolve the cohort identically —
+    including the headerless and repeated-``#CHROM`` edge cases). ``None``
+    when the native library is unavailable."""
+    lib = vcf_library()
+    if lib is None:
+        return None
+    n_lines = ctypes.c_int64()
+    n_samples = ctypes.c_int64()
+    lib.vcf_scan(
+        text, len(text), ctypes.byref(n_lines), ctypes.byref(n_samples)
+    )
+    return n_lines.value, n_samples.value
+
+
+def parse_vcf_span(text: bytes, begin: int, end: int, n_samples: int):
+    """Native parse of ONE line-aligned span ``[begin, end)`` of ``text`` —
+    the chunk-parallel worker body (``sources/files.py``). No bytes are
+    copied: the span is addressed by offset into the shared buffer, and the
+    two foreign calls (count + parse) both release the GIL, so N workers
+    parse N spans on N cores concurrently.
+
+    Returns the same array tuple as :func:`parse_vcf_chunk`, rows in span
+    order. Raises ``ValueError`` on a malformed data line (1-based ordinal
+    within the span). ``None`` when the native library is unavailable.
+    """
+    lib = vcf_library()
+    if lib is None:
+        return None
+    begin, end = int(begin), int(end)
+    if not 0 <= begin <= end <= len(text):
+        raise ValueError(f"span [{begin}, {end}) outside text of {len(text)}")
+    L = int(lib.vcf_count_data_lines_span(text, begin, end))
+    positions = np.empty(L, dtype=np.int64)
+    ends = np.empty(L, dtype=np.int64)
+    af = np.empty(L, dtype=np.float64)
+    has_variation = np.zeros((L, max(n_samples, 1)), dtype=np.int8)
+    contig_off = np.empty(L, dtype=np.int64)
+    contig_len = np.empty(L, dtype=np.int64)
+    parsed = lib.vcf_parse_span(
+        text, begin, end, n_samples, positions, ends, af, has_variation,
+        contig_off, contig_len,
+    )
+    if parsed < 0:
+        raise MalformedVcfLine(-parsed)
     if parsed != L:
         raise ValueError(f"parsed {parsed} of {L} VCF data lines")
     contigs = _contig_strings(text, contig_off, contig_len, L)
@@ -276,15 +365,18 @@ def scan_vcf_sites_chunk(text: bytes):
         text, len(text), positions, ends, contig_off, contig_len
     )
     if parsed < 0:
-        raise ValueError(f"malformed VCF data line #{-parsed}")
+        raise MalformedVcfLine(-parsed)
     contigs = _contig_strings(text, contig_off, contig_len, L)
     return contigs, positions, ends
 
 
 __all__ = [
+    "MalformedVcfLine",
     "vcf_library",
     "native_unavailable_reason",
     "parse_vcf_arrays",
     "parse_vcf_chunk",
+    "parse_vcf_span",
+    "scan_vcf_counts",
     "scan_vcf_sites_chunk",
 ]

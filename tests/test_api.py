@@ -99,13 +99,21 @@ def test_center_matrix_exact_past_f32_range():
 def test_package_version_matches_pyproject():
     """__version__ and pyproject agree (it drifted once)."""
     import os
-    import tomllib
+    import re
 
     import spark_examples_tpu
 
     root = os.path.dirname(os.path.dirname(spark_examples_tpu.__file__))
     with open(os.path.join(root, "pyproject.toml"), "rb") as f:
-        declared = tomllib.load(f)["project"]["version"]
+        text = f.read().decode("utf-8")
+    try:  # tomllib is 3.11+; the seed image runs 3.10
+        import tomllib
+
+        declared = tomllib.loads(text)["project"]["version"]
+    except ModuleNotFoundError:
+        match = re.search(r'^version\s*=\s*"([^"]+)"', text, re.MULTILINE)
+        assert match, "pyproject.toml has no version line"
+        declared = match.group(1)
     assert spark_examples_tpu.__version__ == declared
 
 

@@ -506,6 +506,7 @@ def test_device_ingest_bitwise_identical_across_device_counts():
 def test_device_ingest_bitwise_matches_host_fuzz():
     """Fuzz the device ingest kernel against the host packed path: any
     cohort/seed/region must produce the identical Gramian."""
+    pytest.importorskip("hypothesis")  # declared only under the `test` extra
     from hypothesis import given, settings, strategies as st
 
     @given(

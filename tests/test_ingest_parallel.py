@@ -1,0 +1,381 @@
+"""Chunk-parallel native ingest engine + double-buffered device feeding.
+
+The parity contract is absolute: ``--ingest-workers N`` (any N) must produce
+byte-identical packed arrays — and therefore identical PCA output — to the
+serial oracle path (``--ingest-workers 0``) on every fixture, including gz
+streaming and header-edge-case files. The machinery under test:
+
+- line-aligned span chunking + order-preserving pool merge
+  (``sources/files.py``), over the GIL-releasing C-ABI span parser
+  (``native/vcfparse.cpp:vcf_parse_span`` via ``utils/native.py``);
+- the bounded prefetch queue between parse and device feed
+  (``pipeline/datasets.py:PrefetchIterator``) — backpressure must hold;
+- the double-buffered Gramian feed (``ops/gramian.py`` ``pipeline_depth``).
+"""
+
+import ctypes
+import gzip
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.pipeline import pca_driver
+from spark_examples_tpu.pipeline.datasets import PrefetchIterator
+from spark_examples_tpu.sources.files import (
+    FileGenomicsSource,
+    _line_aligned_spans,
+    _ordered_pool_map,
+    _PackedVcf,
+    _read_vcf_header_samples,
+    _StreamedVcf,
+    default_ingest_workers,
+)
+
+
+def _assert_arrays_equal(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    if a.dtype != object and np.issubdtype(a.dtype, np.floating):
+        np.testing.assert_array_equal(np.isnan(a), np.isnan(b))
+        np.testing.assert_array_equal(a[~np.isnan(a)], b[~np.isnan(b)])
+    else:
+        np.testing.assert_array_equal(a, b)
+
+
+def _edge_case_vcf(tmp_path, name="cohort.vcf", n_samples=7, rows=300,
+                   compress=False, seed=5):
+    """Deterministic multi-contig fixture exercising the header edge cases:
+    a single-'#' comment BEFORE #CHROM, another mid-file, CRLF-free sorted
+    rows, AF-less rows, missing calls, and a contig switch."""
+    rng = np.random.default_rng(seed)
+    lines = [
+        "##fileformat=VCFv4.2",
+        "# single-hash comment before the column row",
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\t"
+        + "\t".join(f"S{i:02d}" for i in range(n_samples)),
+    ]
+    for contig in ("1", "17"):
+        for k in range(rows):
+            pos = 50 + 17 * k
+            info = f"AF={rng.random():.4f}" if k % 3 else "NS=2"
+            gts = "\t".join(
+                rng.choice(["0|0", "0|1", "1|1", ".|.", "0/2"])
+                for _ in range(n_samples)
+            )
+            lines.append(f"{contig}\t{pos}\t.\tAC\tG\t.\t.\t{info}\tGT\t{gts}")
+        lines.append("# mid-file comment line")
+    doc = "\n".join(lines) + "\n"
+    path = tmp_path / (name + (".gz" if compress else ""))
+    if compress:
+        with gzip.open(path, "wt") as f:
+            f.write(doc)
+    else:
+        path.write_text(doc)
+    return str(path)
+
+
+# ------------------------------------------------------------ chunking units
+
+
+def test_line_aligned_spans_reassemble_exactly():
+    text = b"alpha\nbeta\nmuch longer line gamma\nd\n\ntail without newline"
+    for n in (1, 2, 3, 5, 64):
+        spans = _line_aligned_spans(text, n)
+        assert b"".join(text[a:b] for a, b in spans) == text
+        assert all(b > a for a, b in spans)
+        # Every boundary except the last sits just past a newline.
+        assert all(text[b - 1 : b] == b"\n" for _, b in spans[:-1])
+    assert _line_aligned_spans(b"", 4) == []
+
+
+def test_ordered_pool_map_preserves_order_and_errors():
+    assert list(_ordered_pool_map(lambda x: x * x, range(50), 4)) == [
+        x * x for x in range(50)
+    ]
+
+    def boom(x):
+        if x == 7:
+            raise ValueError("chunk 7 exploded")
+        return x
+
+    out = []
+    with pytest.raises(ValueError, match="chunk 7 exploded"):
+        for item in _ordered_pool_map(boom, range(20), 3):
+            out.append(item)
+    assert out == list(range(7))  # everything before the failure, in order
+
+
+def test_ordered_pool_map_bounds_source_advance():
+    """Backpressure: a paused consumer stops the source iterator from being
+    drained arbitrarily far ahead (the streaming-reader memory bound)."""
+    pulled = []
+
+    def source():
+        for i in range(100):
+            pulled.append(i)
+            yield i
+
+    workers = 3
+    gen = _ordered_pool_map(lambda x: x, source(), workers)
+    consumed = []
+    for item in gen:
+        consumed.append(item)
+        time.sleep(0.002)
+        # window = workers + 2 pending futures, plus one yielded and one
+        # being pulled from the source.
+        assert len(pulled) - len(consumed) <= workers + 2 + 2
+        if len(consumed) >= 30:
+            break
+    gen.close()
+    assert consumed == list(range(30))
+    assert len(pulled) < 100
+
+
+# ----------------------------------------------------------- native GIL path
+
+
+def test_native_library_is_gil_releasing_cdll():
+    """The chunk-parallel engine's scaling rests on ctypes releasing the GIL
+    around foreign calls — true for CDLL, false for PyDLL. Guard the binding
+    class so a refactor cannot silently serialize the pool."""
+    from spark_examples_tpu.utils import native as native_mod
+
+    lib = native_mod.vcf_library()
+    if lib is None:
+        pytest.skip(f"no native build: {native_mod.native_unavailable_reason()}")
+    assert isinstance(lib, ctypes.CDLL)
+    assert not isinstance(lib, ctypes.PyDLL)
+
+
+def test_parse_vcf_span_matches_whole_buffer(tmp_path):
+    from spark_examples_tpu.utils import native as native_mod
+
+    if native_mod.vcf_library() is None:
+        pytest.skip("no native build")
+    path = _edge_case_vcf(tmp_path, rows=40)
+    text = open(path, "rb").read()
+    whole = native_mod.parse_vcf_arrays(text)
+    _, n_samples = native_mod.scan_vcf_counts(text)
+    for n_spans in (1, 2, 5):
+        spans = _line_aligned_spans(text, n_spans)
+        parts = [
+            native_mod.parse_vcf_span(text, a, b, n_samples) for a, b in spans
+        ]
+        merged = [np.concatenate([p[i] for p in parts]) for i in range(5)]
+        for a, b in zip(whole, merged):
+            _assert_arrays_equal(a, b)
+
+
+# ------------------------------------------------------------- parity: packed
+
+
+@pytest.mark.parametrize("compress", [False, True])
+@pytest.mark.parametrize("workers", [1, 2, 4])
+def test_packed_parallel_parity(tmp_path, compress, workers):
+    """The tentpole invariant: byte-identical per-contig packed arrays for
+    every worker count vs the serial oracle — gz and plain, with comment
+    lines before #CHROM and mid-file."""
+    path = _edge_case_vcf(tmp_path, compress=compress)
+    serial = _PackedVcf(path, "cohort", ingest_workers=0)
+    parallel = _PackedVcf(path, "cohort", ingest_workers=workers)
+    assert serial.num_samples == parallel.num_samples == 7
+    assert list(serial.by_contig) == list(parallel.by_contig)
+    for name in serial.by_contig:
+        for a, b in zip(serial.by_contig[name], parallel.by_contig[name]):
+            _assert_arrays_equal(a, b)
+    assert serial.contig_bounds == parallel.contig_bounds
+
+
+@pytest.mark.parametrize("compress", [False, True])
+def test_streamed_parallel_parity(tmp_path, compress):
+    """Streaming integration: parallel chunk decode yields the chunks in
+    file order with identical arrays, across chunk sizes that slice lines
+    mid-record."""
+    path = _edge_case_vcf(tmp_path, compress=compress)
+
+    def collect(workers, chunk_bytes):
+        view = _StreamedVcf(
+            path, "cohort", chunk_bytes=chunk_bytes, ingest_workers=workers
+        )
+        parts = list(view.iter_chunk_arrays())
+        assert parts, "fixture should produce data"
+        return [np.concatenate([p[i] for p in parts]) for i in range(5)]
+
+    want = collect(0, 1024)
+    for workers in (2, 4):
+        for chunk_bytes in (777, 4096):
+            got = collect(workers, chunk_bytes)
+            for a, b in zip(want, got):
+                _assert_arrays_equal(a, b)
+
+
+def test_malformed_line_raises_same_file_level_ordinal(tmp_path):
+    """Both paths fail loudly AND report the same FILE-level data-line
+    number — the parallel merge translates the span-relative ordinal."""
+    from spark_examples_tpu.utils import native as native_mod
+
+    rows = [
+        f"1\t{10 + 7 * k}\t.\tA\tG\t.\t.\tAF=0.5\tGT\t0|1" for k in range(90)
+    ]
+    rows[61] = "1\tnot_a_pos\t.\tA"  # data line #62
+    path = tmp_path / "bad.vcf"
+    path.write_text(
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS0\n"
+        + "\n".join(rows)
+        + "\n"
+    )
+    messages = []
+    for workers in (0, 3):
+        with pytest.raises(ValueError) as err:
+            _PackedVcf(str(path), "bad", ingest_workers=workers)
+        messages.append(str(err.value))
+    assert messages[0] == messages[1]
+    if native_mod.vcf_library() is not None:
+        assert "#62" in messages[0]
+
+
+def test_driver_end_to_end_parity_across_workers(tmp_path):
+    """``--ingest-workers N`` (N>=2) produces identical PCA output to the
+    serial oracle on the same fixture, for the in-memory packed path AND the
+    streamed path."""
+    path = _edge_case_vcf(tmp_path, rows=120)
+    base = [
+        "--source", "file", "--input-files", path,
+        "--references", "1:0:6000,17:0:6000",
+        "--ingest", "packed",
+        "--min-allele-frequency", "0.2",
+    ]
+    want = pca_driver.run(base + ["--ingest-workers", "0"])
+    assert pca_driver.run(base + ["--ingest-workers", "4"]) == want
+    streamed = base + ["--stream-chunk-bytes", "2048"]
+    assert pca_driver.run(streamed + ["--ingest-workers", "0"]) == want
+    assert pca_driver.run(streamed + ["--ingest-workers", "4"]) == want
+
+
+# ---------------------------------------------------- prefetch / double-buffer
+
+
+def test_prefetch_iterator_is_bounded_and_ordered():
+    produced = []
+
+    def source():
+        for i in range(60):
+            produced.append(i)
+            yield i
+
+    prefetch = PrefetchIterator(source(), depth=3)
+    seen = []
+    for item in prefetch:
+        time.sleep(0.001)
+        seen.append(item)
+        # The queue holds ≤ depth items; the producer may hold one more.
+        assert len(produced) - len(seen) <= 3 + 1
+    assert seen == list(range(60))
+    assert prefetch.items == 60
+
+
+def test_prefetch_iterator_propagates_producer_error():
+    def source():
+        yield "ok"
+        raise RuntimeError("parse died")
+
+    prefetch = PrefetchIterator(source(), depth=2)
+    assert next(prefetch) == "ok"
+    with pytest.raises(RuntimeError, match="parse died"):
+        next(prefetch)
+
+
+def test_prefetch_close_releases_producer_thread():
+    release = threading.Event()
+
+    def source():
+        for i in range(1000):
+            if i > 2:
+                release.wait(5.0)
+            yield i
+
+    prefetch = PrefetchIterator(source(), depth=2)
+    assert next(prefetch) == 0
+    release.set()
+    prefetch.close()
+    assert not prefetch._thread.is_alive()
+
+
+def test_gramian_pipeline_depth_matches_synced_feed():
+    from spark_examples_tpu.ops.gramian import GramianAccumulator
+
+    rng = np.random.default_rng(11)
+    X = (rng.random((500, 23)) < 0.4).astype(np.uint8)
+    want = (X.T.astype(np.int64) @ X.astype(np.int64)).astype(np.float64)
+    for depth in (None, 1, 2, 4):
+        acc = GramianAccumulator(23, block_size=64, pipeline_depth=depth)
+        for off in range(0, 500, 61):
+            acc.add_rows(X[off : off + 61])
+        np.testing.assert_array_equal(acc.finalize(), want)
+
+
+def test_gramian_pipeline_depth_counts_kernel_parity():
+    """Count-valued rows (same-set joins) take the unpacked counts kernel,
+    whose full-block flush ships a view of the reused staging buffer — the
+    one branch where pipelined (non-syncing) flushes must copy before the
+    next add_rows overwrites it. Exact block-multiple feed sizes force the
+    no-copy full-block path."""
+    from spark_examples_tpu.ops.gramian import GramianAccumulator
+
+    rng = np.random.default_rng(7)
+    X = rng.integers(0, 3, (384, 17)).astype(np.uint8)  # values in {0,1,2}
+    want = (X.T.astype(np.int64) @ X.astype(np.int64)).astype(np.float64)
+    for depth in (None, 2):
+        acc = GramianAccumulator(
+            17, block_size=32, exact_int=True, pipeline_depth=depth
+        )
+        for off in range(0, 384, 32):  # exactly one full block per call
+            acc.add_rows(X[off : off + 32])
+        np.testing.assert_array_equal(acc.finalize(), want)
+
+
+# ------------------------------------------------------- satellite regressions
+
+
+def test_header_comment_before_chrom_keeps_cohort(tmp_path):
+    """ADVICE fix: a single-'#' comment line before #CHROM must not end the
+    header scan with a silent 0-sample cohort."""
+    path = tmp_path / "commented.vcf"
+    path.write_text(
+        "##fileformat=VCFv4.2\n"
+        "# a perfectly legal comment\n"
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS0\tS1\n"
+        "1\t10\t.\tA\tG\t.\t.\tAF=0.5\tGT\t0|1\t1|1\n"
+    )
+    assert _read_vcf_header_samples(str(path)) == ["S0", "S1"]
+    # And the streaming view built on it sees the full cohort.
+    view = _StreamedVcf(str(path), "commented")
+    assert view.num_samples == 2
+    # Headerless files still yield the empty cohort (not an error).
+    bare = tmp_path / "headerless.vcf"
+    bare.write_text("1\t10\t.\tA\tG\t.\t.\tAF=0.5\n")
+    assert _read_vcf_header_samples(str(bare)) == []
+
+
+def test_blocks_per_dispatch_rejects_non_positive():
+    from spark_examples_tpu.config import PcaConf
+
+    for bad in ("0", "-3"):
+        with pytest.raises(ValueError, match="blocks-per-dispatch"):
+            PcaConf.parse(["--blocks-per-dispatch", bad])
+    assert PcaConf.parse(["--blocks-per-dispatch", "5"]).blocks_per_dispatch == 5
+    assert PcaConf.parse([]).blocks_per_dispatch is None
+
+
+def test_ingest_workers_flag_validation():
+    from spark_examples_tpu.config import PcaConf
+
+    with pytest.raises(ValueError, match="ingest-workers"):
+        PcaConf.parse(["--ingest-workers", "-1"])
+    assert PcaConf.parse(["--ingest-workers", "0"]).ingest_workers == 0
+    assert PcaConf.parse([]).ingest_workers is None
+    assert 1 <= default_ingest_workers() <= 8
+    with pytest.raises(ValueError, match=">= 0"):
+        FileGenomicsSource(["x.vcf"], ingest_workers=-2)

@@ -6,6 +6,12 @@ shard layout, worker count, and device count.
 """
 
 import numpy as np
+import pytest
+
+# hypothesis is declared only under the `test` extra; the tier-1 gate must
+# collect (and run everything else) on the bare seed image.
+pytest.importorskip("hypothesis")
+
 from hypothesis import given, settings, strategies as st
 
 from spark_examples_tpu.ops.gramian import GramianAccumulator, gramian_reference
