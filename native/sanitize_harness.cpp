@@ -1,0 +1,205 @@
+// Sanitizer replay harness for the native VCF parser.
+//
+// Compiled TOGETHER with vcfparse.cpp into a standalone executable (no
+// Python, no ctypes) by utils/native.py:build_sanitizer_harness, under
+// -fsanitize=address / undefined / thread. A standalone binary sidesteps
+// the ASan/TSan runtime-preload problem of loading instrumented .so files
+// into an uninstrumented CPython, and gives TSan a *real* multi-threaded
+// exercise of the span entry points — the exact concurrency shape the
+// chunk-parallel ingest engine runs them in (N threads, one shared
+// read-only buffer, disjoint output arrays).
+//
+// Usage: harness CORPUS_FILE... — replays every corpus document through:
+//   1. vcf_scan + vcf_parse            (whole-buffer parse)
+//   2. vcf_count_data_lines + vcf_scan_sites + vcf_mark_contig_changes
+//   3. vcf_parse_span / vcf_count_data_lines_span from SPAN_THREADS
+//      concurrent threads over line-aligned spans of the shared buffer
+//
+// A malformed document is a VALID outcome (the parser reports the negative
+// line ordinal; the Python layer raises) — the harness only fails on
+// contract violations (row counts disagreeing with the pre-scan) and on
+// whatever the sanitizer itself traps. Exit 0 = clean.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+int vcf_scan(const char* buf, int64_t len, int64_t* n_lines,
+             int64_t* n_samples);
+int64_t vcf_parse(const char* buf, int64_t len, int64_t n_samples,
+                  int64_t* positions, int64_t* ends, double* af,
+                  int8_t* has_variation, int64_t* contig_off,
+                  int64_t* contig_len);
+int64_t vcf_count_data_lines(const char* buf, int64_t len);
+int64_t vcf_count_data_lines_span(const char* buf, int64_t begin,
+                                  int64_t end_off);
+int64_t vcf_parse_span(const char* buf, int64_t begin, int64_t end_off,
+                       int64_t n_samples, int64_t* positions, int64_t* ends,
+                       double* af, int8_t* has_variation, int64_t* contig_off,
+                       int64_t* contig_len);
+int64_t vcf_scan_sites(const char* buf, int64_t len, int64_t* positions,
+                       int64_t* ends, int64_t* contig_off,
+                       int64_t* contig_len);
+void vcf_mark_contig_changes(const char* buf, const int64_t* off,
+                             const int64_t* len, int64_t rows, int8_t* flags);
+}
+
+namespace {
+
+constexpr int kSpanThreads = 4;
+
+struct ParseBuffers {
+    std::vector<int64_t> positions, ends, contig_off, contig_len;
+    std::vector<double> af;
+    std::vector<int8_t> has_variation;
+    void resize(int64_t rows, int64_t n_samples) {
+        positions.resize(rows);
+        ends.resize(rows);
+        contig_off.resize(rows);
+        contig_len.resize(rows);
+        af.resize(rows);
+        has_variation.assign(
+            static_cast<size_t>(rows) *
+                static_cast<size_t>(n_samples > 0 ? n_samples : 1),
+            0);
+    }
+};
+
+// Line-aligned spans of [0, len): each boundary sits one past a '\n'
+// (mirrors sources/files.py:_line_aligned_spans).
+std::vector<std::pair<int64_t, int64_t>> line_spans(const char* buf,
+                                                    int64_t len, int n) {
+    std::vector<std::pair<int64_t, int64_t>> spans;
+    if (len == 0) return spans;
+    int64_t target = (len + n - 1) / n;
+    int64_t begin = 0;
+    while (begin < len) {
+        int64_t cut = begin + target < len ? begin + target : len;
+        if (cut < len) {
+            const void* nl = memchr(buf + cut - 1,
+                                    '\n',
+                                    static_cast<size_t>(len - cut + 1));
+            cut = nl ? static_cast<const char*>(nl) - buf + 1 : len;
+        }
+        spans.emplace_back(begin, cut);
+        begin = cut;
+    }
+    return spans;
+}
+
+int replay_document(const std::string& data, const char* name) {
+    const char* buf = data.data();
+    const int64_t len = static_cast<int64_t>(data.size());
+
+    // 1. Whole-buffer scan + parse (the parse_vcf_arrays contract).
+    int64_t n_lines = 0, n_samples = 0;
+    vcf_scan(buf, len, &n_lines, &n_samples);
+    ParseBuffers whole;
+    whole.resize(n_lines, n_samples);
+    int64_t parsed = vcf_parse(buf, len, n_samples, whole.positions.data(),
+                               whole.ends.data(), whole.af.data(),
+                               whole.has_variation.data(),
+                               whole.contig_off.data(),
+                               whole.contig_len.data());
+    const bool malformed = parsed < 0;
+    if (!malformed && parsed != n_lines) {
+        fprintf(stderr, "%s: vcf_parse returned %lld of %lld scanned lines\n",
+                name, (long long)parsed, (long long)n_lines);
+        return 1;
+    }
+
+    // 2. Site-only scan + contig-run marking over its output.
+    int64_t counted = vcf_count_data_lines(buf, len);
+    if (counted != n_lines) {
+        fprintf(stderr, "%s: count %lld != scan %lld\n", name,
+                (long long)counted, (long long)n_lines);
+        return 1;
+    }
+    ParseBuffers sites;
+    sites.resize(counted, 0);
+    int64_t site_rows = vcf_scan_sites(buf, len, sites.positions.data(),
+                                       sites.ends.data(),
+                                       sites.contig_off.data(),
+                                       sites.contig_len.data());
+    if (site_rows >= 0) {
+        std::vector<int8_t> flags(static_cast<size_t>(site_rows) + 1);
+        vcf_mark_contig_changes(buf, sites.contig_off.data(),
+                                sites.contig_len.data(), site_rows,
+                                flags.data());
+    } else if (!malformed) {
+        fprintf(stderr, "%s: sites scan rejected what vcf_parse accepted\n",
+                name);
+        return 1;
+    }
+
+    // 3. Concurrent span parses over the SHARED buffer — the TSan subject.
+    auto spans = line_spans(buf, len, kSpanThreads);
+    std::vector<ParseBuffers> outs(spans.size());
+    std::vector<int64_t> span_rows(spans.size(), 0);
+    std::vector<std::thread> threads;
+    threads.reserve(spans.size());
+    for (size_t i = 0; i < spans.size(); ++i) {
+        threads.emplace_back([&, i] {
+            int64_t rows = vcf_count_data_lines_span(buf, spans[i].first,
+                                                     spans[i].second);
+            outs[i].resize(rows, n_samples);
+            span_rows[i] = vcf_parse_span(
+                buf, spans[i].first, spans[i].second, n_samples,
+                outs[i].positions.data(), outs[i].ends.data(),
+                outs[i].af.data(), outs[i].has_variation.data(),
+                outs[i].contig_off.data(), outs[i].contig_len.data());
+        });
+    }
+    for (auto& t : threads) t.join();
+    int64_t total = 0;
+    bool span_malformed = false;
+    for (int64_t rows : span_rows) {
+        if (rows < 0) span_malformed = true;
+        else total += rows;
+    }
+    if (!malformed && !span_malformed && total != n_lines) {
+        fprintf(stderr, "%s: span parses total %lld != %lld serial rows\n",
+                name, (long long)total, (long long)n_lines);
+        return 1;
+    }
+    if (malformed != span_malformed) {
+        fprintf(stderr, "%s: whole/span malformed-line disagreement\n", name);
+        return 1;
+    }
+    return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+    if (argc < 2) {
+        fprintf(stderr, "usage: %s corpus_file...\n", argv[0]);
+        return 2;
+    }
+    int failures = 0;
+    for (int i = 1; i < argc; ++i) {
+        FILE* f = fopen(argv[i], "rb");
+        if (!f) {
+            fprintf(stderr, "cannot open %s\n", argv[i]);
+            return 2;
+        }
+        std::string data;
+        char chunk[1 << 16];
+        size_t got;
+        while ((got = fread(chunk, 1, sizeof chunk, f)) > 0)
+            data.append(chunk, got);
+        fclose(f);
+        failures += replay_document(data, argv[i]);
+    }
+    if (failures) {
+        fprintf(stderr, "%d corpus document(s) violated the parse contract\n",
+                failures);
+        return 1;
+    }
+    return 0;
+}

@@ -1,0 +1,24 @@
+"""``graftcheck`` — the static-analysis subsystem.
+
+Three parts, one CLI (``python -m spark_examples_tpu graftcheck ...``):
+
+- ``lint``   — AST-walking JAX-pitfall linter tuned to this repo
+  (``linter.py``; rule catalogue in ``rules.py``). The concurrent ingest
+  engine and the device pipeline fail *silently* (host-sync stalls,
+  recompilation storms, data races), so the failure classes tier-1 cannot
+  observe are pinned as lint rules instead.
+- ``plan``   — device-free pipeline dry-run (``plan.py``): the full flag
+  surface is validated with ``jax.eval_shape`` over ``ShapeDtypeStruct``
+  operands and an ``AbstractMesh``, so a 2-hour whole-genome run cannot die
+  at minute 90 on a config error.
+- ``sanitize`` — ASAN/UBSAN/TSAN replay of the VCF fuzz corpus against the
+  native parser (``sanitize.py``), turning the PR-1 concurrency claims into
+  continuously-checked invariants.
+- ``typecheck`` — baseline-gated mypy over ``config.py`` + ``check/``
+  (``typecheck.py``): new type errors fail, committed debt does not.
+"""
+
+from spark_examples_tpu.check.rules import Finding, Rule, RULES
+from spark_examples_tpu.check.linter import lint_paths, lint_source
+
+__all__ = ["Finding", "Rule", "RULES", "lint_paths", "lint_source"]

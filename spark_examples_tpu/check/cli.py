@@ -1,0 +1,139 @@
+"""The ``graftcheck`` CLI front-end.
+
+Dispatched from the package CLI (``python -m spark_examples_tpu graftcheck
+<sub> ...``); subcommand exit codes propagate so ``ci.sh`` stages can gate
+on them:
+
+    graftcheck lint [PATH...] [--json]        0 clean / 1 findings
+    graftcheck plan <pca flags> [--plan-devices N] [--json]
+                                              0 plan OK / 2 rejected
+    graftcheck sanitize [--modes m1,m2] [--strict]
+                                              0 clean or skipped / 1 FAIL
+    graftcheck typecheck [--strict] [--update-baseline]
+                                              0 ok or skipped / 1 new errors
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional, Sequence
+
+
+def _default_lint_root() -> str:
+    """The installed package directory — so ``graftcheck lint`` with no
+    argument lints this package regardless of the working directory."""
+    import spark_examples_tpu
+
+    return os.path.dirname(os.path.abspath(spark_examples_tpu.__file__))
+
+
+def _cmd_lint(argv: Sequence[str]) -> int:
+    from spark_examples_tpu.check.linter import json_report, lint_paths
+
+    parser = argparse.ArgumentParser(prog="graftcheck lint")
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="Files or package trees to lint (default: this package).",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="Emit the machine-readable report."
+    )
+    ns = parser.parse_args(list(argv))
+    paths = ns.paths or [_default_lint_root()]
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"graftcheck lint: no such path {path!r}", file=sys.stderr)
+            return 2
+    findings, checked = lint_paths(paths)
+    if ns.json:
+        print(json_report(findings, checked))
+    else:
+        for f in findings:
+            print(f.format())
+        verdict = "clean" if not findings else f"{len(findings)} finding(s)"
+        print(f"graftcheck lint: {checked} file(s), {verdict}")
+    return 1 if findings else 0
+
+
+def _cmd_plan(argv: Sequence[str]) -> int:
+    from spark_examples_tpu.check.plan import parse_plan_args, validate_plan
+
+    try:
+        conf, plan_devices, json_out = parse_plan_args(argv)
+    except ValueError as e:
+        # Cross-flag contract violations from PcaConf._from_namespace are
+        # plan rejections in their own right (e.g. --blocks-per-dispatch 0).
+        print(f"  ERROR [flag-contract] {e}")
+        print("plan REJECTED")
+        return 2
+    report = validate_plan(conf, plan_devices)
+    print(report.to_json() if json_out else report.format())
+    return 0 if report.ok else 2
+
+
+def _cmd_sanitize(argv: Sequence[str]) -> int:
+    from spark_examples_tpu.check.sanitize import DEFAULT_MODES, run_sanitize
+
+    parser = argparse.ArgumentParser(prog="graftcheck sanitize")
+    parser.add_argument(
+        "--modes",
+        default=",".join(DEFAULT_MODES),
+        help=f"Comma-separated sanitizer modes (default {','.join(DEFAULT_MODES)}).",
+    )
+    parser.add_argument(
+        "--strict",
+        action="store_true",
+        help="Fail (not skip) when the toolchain is missing a mode.",
+    )
+    ns = parser.parse_args(list(argv))
+    modes = [m.strip() for m in ns.modes.split(",") if m.strip()]
+    return run_sanitize(modes, strict=ns.strict)
+
+
+def _cmd_typecheck(argv: Sequence[str]) -> int:
+    from spark_examples_tpu.check.typecheck import run_typecheck
+
+    parser = argparse.ArgumentParser(prog="graftcheck typecheck")
+    parser.add_argument(
+        "--strict",
+        action="store_true",
+        help="Fail (not skip) when mypy is not installed.",
+    )
+    parser.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="Rewrite check/mypy_baseline.txt from the current diagnostics.",
+    )
+    ns = parser.parse_args(list(argv))
+    return run_typecheck(strict=ns.strict, update_baseline=ns.update_baseline)
+
+
+_SUBCOMMANDS = {
+    "lint": _cmd_lint,
+    "plan": _cmd_plan,
+    "sanitize": _cmd_sanitize,
+    "typecheck": _cmd_typecheck,
+}
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0
+    sub, rest = argv[0], argv[1:]
+    if sub not in _SUBCOMMANDS:
+        print(
+            f"graftcheck: unknown subcommand {sub!r} "
+            f"(have: {', '.join(sorted(_SUBCOMMANDS))})",
+            file=sys.stderr,
+        )
+        return 2
+    return _SUBCOMMANDS[sub](rest)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,527 @@
+"""AST-walking JAX-pitfall linter (the ``graftcheck lint`` engine).
+
+Design: one :class:`_LintVisitor` pass per file, no type inference — every
+rule is a syntactic pattern plus *scope* (which package subtree it applies
+to, ``rules.py``) plus a small amount of dataflow that stays inside one
+function body (names assigned from ``jnp.*`` expressions). The rules are
+deliberately tuned to THIS repo's idioms; anything legitimately outside
+them carries a ``# graftcheck: disable=ID -- why`` escape hatch, so the
+merged tree lints clean and the linter can gate CI (``ci.sh``).
+
+Import-alias resolution makes the patterns robust to import style:
+``import jax.numpy as jnp``, ``from jax import numpy as jnp``,
+``from jax import jit``, and ``from threading import Lock`` all resolve to
+their canonical dotted names before matching.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from spark_examples_tpu.check.rules import (
+    RULES,
+    Finding,
+    apply_disables,
+    parse_disables,
+)
+
+#: Call roots that convert a device value to host (GC001 sinks).
+_HOST_SINKS = ("float", "int", "numpy.asarray", "numpy.array", "numpy.float64")
+
+#: Lock constructors that demand the lock-ordering idiom (GC006). Event is
+#: excluded: it is a flag, not a mutual-exclusion primitive, and cannot
+#: participate in a lock-ordering deadlock by itself.
+_LOCK_CTORS = (
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "threading.Semaphore",
+    "threading.BoundedSemaphore",
+)
+
+#: How far above a lock construction the ``# lock order:`` comment may sit.
+_LOCK_COMMENT_WINDOW = 3
+
+
+def _dotted(node: ast.AST, alias: Dict[str, str]) -> Optional[str]:
+    """Canonical dotted name of a Name/Attribute chain, with the leading
+    segment resolved through the file's import aliases; ``None`` for
+    anything else (subscripts, calls, literals)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    parts.reverse()
+    head = alias.get(parts[0], parts[0])
+    return ".".join([head] + parts[1:])
+
+
+def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Map local names to canonical dotted module paths, normalizing the
+    numpy/jax spellings the rules match against."""
+    alias: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for item in node.names:
+                alias[item.asname or item.name.split(".")[0]] = (
+                    item.name if item.asname else item.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for item in node.names:
+                alias[item.asname or item.name] = f"{node.module}.{item.name}"
+    # Canonical spellings for the matchers (jnp/np import styles collapse).
+    resolved = {}
+    for name, target in alias.items():
+        if target == "jax.numpy":
+            resolved[name] = "jax.numpy"
+        elif target in ("numpy", "np"):
+            resolved[name] = "numpy"
+        else:
+            resolved[name] = target
+    return resolved
+
+
+def _is_jnp_rooted(node: ast.AST, alias: Dict[str, str]) -> bool:
+    """Whether an expression's outermost call/attr chain starts at
+    ``jax.numpy`` (covers ``jnp.sum(x)``, ``jnp.linalg.eigh(x)``)."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    name = _dotted(node, alias)
+    return bool(name and name.startswith("jax.numpy."))
+
+
+class _JitContext:
+    """One jit-decorated function on the stack: its traced (non-static)
+    parameter names, for GC002's branch test."""
+
+    def __init__(self, traced_params: Set[str], fn_name: str):
+        self.traced_params = traced_params
+        self.fn_name = fn_name
+
+
+def _jit_decoration(
+    dec: ast.expr, alias: Dict[str, str]
+) -> Optional[Dict[str, ast.expr]]:
+    """If ``dec`` applies ``jax.jit``, return its keyword arguments
+    (empty dict for the bare form); else ``None``. Recognized forms::
+
+        @jax.jit                      @jit
+        @functools.partial(jax.jit, static_argnames=...)
+        @partial(jit, donate_argnums=...)
+        @jax.jit(static_argnums=...)   (decorator-factory form)
+    """
+    name = _dotted(dec, alias)
+    if name in ("jax.jit", "jax.jit.jit", "jit"):
+        return {}
+    if isinstance(dec, ast.Call):
+        fn_name = _dotted(dec.func, alias)
+        kwargs = {k.arg: k.value for k in dec.keywords if k.arg}
+        if fn_name in ("jax.jit", "jit"):
+            return kwargs
+        if fn_name in ("functools.partial", "partial") and dec.args:
+            inner = _dotted(dec.args[0], alias)
+            if inner in ("jax.jit", "jit"):
+                return kwargs
+    return None
+
+
+def _static_param_names(
+    args: ast.arguments, jit_kwargs: Dict[str, ast.expr]
+) -> Set[str]:
+    """Resolve static_argnames/static_argnums to parameter names (constant
+    specs only — dynamic specs conservatively leave params traced)."""
+    posonly = [a.arg for a in getattr(args, "posonlyargs", [])]
+    names = posonly + [a.arg for a in args.args]
+    static: Set[str] = set()
+    spec = jit_kwargs.get("static_argnames")
+    if spec is not None:
+        for node in ast.walk(spec):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                static.add(node.value)
+    spec = jit_kwargs.get("static_argnums")
+    if spec is not None:
+        for node in ast.walk(spec):
+            if isinstance(node, ast.Constant) and isinstance(node.value, int):
+                if 0 <= node.value < len(names):
+                    static.add(names[node.value])
+    return static
+
+
+class _LintVisitor(ast.NodeVisitor):
+    def __init__(
+        self,
+        relpath: str,
+        source_lines: Sequence[str],
+        alias: Dict[str, str],
+    ):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.alias = alias
+        self.findings: List[Finding] = []
+        self._loop_depth = 0
+        self._func_depth = 0
+        self._jit_stack: List[_JitContext] = []
+        #: Per-function-scope set of names assigned from jnp expressions.
+        self._jnp_names: List[Set[str]] = []
+
+    # ------------------------------------------------------------- plumbing
+
+    def emit(self, rule_id: str, node: ast.AST, detail: str) -> None:
+        rule = RULES[rule_id]
+        if not rule.applies_to(self.relpath):
+            return
+        self.findings.append(
+            Finding(
+                rule_id,
+                self.relpath,
+                getattr(node, "lineno", 0),
+                getattr(node, "col_offset", 0) + 1,
+                detail,
+            )
+        )
+
+    def _has_lock_order_comment(self, lineno: int) -> bool:
+        lo = max(0, lineno - 1 - _LOCK_COMMENT_WINDOW)
+        window = self.lines[lo:lineno]
+        return any("lock order:" in line for line in window)
+
+    # ------------------------------------------------------------ functions
+
+    def _visit_function(self, node) -> None:
+        jit_kwargs = None
+        for dec in getattr(node, "decorator_list", []):
+            jit_kwargs = _jit_decoration(dec, self.alias)
+            if jit_kwargs is not None:
+                break
+        ctx = None
+        if jit_kwargs is not None:
+            static = _static_param_names(node.args, jit_kwargs)
+            params = {a.arg for a in node.args.args} | {
+                a.arg for a in getattr(node.args, "posonlyargs", [])
+            }
+            ctx = _JitContext(params - static - {"self"}, node.name)
+            self._jit_stack.append(ctx)
+            self._check_donation(node, jit_kwargs)
+        self._func_depth += 1
+        self._jnp_names.append(set())
+        # Loops outside don't lexically contain this body's dispatches.
+        outer_loop_depth, self._loop_depth = self._loop_depth, 0
+        self.generic_visit(node)
+        self._loop_depth = outer_loop_depth
+        self._jnp_names.pop()
+        self._func_depth -= 1
+        if ctx is not None:
+            self._jit_stack.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # A lambda body runs at CALL time: module-level `f = lambda x:
+        # jnp.sum(x)` must not trip the import-time rule (GC004).
+        self._func_depth += 1
+        self.generic_visit(node)
+        self._func_depth -= 1
+
+    def _check_donation(self, node, jit_kwargs: Dict[str, ast.expr]) -> None:
+        """GC005: jitted accumulator-shaped updates must donate (or carry a
+        justification disable). Heuristic: the function name says it updates
+        state in place (update/accum/flush) and takes at least two params."""
+        name = node.name.lower()
+        if not any(tag in name for tag in ("update", "accum", "flush")):
+            return
+        n_params = len(node.args.args) + len(
+            getattr(node.args, "posonlyargs", [])
+        )
+        if n_params < 2:
+            return
+        if {"donate_argnums", "donate_argnames"} & set(jit_kwargs):
+            return
+        self.emit(
+            "GC005",
+            node,
+            f"jitted accumulator update {node.name!r} has no "
+            "donate_argnums/donate_argnames; donating the accumulator "
+            "halves its peak memory (disable with a justification if "
+            "non-donation is a measured win)",
+        )
+
+    # ---------------------------------------------------------------- loops
+
+    def _visit_loop(self, node) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch_on_traced(node, "while")
+        self._visit_loop(node)
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch_on_traced(node, "if")
+        self.generic_visit(node)
+
+    # ------------------------------------------------------- GC002 (branch)
+
+    def _check_branch_on_traced(self, node, kind: str) -> None:
+        if not self._jit_stack:
+            return
+        ctx = self._jit_stack[-1]
+        test = node.test
+        # `x is None` / `x is not None` and isinstance() never call a
+        # tracer's __bool__; only value comparisons and bare names do.
+        traced = self._traced_names_in_bool_test(test, ctx.traced_params)
+        if traced:
+            names = ", ".join(sorted(traced))
+            self.emit(
+                "GC002",
+                node,
+                f"Python `{kind}` on traced value(s) {names} inside jitted "
+                f"{ctx.fn_name!r}; use lax.cond/lax.select/lax.while_loop "
+                "or mark the argument static",
+            )
+
+    def _traced_names_in_bool_test(
+        self, test: ast.expr, traced_params: Set[str]
+    ) -> Set[str]:
+        """Traced parameter names whose runtime VALUE the test branches on.
+
+        Conservative by construction: identity tests (``is``/``is not``),
+        ``isinstance``/callable probes, and attribute accesses (``x.ndim``,
+        ``x.shape``) are trace-time Python values, not tracers — only bare
+        names, value comparisons, boolean combinations, and negations of
+        those convert a tracer to bool.
+        """
+        if isinstance(test, ast.Name):
+            return {test.id} & traced_params
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._traced_names_in_bool_test(test.operand, traced_params)
+        if isinstance(test, ast.BoolOp):
+            out: Set[str] = set()
+            for value in test.values:
+                out |= self._traced_names_in_bool_test(value, traced_params)
+            return out
+        if isinstance(test, ast.Compare):
+            if any(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+                return set()
+            out = set()
+            for operand in [test.left, *test.comparators]:
+                if isinstance(operand, ast.Name):
+                    out |= {operand.id} & traced_params
+                elif isinstance(operand, ast.BinOp):
+                    for sub in ast.walk(operand):
+                        if isinstance(sub, ast.Name):
+                            out |= {sub.id} & traced_params
+            return out
+        return set()
+
+    # ----------------------------------------------------------- assignment
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self._jnp_names and _is_jnp_rooted(node.value, self.alias):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    self._jnp_names[-1].add(target.id)
+        self.generic_visit(node)
+
+    # ----------------------------------------------------------------- call
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted(node.func, self.alias)
+
+        # GC003: jit construction inside a loop body.
+        if self._loop_depth > 0:
+            jit_built = name in ("jax.jit", "jit") or (
+                name in ("functools.partial", "partial")
+                and node.args
+                and _dotted(node.args[0], self.alias) in ("jax.jit", "jit")
+            )
+            if jit_built:
+                self.emit(
+                    "GC003",
+                    node,
+                    "jax.jit constructed inside a loop — every iteration "
+                    "pays a cache lookup on a fresh callable (recompile "
+                    "storm); hoist the jit out of the loop",
+                )
+
+        # GC004: jnp at import time (module/class body, not inside a def).
+        if self._func_depth == 0 and name and name.startswith("jax.numpy."):
+            self.emit(
+                "GC004",
+                node,
+                f"{name.replace('jax.numpy', 'jnp')}(...) executed at import "
+                "time initializes the JAX backend as an import side effect; "
+                "move into a function or use numpy",
+            )
+
+        # GC006: bare lock construction in ingest code.
+        if name in _LOCK_CTORS and not self._has_lock_order_comment(
+            node.lineno
+        ):
+            self.emit(
+                "GC006",
+                node,
+                f"{name}() in ingest code without the lock-ordering idiom; "
+                "add a `# lock order: ...` comment on or just above this "
+                "line stating what may be held when taking it",
+            )
+
+        # GC007: per-iteration device sync.
+        if self._loop_depth > 0:
+            syncs = name == "jax.block_until_ready" or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready"
+            )
+            if syncs:
+                self.emit(
+                    "GC007",
+                    node,
+                    "block_until_ready inside a loop serializes dispatch "
+                    "against compute; sync once after the loop or bound "
+                    "the in-flight window",
+                )
+
+        # GC008: trace-time print under jit.
+        if self._jit_stack and name == "print":
+            self.emit(
+                "GC008",
+                node,
+                f"print() inside jitted {self._jit_stack[-1].fn_name!r} "
+                "runs at trace time with tracers; use jax.debug.print",
+            )
+
+        # GC001: implicit device→host sync in hot paths.
+        self._check_host_sink(node, name)
+
+        # .item() on anything in a hot path is a per-call sync.
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "item"
+            and not node.args
+            and not node.keywords
+        ):
+            self.emit(
+                "GC001",
+                node,
+                ".item() forces a device→host sync per call in hot-path "
+                "code; batch values and fetch once (see "
+                "parallel/mesh.py:packed_host_fetch)",
+            )
+
+        self.generic_visit(node)
+
+    def _check_host_sink(self, node: ast.Call, name: Optional[str]) -> None:
+        if name not in _HOST_SINKS or len(node.args) != 1:
+            return
+        arg = node.args[0]
+        jnp_value = _is_jnp_rooted(arg, self.alias) or (
+            isinstance(arg, ast.Name)
+            and any(arg.id in scope for scope in self._jnp_names)
+        )
+        if jnp_value:
+            self.emit(
+                "GC001",
+                node,
+                f"{name}() on a jnp value forces an implicit device→host "
+                "sync in hot-path code; keep the value on device or batch "
+                "the fetch (parallel/mesh.py:packed_host_fetch)",
+            )
+
+
+def lint_source(
+    source: str, relpath: str, honor_disables: bool = True
+) -> List[Finding]:
+    """Lint one file's text; ``relpath`` (package-relative, '/'-separated)
+    drives rule scoping. Returns findings sorted by (line, rule)."""
+    tree = ast.parse(source, filename=relpath)
+    alias = _collect_aliases(tree)
+    visitor = _LintVisitor(relpath, source.splitlines(), alias)
+    visitor.visit(tree)
+    findings = visitor.findings
+    if honor_disables:
+        per_line, whole_file = parse_disables(source)
+        findings = apply_disables(findings, per_line, whole_file)
+    return sorted(findings, key=lambda f: (f.line, f.rule_id, f.col))
+
+
+def _package_relpath(path: str) -> str:
+    """Scope-resolvable relpath of one file: relative to the topmost
+    enclosing package root (the highest ancestor chain of directories that
+    all carry ``__init__.py``), so ``graftcheck lint <pkg>/ops/gramian.py``
+    sees the same ``ops/gramian.py`` relpath — and therefore the same
+    scoped rules — as a whole-tree lint."""
+    path = os.path.abspath(path)
+    top = cur = os.path.dirname(path)
+    while os.path.exists(os.path.join(cur, "__init__.py")):
+        top = cur  # the highest dir that is itself a package
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            break
+        cur = parent
+    return os.path.relpath(path, top).replace(os.sep, "/")
+
+
+def _iter_py_files(root: str) -> Iterable[Tuple[str, str]]:
+    """Yield ``(abs_path, relpath)`` for package .py files under ``root``
+    (or the single file itself), skipping caches."""
+    if os.path.isfile(root):
+        yield root, _package_relpath(root)
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [
+            d for d in dirnames if d not in ("__pycache__", ".git")
+        ]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                full = os.path.join(dirpath, fn)
+                yield full, os.path.relpath(full, root).replace(os.sep, "/")
+
+
+def lint_paths(paths: Sequence[str]) -> Tuple[List[Finding], int]:
+    """Lint files/trees; returns ``(findings, files_checked)``."""
+    findings: List[Finding] = []
+    checked = 0
+    for root in paths:
+        for full, relpath in _iter_py_files(root):
+            with open(full, "r", encoding="utf-8") as f:
+                source = f.read()
+            try:
+                findings.extend(lint_source(source, relpath))
+            except SyntaxError as e:
+                findings.append(
+                    Finding(
+                        "GC000",
+                        relpath,
+                        e.lineno or 0,
+                        (e.offset or 0),
+                        f"syntax error: {e.msg}",
+                    )
+                )
+            checked += 1
+    return findings, checked
+
+
+def json_report(findings: Sequence[Finding], checked: int) -> str:
+    """Machine-readable report (one stable schema for CI tooling)."""
+    return json.dumps(
+        {
+            "tool": "graftcheck",
+            "checked_files": checked,
+            "finding_count": len(findings),
+            "findings": [f.to_json() for f in findings],
+        },
+        indent=2,
+    )
+
+
+__all__ = ["lint_source", "lint_paths", "json_report"]

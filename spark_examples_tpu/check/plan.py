@@ -1,0 +1,438 @@
+"""Device-free pipeline plan validation (``graftcheck plan``).
+
+A whole-genome run is hours of wall-clock; a partition/mesh/dtype config
+error that only surfaces at the finalize reduce (or at the first sharded
+flush) wastes all of it. This module dry-runs a full flag configuration
+*statically*:
+
+- flag grammar and cross-flag contracts are parsed through the REAL parser
+  (``config.build_pca_parser`` / ``PcaConf._from_namespace`` — never a
+  drifted copy);
+- mesh/partition geometry is checked arithmetically against a *declared*
+  device count (``--plan-devices``), so the validator runs on a dev box
+  with zero accelerators;
+- the actual jitted Gramian update kernels are traced with
+  ``jax.eval_shape`` over ``ShapeDtypeStruct`` operands — and, for the
+  sharded strategy, through ``shard_map`` over an ``AbstractMesh`` — so
+  ingest-block → accumulator shape/dtype agreement is proven by the same
+  code that will run, without touching a device or allocating a byte.
+
+Exit contract (``check/cli.py``): 0 = plan OK (warnings allowed),
+2 = plan rejected with at least one error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from spark_examples_tpu.config import PcaConf, build_pca_parser
+
+
+@dataclass
+class PlanIssue:
+    """One validation result: ``severity`` is 'error' (plan rejected) or
+    'warning' (plan runs, but something is off-contract or wasteful)."""
+
+    code: str
+    severity: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.severity.upper()} [{self.code}] {self.message}"
+
+
+@dataclass
+class PlanReport:
+    issues: List[PlanIssue] = field(default_factory=list)
+    #: Resolved geometry facts (mesh shape, shard count, padded cohort, ...).
+    geometry: Dict[str, object] = field(default_factory=dict)
+    #: eval_shape-verified kernel signatures, for the human report.
+    shape_checks: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not any(i.severity == "error" for i in self.issues)
+
+    def error(self, code: str, message: str) -> None:
+        self.issues.append(PlanIssue(code, "error", message))
+
+    def warn(self, code: str, message: str) -> None:
+        self.issues.append(PlanIssue(code, "warning", message))
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "tool": "graftcheck-plan",
+                "ok": self.ok,
+                "issues": [
+                    {"code": i.code, "severity": i.severity, "message": i.message}
+                    for i in self.issues
+                ],
+                "geometry": self.geometry,
+                "shape_checks": self.shape_checks,
+            },
+            indent=2,
+        )
+
+    def format(self) -> str:
+        lines = []
+        for key, value in self.geometry.items():
+            lines.append(f"  {key}: {value}")
+        for check in self.shape_checks:
+            lines.append(f"  verified: {check}")
+        for issue in self.issues:
+            lines.append(f"  {issue.format()}")
+        verdict = "plan OK" if self.ok else "plan REJECTED"
+        lines.append(verdict)
+        return "\n".join(lines)
+
+
+class _RaisingParser(argparse.ArgumentParser):
+    """argparse whose flag errors raise ``ValueError`` instead of
+    ``SystemExit``-with-usage-text: the plan CLI reports them as
+    machine-readable plan rejections, and in-process callers of
+    ``check.cli.main(['plan', ...])`` get the documented int return.
+    ``-h`` keeps argparse's normal exit."""
+
+    def error(self, message):
+        raise ValueError(message)
+
+
+def parse_plan_args(argv: Sequence[str]):
+    """Parse ``graftcheck plan`` argv: the full PCA flag surface plus the
+    plan-only ``--plan-devices``. Returns ``(conf, plan_devices, json_out)``.
+    Flag errors raise ``ValueError`` (argparse's SystemExit is converted so
+    the caller reports them as plan rejections, not a CLI crash)."""
+    parser = build_pca_parser(
+        _RaisingParser(prog="graftcheck plan", add_help=True)
+    )
+    parser.add_argument(
+        "--plan-devices",
+        type=int,
+        default=None,
+        help=(
+            "Declared device count to validate the mesh against (the "
+            "validator never queries real devices). Unset: device-count "
+            "checks are skipped, geometry/shape checks still run."
+        ),
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="Emit the machine-readable report."
+    )
+    ns = parser.parse_args(list(argv))
+    conf = PcaConf._from_namespace(ns)
+    return conf, ns.plan_devices, ns.json
+
+
+def _resolve_mesh_axes(
+    conf: PcaConf, plan_devices: Optional[int], report: PlanReport
+):
+    """(data, samples) the run would build, mirroring
+    ``pca_driver._make_mesh`` / ``parallel.mesh.default_mesh`` — or None
+    when the mesh is unresolvable (errors recorded)."""
+    from spark_examples_tpu.parallel.mesh import parse_mesh_shape
+
+    if conf.mesh_shape:
+        try:
+            shape = parse_mesh_shape(conf.mesh_shape)
+        except ValueError as e:
+            report.error("mesh-grammar", str(e))
+            return None
+        data, samples = shape["data"], shape["samples"]
+        if data < 1 or samples < 1:
+            report.error(
+                "mesh-axis-size",
+                f"--mesh-shape {conf.mesh_shape}: every axis must be >= 1",
+            )
+            return None
+        if plan_devices is not None and data * samples > plan_devices:
+            report.error(
+                "mesh-exceeds-devices",
+                f"--mesh-shape {conf.mesh_shape} needs {data * samples} "
+                f"devices; --plan-devices declares {plan_devices} "
+                "(make_mesh would raise at run start, after flags parsed "
+                "but potentially after ingest warm-up)",
+            )
+        if data > conf.num_reduce_partitions:
+            # The reference contract (GenomicsConf.scala:35-38 via
+            # BASELINE.json): --num-reduce-partitions BOUNDS the data-axis
+            # parallelism. default_mesh enforces the cap; an explicit mesh
+            # that exceeds it contradicts the flag surface.
+            report.error(
+                "data-axis-exceeds-reduce-partitions",
+                f"--mesh-shape data axis {data} exceeds "
+                f"--num-reduce-partitions {conf.num_reduce_partitions}; "
+                "the reduce-partition flag bounds data parallelism "
+                "(raise it, or shrink the mesh)",
+            )
+        return data, samples
+    # Default mesh: all declared devices data-major, samples axis 1,
+    # data capped by --num-reduce-partitions (parallel/mesh.py:default_mesh).
+    devices = plan_devices if plan_devices is not None else 1
+    data = max(1, min(devices, conf.num_reduce_partitions))
+    return data, 1
+
+
+def _eval_dense_update(report: PlanReport, data: int, conf: PcaConf) -> None:
+    """Trace the real dense-update kernels abstractly: ingest block
+    (B, N) uint8 → bit-packed (D, B, ceil(N/8)) → G (D, N, N)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_examples_tpu.ops.gramian import (
+        _dense_update,
+        _dense_update_counts,
+        data_axis_sum,
+    )
+
+    N = int(conf.num_samples)
+    B = int(conf.block_size)
+    operand = np.int8 if conf.exact_similarity else np.float32
+    accum = jnp.int32 if conf.exact_similarity else jnp.float32
+    G = jax.ShapeDtypeStruct((data, N, N), accum)
+    X_packed = jax.ShapeDtypeStruct((data, B, -(-N // 8)), jnp.uint8)
+    out = jax.eval_shape(
+        lambda g, x: _dense_update(g, x, operand, N), G, X_packed
+    )
+    if out.shape != G.shape or out.dtype != G.dtype:
+        report.error(
+            "dense-update-shape",
+            f"dense Gramian update maps {G.shape}/{G.dtype} to "
+            f"{out.shape}/{out.dtype} — accumulator would diverge",
+        )
+    else:
+        report.shape_checks.append(
+            f"dense update: ({data}, {B}, {N}) uint8 blocks -> "
+            f"G {out.shape} {out.dtype}"
+        )
+    X_counts = jax.ShapeDtypeStruct((data, B, N), jnp.uint8)
+    out_c = jax.eval_shape(
+        lambda g, x: _dense_update_counts(g, x, operand), G, X_counts
+    )
+    if out_c.shape != G.shape or out_c.dtype != G.dtype:
+        report.error(
+            "counts-update-shape",
+            f"count-valued update maps {G.shape} to {out_c.shape}",
+        )
+    final = jax.eval_shape(data_axis_sum, G)
+    if final.shape != (N, N):
+        report.error(
+            "finalize-shape",
+            f"finalize reduce yields {final.shape}, expected {(N, N)}",
+        )
+    else:
+        report.shape_checks.append(
+            f"finalize psum over data axis: {G.shape} -> "
+            f"{final.shape} {final.dtype}"
+        )
+
+
+def _eval_sharded_update(
+    report: PlanReport, data: int, samples: int, conf: PcaConf
+) -> None:
+    """Trace the sharded ring update through shard_map over an
+    ``AbstractMesh`` — the same `_ring_tiles` body the run executes, with
+    the same PartitionSpecs ``ShardedGramianAccumulator`` installs, proven
+    shape-correct with zero devices."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from spark_examples_tpu.ops.gramian import _ring_tiles
+    from spark_examples_tpu.parallel.mesh import DATA_AXIS, SAMPLES_AXIS
+    from spark_examples_tpu.utils.compat import shard_map
+
+    try:
+        from jax.sharding import AbstractMesh
+    except ImportError:
+        report.warn(
+            "no-abstract-mesh",
+            "this jax has no AbstractMesh; sharded-update shape check "
+            "skipped (geometry checks above still hold)",
+        )
+        return
+
+    N = int(conf.num_samples)
+    B = int(conf.block_size)
+    padded = -(-N // samples) * samples
+    if padded != N:
+        report.warn(
+            "cohort-padding",
+            f"--num-samples {N} is not divisible by the samples axis "
+            f"({samples}); the sharded accumulator pads to {padded} "
+            f"(+{(padded - N) * 100.0 / N:.1f}% wasted rows/columns)",
+        )
+    operand = np.int8 if conf.exact_similarity else np.float32
+    accum = jnp.int32 if conf.exact_similarity else jnp.float32
+    mesh = AbstractMesh(((DATA_AXIS, data), (SAMPLES_AXIS, samples)))
+    g_spec = P(DATA_AXIS, SAMPLES_AXIS, None)
+    x_spec = P(DATA_AXIS, None, SAMPLES_AXIS)
+
+    def update(G, X):
+        def per_slice(G_local, X_local):
+            return _ring_tiles(
+                G_local[0], X_local[0], SAMPLES_AXIS, operand
+            )[None]
+
+        return shard_map(
+            per_slice, mesh=mesh, in_specs=(g_spec, x_spec), out_specs=g_spec
+        )(G, X)
+
+    G = jax.ShapeDtypeStruct((data, padded, padded), accum)
+    X = jax.ShapeDtypeStruct((data, B, padded), jnp.uint8)
+    try:
+        out = jax.eval_shape(update, G, X)
+    except Exception as e:
+        report.error(
+            "sharded-update-trace",
+            f"sharded ring update fails to trace on a "
+            f"{data}x{samples} abstract mesh: {e}",
+        )
+        return
+    if out.shape != G.shape or out.dtype != G.dtype:
+        report.error(
+            "sharded-update-shape",
+            f"sharded update maps {G.shape} to {out.shape}",
+        )
+    else:
+        report.shape_checks.append(
+            f"sharded ring update over abstract {data}x{samples} mesh: "
+            f"({data}, {B}, {padded}) uint8 blocks -> G {out.shape} {out.dtype}"
+        )
+
+
+def validate_plan(
+    conf: PcaConf, plan_devices: Optional[int] = None
+) -> PlanReport:
+    """Statically validate one pipeline configuration. Pure flag/geometry
+    arithmetic plus abstract kernel traces — no device is queried."""
+    report = PlanReport()
+
+    # ---------------------------------------------------------- flag sanity
+    if conf.num_reduce_partitions < 1:
+        report.error(
+            "reduce-partitions",
+            f"--num-reduce-partitions must be >= 1, got "
+            f"{conf.num_reduce_partitions}",
+        )
+    if conf.bases_per_partition <= 0:
+        report.error(
+            "bases-per-partition",
+            f"--bases-per-partition must be positive, got "
+            f"{conf.bases_per_partition} (shard enumeration would reject it)",
+        )
+    if conf.block_size < 1:
+        report.error(
+            "block-size", f"--block-size must be >= 1, got {conf.block_size}"
+        )
+    if conf.num_pc < 1:
+        report.error("num-pc", f"--num-pc must be >= 1, got {conf.num_pc}")
+    elif conf.num_pc > conf.num_samples:
+        report.error(
+            "num-pc-exceeds-cohort",
+            f"--num-pc {conf.num_pc} exceeds the cohort size "
+            f"{conf.num_samples}: the eigensolve cannot produce more "
+            "components than samples",
+        )
+    if conf.ingest == "device" and conf.source != "synthetic":
+        report.error(
+            "device-ingest-source",
+            f"--ingest device requires --source synthetic "
+            f"(got --source {conf.source}); the fused on-device generator "
+            "has no data plane for file/REST inputs",
+        )
+    if conf.ingest == "device" and conf.pca_backend != "tpu":
+        report.error(
+            "device-ingest-backend",
+            "--ingest device requires --pca-backend tpu",
+        )
+
+    # -------------------------------------------------------- shard windows
+    n_shards: Optional[int] = None
+    if not conf.all_references and conf.bases_per_partition > 0:
+        try:
+            contig_lists = conf.get_references()
+        except (ValueError, TypeError) as e:
+            report.error("references-grammar", f"--references: {e}")
+        else:
+            n_shards = sum(
+                len(contig.get_shards(conf.bases_per_partition))
+                for contigs in contig_lists
+                for contig in contigs
+            )
+            report.geometry["shard_windows"] = n_shards
+            if n_shards == 0:
+                report.error(
+                    "no-shards",
+                    "--references yields zero shard windows: nothing to "
+                    "ingest",
+                )
+
+    # ------------------------------------------------------------- the mesh
+    axes = _resolve_mesh_axes(conf, plan_devices, report)
+    if axes is None:
+        return report
+    data, samples = axes
+    report.geometry["mesh"] = f"data={data}, samples={samples}"
+    report.geometry["devices_needed"] = data * samples
+
+    sharded = conf.similarity_strategy == "sharded"
+    if sharded and samples < 2:
+        report.error(
+            "sharded-needs-samples-axis",
+            "--similarity-strategy sharded needs a mesh samples axis of at "
+            f"least 2, resolved mesh has samples={samples} "
+            "(use --mesh-shape data,samples)",
+        )
+    if n_shards is not None and n_shards < data:
+        report.warn(
+            "data-axis-starvation",
+            f"only {n_shards} shard window(s) feed a data axis of {data}; "
+            "blocks stripe across the staging buffer so devices still "
+            "receive work, but the data-parallel speedup is bounded by "
+            "the window count",
+        )
+
+    # ----------------------------------------- abstract kernel shape proofs
+    if conf.pca_backend == "tpu":
+        if report.ok:
+            _eval_dense_update(report, data, conf)
+        if report.ok and (sharded or samples >= 2):
+            _eval_sharded_update(report, data, samples, conf)
+
+    # --------------------------------------------------- memory feasibility
+    from spark_examples_tpu.ops.gramian import (
+        _DEFAULT_DEVICE_BYTES,
+        _DENSE_BUFFERS,
+        DENSE_HBM_FRACTION,
+    )
+
+    N = int(conf.num_samples)
+    accum_bytes = 4
+    dense_need = _DENSE_BUFFERS * N * N * accum_bytes
+    report.geometry["dense_accumulator_bytes_per_device"] = N * N * accum_bytes
+    staging = data * conf.block_size * N
+    report.geometry["host_staging_bytes"] = staging
+    if not sharded and conf.similarity_strategy == "dense":
+        # Explicit dense: validate against the default HBM budget (the
+        # validator must not query real devices; the run's auto rule reads
+        # memory_stats when available). Auto configs fall back to sharded
+        # at run time, so only the EXPLICIT flag can be infeasible.
+        if dense_need > DENSE_HBM_FRACTION * _DEFAULT_DEVICE_BYTES:
+            report.error(
+                "dense-exceeds-hbm",
+                f"--similarity-strategy dense with N={N} needs ~"
+                f"{dense_need / (1 << 30):.1f} GiB of working buffers per "
+                f"device, past {DENSE_HBM_FRACTION:.0%} of the "
+                f"{_DEFAULT_DEVICE_BYTES >> 30} GiB default budget; use "
+                "the sharded strategy (and a samples axis)",
+            )
+    return report
+
+
+__all__ = ["PlanIssue", "PlanReport", "parse_plan_args", "validate_plan"]

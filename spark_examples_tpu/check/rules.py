@@ -1,0 +1,225 @@
+"""The graftcheck rule catalogue.
+
+Each rule is one silent-failure class of this codebase's hot paths: the
+linter (``linter.py``) walks the package AST and anchors findings to these
+IDs. Scope globs keep repo-tuned rules out of code where the pattern is
+legitimate (e.g. host-sync calls are fine in tests and the host oracle).
+
+Adding a rule (see DESIGN.md §"graftcheck"):
+
+1. register a :class:`Rule` here with a fresh ``GCnnn`` id;
+2. implement its visitor hook in ``linter.py:_LintVisitor`` (emit via
+   ``self.emit(RULE_ID, node, detail)``);
+3. add a violation fixture + a clean fixture to
+   ``tests/test_graftcheck.py`` asserting the id and line number.
+
+Every rule honors the escape hatch::
+
+    something_flagged()  # graftcheck: disable=GC001  -- justification
+
+on the finding's line, or ``# graftcheck: disable-file=GC001`` anywhere in
+the file (comma-separate multiple ids; ``disable=all`` silences the line).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+#: Directories (package-relative glob prefixes) that are "hot path" for
+#: device-sync rules: per-block work that runs once per genotype block or
+#: per shard, where one stray sync serializes the pipeline.
+HOT_PATH_GLOBS = ("ops/*", "pipeline/*")
+
+#: Ingest-concurrency scope: modules where threads share parse state, so
+#: bare lock creation must carry the documented lock-ordering idiom
+#: (a ``# lock order:`` comment on or just above the creation line).
+INGEST_GLOBS = ("sources/*", "pipeline/datasets.py", "utils/native.py")
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One lint rule: identity, scope, and the one-line rationale."""
+
+    id: str
+    name: str
+    summary: str
+    #: Package-relative path globs the rule applies to; empty = everywhere.
+    scope: Tuple[str, ...] = ()
+
+    def applies_to(self, relpath: str) -> bool:
+        if not self.scope:
+            return True
+        return any(fnmatch.fnmatch(relpath, g) for g in self.scope)
+
+
+RULES: Dict[str, Rule] = {
+    rule.id: rule
+    for rule in [
+        Rule(
+            "GC000",
+            "unparseable-file",
+            "The file does not parse as Python; the linter cannot vouch "
+            "for it (and neither can the interpreter).",
+        ),
+        Rule(
+            "GC001",
+            "host-sync-in-hot-path",
+            "Implicit device→host sync (.item()/float()/int()/np.asarray on "
+            "a jnp value) inside per-block hot-path code stalls the dispatch "
+            "pipeline once per call.",
+            scope=HOT_PATH_GLOBS,
+        ),
+        Rule(
+            "GC002",
+            "python-branch-on-traced",
+            "Python if/while on a traced value inside a jitted function "
+            "raises TracerBoolConversionError at runtime (or silently "
+            "specializes); use lax.cond/lax.while_loop or mark the argument "
+            "static.",
+        ),
+        Rule(
+            "GC003",
+            "jit-in-loop",
+            "jax.jit constructed inside a loop builds a fresh cache entry "
+            "per iteration — a recompilation storm; hoist the jit (or "
+            "functools.partial it) out of the loop.",
+        ),
+        Rule(
+            "GC004",
+            "jnp-at-import-time",
+            "jnp.* executed at module import time initializes the backend "
+            "(and can allocate device memory) as a side effect of `import`; "
+            "move it into a function or use numpy for module constants.",
+        ),
+        Rule(
+            "GC005",
+            "accumulator-update-without-donation",
+            "A jitted accumulator update without donate_argnums holds two "
+            "live copies of the accumulator per step; donate the buffer or "
+            "document why not (e.g. measured pipelining win).",
+            scope=("ops/*",),
+        ),
+        Rule(
+            "GC006",
+            "undocumented-lock-in-ingest",
+            "A bare threading lock in ingest code without the documented "
+            "lock-ordering idiom (`# lock order:` comment) — the "
+            "GIL-released parse pool makes ordering violations real "
+            "deadlocks, not theoretical ones.",
+            scope=INGEST_GLOBS,
+        ),
+        Rule(
+            "GC007",
+            "sync-inside-loop",
+            "block_until_ready inside a loop syncs every iteration, "
+            "serializing dispatch against compute; sync once after the "
+            "loop, or bound the in-flight window instead.",
+            scope=HOT_PATH_GLOBS,
+        ),
+        Rule(
+            "GC008",
+            "print-under-jit",
+            "print() inside a jitted function runs at trace time only "
+            "(once per compilation, with tracers, not values); use "
+            "jax.debug.print for runtime values.",
+        ),
+    ]
+}
+
+
+@dataclass
+class Finding:
+    """One lint finding, JSON-serializable for the machine report."""
+
+    rule_id: str
+    path: str
+    line: int
+    col: int
+    detail: str
+
+    @property
+    def rule(self) -> Rule:
+        return RULES[self.rule_id]
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule_id} "
+            f"[{self.rule.name}] {self.detail}"
+        )
+
+    def to_json(self) -> Dict:
+        return {
+            "rule": self.rule_id,
+            "name": self.rule.name,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "detail": self.detail,
+        }
+
+
+def parse_disables(
+    source: str,
+) -> Tuple[Dict[int, set], set]:
+    """Extract the escape hatches from source text.
+
+    Returns ``(per_line, whole_file)``: ``per_line`` maps 1-based line
+    numbers to the set of rule ids disabled on that line (``{"all"}``
+    disables every rule), ``whole_file`` is the set disabled for the file.
+    Comment grammar::
+
+        # graftcheck: disable=GC001,GC006  -- optional justification
+        # graftcheck: disable-file=GC004   -- optional justification
+    """
+    per_line: Dict[int, set] = {}
+    whole_file: set = set()
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        marker = "# graftcheck:"
+        at = line.find(marker)
+        if at < 0:
+            continue
+        directive = line[at + len(marker) :].strip()
+        for key, sink in (("disable-file=", whole_file), ("disable=", None)):
+            if directive.startswith(key):
+                ids = directive[len(key) :].split("--")[0]
+                parsed = {
+                    token.strip()
+                    for token in ids.split(",")
+                    if token.strip()
+                }
+                if sink is None:
+                    per_line.setdefault(lineno, set()).update(parsed)
+                else:
+                    sink.update(parsed)
+                break
+    return per_line, whole_file
+
+
+def apply_disables(
+    findings: Sequence[Finding],
+    per_line: Dict[int, set],
+    whole_file: set,
+) -> List[Finding]:
+    """Drop findings silenced by an escape hatch."""
+
+    def silenced(f: Finding) -> bool:
+        if "all" in whole_file or f.rule_id in whole_file:
+            return True
+        ids = per_line.get(f.line, ())
+        return "all" in ids or f.rule_id in ids
+
+    return [f for f in findings if not silenced(f)]
+
+
+__all__ = [
+    "Rule",
+    "Finding",
+    "RULES",
+    "HOT_PATH_GLOBS",
+    "INGEST_GLOBS",
+    "parse_disables",
+    "apply_disables",
+]

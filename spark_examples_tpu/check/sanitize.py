@@ -1,0 +1,123 @@
+"""Sanitizer replay of the native parser (``graftcheck sanitize``).
+
+PR 1 made ``native/vcfparse.cpp`` concurrent: span entry points run
+GIL-released on a thread pool over one shared buffer. Its safety claims —
+no out-of-bounds writes sizing arrays from the pre-scan, no UB in the
+integer/float parsing, no data races between span workers — are exactly
+the claims compilers can *instrument*. This module builds the standalone
+harness (``utils/native.py:build_sanitizer_harness``) under each requested
+sanitizer and replays the deterministic fuzz corpus (``check/corpus.py``)
+through it.
+
+Graceful degradation is part of the contract (``ci.sh --sanitize`` must
+not fail images without a toolchain): no compiler → the run reports a SKIP
+and exits 0, unless ``--strict`` (CI images that are SUPPOSED to have a
+compiler pass it so a silently-missing toolchain cannot masquerade as a
+green sanitizer gate).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+from typing import Dict, List, Optional, Sequence
+
+from spark_examples_tpu.check.corpus import corpus_documents
+
+#: Per-mode runtime options: deterministic, fail-fast, and quiet enough to
+#: read. Leak checking stays off — the harness frees everything it owns,
+#: but the one-time C runtime/locale allocations below it are not ours to
+#: assert on, and the replay's subject is overflows and races, not leaks.
+_SANITIZER_ENV: Dict[str, Dict[str, str]] = {
+    "asan": {"ASAN_OPTIONS": "detect_leaks=0:abort_on_error=0:exitcode=99"},
+    "ubsan": {"UBSAN_OPTIONS": "print_stacktrace=1"},
+    "tsan": {"TSAN_OPTIONS": "halt_on_error=1:exitcode=99"},
+}
+
+DEFAULT_MODES = ("asan", "ubsan", "tsan")
+
+
+def replay_corpus(
+    mode: str, corpus: Optional[Sequence[bytes]] = None, timeout: float = 300.0
+) -> subprocess.CompletedProcess:
+    """Build the ``mode`` harness and replay the corpus through it in one
+    subprocess. Raises ``RuntimeError`` when the harness cannot build."""
+    from spark_examples_tpu.utils.native import build_sanitizer_harness
+
+    harness = build_sanitizer_harness(mode)
+    docs = corpus_documents() if corpus is None else list(corpus)
+    with tempfile.TemporaryDirectory(prefix=f"graftcheck-{mode}-") as d:
+        paths: List[str] = []
+        for i, doc in enumerate(docs):
+            path = os.path.join(d, f"corpus-{i:03d}.vcf")
+            with open(path, "wb") as f:
+                f.write(doc)
+            paths.append(path)
+        env = dict(os.environ)
+        env.update(_SANITIZER_ENV.get(mode, {}))
+        return subprocess.run(
+            [harness, *paths],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            env=env,
+        )
+
+
+def run_sanitize(
+    modes: Sequence[str] = DEFAULT_MODES, strict: bool = False
+) -> int:
+    """Replay the corpus under each sanitizer mode; returns the exit code
+    for the CLI (0 = clean or skipped, 1 = violations, 2 = infra failure
+    under --strict)."""
+    from spark_examples_tpu.utils.native import _compiler
+
+    if _compiler() is None:
+        message = (
+            "graftcheck sanitize: SKIP (no C++ compiler on PATH; the "
+            "native layer itself falls back to pure Python on this image)"
+        )
+        print(message)
+        return 2 if strict else 0
+    n_docs = len(corpus_documents())
+    failures = 0
+    for mode in modes:
+        try:
+            proc = replay_corpus(mode)
+        except subprocess.TimeoutExpired as e:
+            # A hung harness IS the bug class this stage hunts (e.g. a
+            # lock-order deadlock in the span entry points): a per-mode
+            # FAIL, never a traceback that aborts the remaining modes.
+            failures += 1
+            print(
+                f"graftcheck sanitize[{mode}]: FAIL (harness hung past "
+                f"{e.timeout:.0f}s — deadlock suspected)"
+            )
+            continue
+        except RuntimeError as e:
+            # A present compiler that cannot produce this mode (e.g. no
+            # tsan runtime) is a per-mode skip, not a failure — unless the
+            # operator demanded the full matrix.
+            print(f"graftcheck sanitize[{mode}]: SKIP ({e})")
+            if strict:
+                failures += 1
+            continue
+        if proc.returncode == 0:
+            print(
+                f"graftcheck sanitize[{mode}]: OK — {n_docs} corpus "
+                "documents replayed clean"
+            )
+        else:
+            failures += 1
+            print(
+                f"graftcheck sanitize[{mode}]: FAIL "
+                f"(exit {proc.returncode})"
+            )
+            tail = (proc.stderr or proc.stdout or "").strip()
+            if tail:
+                print(tail[-4000:])
+    return 1 if failures else 0
+
+
+__all__ = ["DEFAULT_MODES", "replay_corpus", "run_sanitize"]

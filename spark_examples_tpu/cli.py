@@ -64,8 +64,17 @@ def _reads_cmd(run_fn, readset_params: Sequence[str]):
     return invoke
 
 
+def _graftcheck(argv):
+    # Static analysis must not pay (or trigger) backend/platform/cache
+    # configuration — dispatched before the real-command setup in main().
+    from spark_examples_tpu.check.cli import main as graftcheck_main
+
+    return graftcheck_main(argv)
+
+
 COMMANDS = {
     "variants-pca": lambda argv: pca_driver.run(argv),
+    "graftcheck": _graftcheck,
     "search-variants-klotho": _variants_cmd(variants_examples.run_klotho),
     "search-variants-brca1": _variants_cmd(variants_examples.run_brca1),
     "search-reads-example-1": _reads_cmd(reads_examples.run_example1, ["readset"]),
@@ -89,6 +98,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if command not in COMMANDS:
         print(f"unknown command: {command}", file=sys.stderr)
         return 2
+    if command == "graftcheck":
+        # Analysis-only: no platform override, no compile cache — lint and
+        # plan must run identically on devices-free CI boxes, and their
+        # exit codes gate ci.sh stages.
+        return int(COMMANDS[command](rest))
     # After the help/unknown early-outs: only real commands pay (and benefit
     # from) the process-global platform/cache configuration.
     from spark_examples_tpu.parallel.mesh import apply_platform_override

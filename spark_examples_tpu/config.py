@@ -279,6 +279,119 @@ class GenomicsConf:
         return [parse_contigs(spec) for spec in self.references.split(";")]
 
 
+def build_pca_parser(
+    parser: Optional[argparse.ArgumentParser] = None,
+) -> argparse.ArgumentParser:
+    """The full PCA flag surface on one parser — shared by
+    :meth:`PcaConf.parse` and the device-free plan validator
+    (``check/plan.py``), so ``graftcheck plan`` validates exactly the
+    grammar the real run parses, never a drifted copy."""
+    parser = _build_base_parser(parser or argparse.ArgumentParser())
+    parser.add_argument(
+        "--all-references",
+        action="store_true",
+        help=(
+            "Use all references (except X and Y) to compute PCA "
+            "(overrides --references)."
+        ),
+    )
+    parser.add_argument("--debug-datasets", action="store_true")
+    parser.add_argument("--min-allele-frequency", type=float, default=None)
+    parser.add_argument("--num-pc", type=int, default=2)
+    parser.add_argument(
+        "--pca-backend",
+        choices=["tpu", "host"],
+        default="tpu",
+        help="Similarity/PCA compute path: device pipeline or NumPy host path.",
+    )
+    parser.add_argument(
+        "--mesh-shape",
+        default=None,
+        help="Device mesh as 'data,samples' (e.g. '4,2'). Default: all "
+        "devices on the data axis, capped by --num-reduce-partitions.",
+    )
+    parser.add_argument(
+        "--block-size",
+        type=int,
+        default=1024,
+        help="Variants per device block in the Gramian accumulation.",
+    )
+    parser.add_argument(
+        "--ingest",
+        choices=["auto", "device", "packed", "wire"],
+        default="auto",
+        help=(
+            "Genotype ingest path: 'device' generates the synthetic data "
+            "plane on the TPU fused with the Gramian (fastest; synthetic "
+            "source only), 'packed' builds dense blocks on host, 'wire' "
+            "streams full JSON records through the dataset layer. 'auto' "
+            "picks the fastest path valid for the configuration."
+        ),
+    )
+    parser.add_argument(
+        "--blocks-per-dispatch",
+        type=int,
+        default=None,
+        help=(
+            "Device-ingest blocks fused per dispatch (lax.scan length); "
+            "higher amortizes per-dispatch overhead on remote-attached "
+            "backends. Default: auto — constant device work per "
+            "dispatch, so small cohorts get longer scans "
+            "(ops/devicegen.py:auto_blocks_per_dispatch)."
+        ),
+    )
+    parser.add_argument(
+        "--exact-similarity",
+        action="store_true",
+        help=(
+            "Force integer (int8xint8->int32) Gramian accumulation. By "
+            "default the f32-accumulation MXU path is used unless the "
+            "projected per-entry count approaches f32's 2^24 exact-integer "
+            "limit, in which case the integer path is auto-selected."
+        ),
+    )
+    parser.add_argument(
+        "--similarity-strategy",
+        choices=["auto", "dense", "sharded"],
+        default="auto",
+        help=(
+            "Similarity accumulation strategy: 'dense' replicates the NxN "
+            "Gramian per data-parallel device (VariantsPca.scala:210-231); "
+            "'sharded' row-tile-shards it over the mesh samples axis (the "
+            "memory-bounded analog of getSimilarityMatrixStream, "
+            ":288-319). 'auto' picks by cohort size."
+        ),
+    )
+    parser.add_argument(
+        "--num-workers",
+        type=int,
+        default=8,
+        help="Host threads for parallel shard streaming.",
+    )
+    parser.add_argument(
+        "--profile-dir",
+        default=None,
+        help=(
+            "Write a jax.profiler device trace (TensorBoard-loadable) "
+            "here and print per-stage wall-clock timings — the Spark-UI "
+            "stand-in (utils/tracing.py)."
+        ),
+    )
+    parser.add_argument(
+        "--save-variants",
+        default=None,
+        metavar="PATH",
+        help=(
+            "Materialize the ingested variants as a checkpoint directory "
+            "at PATH while the analysis streams (one part file per "
+            "shard), for later --input-path resume without re-ingesting. "
+            "Wire ingest, single variant set (the writer the reference's "
+            "objectFile resume never had, VariantsPca.scala:112-113)."
+        ),
+    )
+    return parser
+
+
 @dataclass
 class PcaConf(GenomicsConf):
     """PCA flags (``GenomicsConf.scala:70-98``)."""
@@ -302,110 +415,7 @@ class PcaConf(GenomicsConf):
 
     @classmethod
     def parse(cls, argv: Sequence[str]) -> "PcaConf":
-        parser = _build_base_parser(argparse.ArgumentParser())
-        parser.add_argument(
-            "--all-references",
-            action="store_true",
-            help=(
-                "Use all references (except X and Y) to compute PCA "
-                "(overrides --references)."
-            ),
-        )
-        parser.add_argument("--debug-datasets", action="store_true")
-        parser.add_argument("--min-allele-frequency", type=float, default=None)
-        parser.add_argument("--num-pc", type=int, default=2)
-        parser.add_argument(
-            "--pca-backend",
-            choices=["tpu", "host"],
-            default="tpu",
-            help="Similarity/PCA compute path: device pipeline or NumPy host path.",
-        )
-        parser.add_argument(
-            "--mesh-shape",
-            default=None,
-            help="Device mesh as 'data,samples' (e.g. '4,2'). Default: all "
-            "devices on the data axis, capped by --num-reduce-partitions.",
-        )
-        parser.add_argument(
-            "--block-size",
-            type=int,
-            default=1024,
-            help="Variants per device block in the Gramian accumulation.",
-        )
-        parser.add_argument(
-            "--ingest",
-            choices=["auto", "device", "packed", "wire"],
-            default="auto",
-            help=(
-                "Genotype ingest path: 'device' generates the synthetic data "
-                "plane on the TPU fused with the Gramian (fastest; synthetic "
-                "source only), 'packed' builds dense blocks on host, 'wire' "
-                "streams full JSON records through the dataset layer. 'auto' "
-                "picks the fastest path valid for the configuration."
-            ),
-        )
-        parser.add_argument(
-            "--blocks-per-dispatch",
-            type=int,
-            default=None,
-            help=(
-                "Device-ingest blocks fused per dispatch (lax.scan length); "
-                "higher amortizes per-dispatch overhead on remote-attached "
-                "backends. Default: auto — constant device work per "
-                "dispatch, so small cohorts get longer scans "
-                "(ops/devicegen.py:auto_blocks_per_dispatch)."
-            ),
-        )
-        parser.add_argument(
-            "--exact-similarity",
-            action="store_true",
-            help=(
-                "Force integer (int8xint8->int32) Gramian accumulation. By "
-                "default the f32-accumulation MXU path is used unless the "
-                "projected per-entry count approaches f32's 2^24 exact-integer "
-                "limit, in which case the integer path is auto-selected."
-            ),
-        )
-        parser.add_argument(
-            "--similarity-strategy",
-            choices=["auto", "dense", "sharded"],
-            default="auto",
-            help=(
-                "Similarity accumulation strategy: 'dense' replicates the NxN "
-                "Gramian per data-parallel device (VariantsPca.scala:210-231); "
-                "'sharded' row-tile-shards it over the mesh samples axis (the "
-                "memory-bounded analog of getSimilarityMatrixStream, "
-                ":288-319). 'auto' picks by cohort size."
-            ),
-        )
-        parser.add_argument(
-            "--num-workers",
-            type=int,
-            default=8,
-            help="Host threads for parallel shard streaming.",
-        )
-        parser.add_argument(
-            "--profile-dir",
-            default=None,
-            help=(
-                "Write a jax.profiler device trace (TensorBoard-loadable) "
-                "here and print per-stage wall-clock timings — the Spark-UI "
-                "stand-in (utils/tracing.py)."
-            ),
-        )
-        parser.add_argument(
-            "--save-variants",
-            default=None,
-            metavar="PATH",
-            help=(
-                "Materialize the ingested variants as a checkpoint directory "
-                "at PATH while the analysis streams (one part file per "
-                "shard), for later --input-path resume without re-ingesting. "
-                "Wire ingest, single variant set (the writer the reference's "
-                "objectFile resume never had, VariantsPca.scala:112-113)."
-            ),
-        )
-        ns = parser.parse_args(list(argv))
+        ns = build_pca_parser().parse_args(list(argv))
         return cls._from_namespace(ns)
 
     def get_contigs(self, source, variant_set_ids: Sequence[str]) -> List[Contig]:
@@ -435,4 +445,4 @@ class PcaConf(GenomicsConf):
         return contigs
 
 
-__all__ = ["GenomicsConf", "PcaConf"]
+__all__ = ["GenomicsConf", "PcaConf", "build_pca_parser"]

@@ -381,7 +381,7 @@ def _fused_update(
         site_key_arr = _c64(site_key)
 
         @jax.jit
-        def update(G, rows_count, kept_count, grid_offset, n_valid):
+        def update(G, rows_count, kept_count, grid_offset, n_valid):  # graftcheck: disable=GC005 -- non-donation matches ops/gramian.py's measured policy (donated-buffer serialization costs ~10x sustained throughput on remote-attached backends); G here is the scan carry, double-buffered by the driver
             block_idx = jnp.arange(K * B, dtype=jnp.int64).reshape(K, B)
 
             def body(carry, idx):

@@ -129,7 +129,7 @@ def _maybe_switch_accumulator(acc, next_bound: int, out_shardings=None) -> bool:
 
 
 @functools.partial(jax.jit, static_argnames=("operand_dtype",))
-def _dense_update_counts(G, X, operand_dtype):
+def _dense_update_counts(G, X, operand_dtype):  # graftcheck: disable=GC005 -- non-donation is the measured win: donating G forces a serializing buffer-reuse pattern, ~10x sustained-throughput loss on remote-attached backends (module docstring; same rationale as _dense_update)
     """G[d] += X[d]ᵀ X[d] for unpacked count-valued uint8 rows (the rare
     same-set-join case where a callset column appears more than once per
     variant — the reference's pair loop adds k² for k duplicates, which is
@@ -141,7 +141,7 @@ def _dense_update_counts(G, X, operand_dtype):
 
 
 @functools.partial(jax.jit, static_argnames=("operand_dtype", "num_samples"))
-def _dense_update(G, X_packed, operand_dtype, num_samples):
+def _dense_update(G, X_packed, operand_dtype, num_samples):  # graftcheck: disable=GC005 -- deliberate: donation serializes buffer reuse, ~10x sustained-throughput loss measured on the v5e tunnel (see docstring below); one extra NxN buffer is the cheaper trade
     """G[d] += X[d]ᵀ X[d] — local per data-slice, no communication.
 
     X arrives BIT-PACKED (8 genotypes/byte over PCIe/DCN — ⅛ the traffic of
@@ -448,7 +448,7 @@ class ShardedGramianAccumulator:
         mesh, g_spec, x_spec = self.mesh, self._g_spec, self._x_spec
 
         @jax.jit
-        def update(G, X):
+        def update(G, X):  # graftcheck: disable=GC005 -- same non-donation policy as _dense_update (measured ~10x throughput loss from donated-buffer serialization); the pipeline holds prior G references, which donation would invalidate
             def per_slice(G_local, X_local):
                 # Leading data-axis dim is size 1 locally; drop it.
                 return _ring_tiles(

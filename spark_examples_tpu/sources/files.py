@@ -34,6 +34,7 @@ import json
 import os
 import re
 import threading
+import warnings
 from bisect import bisect_left, bisect_right
 from collections import deque
 
@@ -773,6 +774,15 @@ def _contig_runs(contigs: np.ndarray) -> Iterator[Tuple[str, slice]]:
         yield str(contigs[lo]), slice(lo, hi)
 
 
+class UnsortedVcfError(ValueError):
+    """A streaming pass met records out of coordinate order. Explicitly
+    requested streaming (``--stream-chunk-bytes N``) surfaces this as the
+    hard error it is; AUTO-selected streaming catches it and falls back to
+    the in-memory path with a warning (``FileGenomicsSource``) — the
+    size heuristic must not turn a file that loaded fine before the
+    threshold existed into a hard failure."""
+
+
 class _RunOrderCheck:
     """Coordinate-sortedness guard for one streaming pass: each contig's
     records must be contiguous and non-decreasing in position (the standard
@@ -790,7 +800,7 @@ class _RunOrderCheck:
             if self.current is not None:
                 self.finished.add(self.current)
             if name in self.finished:
-                raise ValueError(
+                raise UnsortedVcfError(
                     f"{self.path}: records for contig {name!r} are not "
                     "contiguous — streaming ingest needs a coordinate-sorted "
                     "VCF; sort the input or disable streaming "
@@ -803,7 +813,7 @@ class _RunOrderCheck:
         if int(positions[0]) < self.last_pos or (
             len(positions) > 1 and np.any(np.diff(positions) < 0)
         ):
-            raise ValueError(
+            raise UnsortedVcfError(
                 f"{self.path}: contig {name!r} positions are not sorted — "
                 "streaming ingest needs a coordinate-sorted VCF; sort the "
                 "input or disable streaming (--stream-chunk-bytes 0)"
@@ -1081,6 +1091,12 @@ class FileGenomicsSource(GenomicsSource):
         #: from a worker thread mid-parse.
         self.ingest_workers = ingest_workers
         _resolve_ingest_workers(ingest_workers)
+        #: Sets whose AUTO-selected streaming failed the coordinate-order
+        #: probe and fell back to the in-memory path (with a warning).
+        self._no_stream: set = set()
+        # lock order: leaf lock guarding the parsed-view caches; held only
+        # around dict get/insert (parses happen inside, but never take
+        # another lock — the parse pool's workers are lock-free).
         self._lock = threading.Lock()
 
     def _table(self, set_id: str) -> _FileTable:
@@ -1112,11 +1128,15 @@ class FileGenomicsSource(GenomicsSource):
         """Whether this set's packed ingest should stream (bounded memory)
         rather than load: explicit via ``stream_chunk_bytes`` (0 = never,
         > 0 = always), else automatic past ``STREAM_THRESHOLD_BYTES``.
-        Only VCFs stream; other formats keep the in-memory tables."""
+        Only VCFs stream; other formats keep the in-memory tables. Sets
+        whose auto-selected streaming already failed the sortedness probe
+        report False (they fell back to the in-memory path)."""
         if not self._is_vcf(set_id):
             return False
         if self.stream_chunk_bytes is not None:
             return self.stream_chunk_bytes > 0
+        if set_id in self._no_stream:
+            return False
         path = self._by_id[set_id]
         try:
             size = os.path.getsize(path)
@@ -1147,6 +1167,78 @@ class FileGenomicsSource(GenomicsSource):
                 self._streamed[set_id] = view
             return view
 
+    def _auto_stream_verified(self, set_id: str) -> bool:
+        """The ADVICE.md sharp-edge fix: AUTO-selected streaming verifies
+        coordinate-sortedness up front (a cached site-only pass — the same
+        scan lazy contig discovery runs, O(chunk) memory, no genotype walk)
+        instead of hard-erroring mid-ingest. An unsorted file warns and
+        falls back to the in-memory path; EXPLICIT ``--stream-chunk-bytes N``
+        skips the probe and keeps the hard error (the flag asserts the
+        input is sorted; a silent O(file) fallback would betray exactly the
+        memory bound the user demanded)."""
+        if self.stream_chunk_bytes is not None:
+            return True  # explicit: trusted, hard error downstream
+        if set_id in self._no_stream:
+            return False
+        try:
+            # Runs (and caches) the order-checked site scan; sorted files
+            # reuse the result for contig discovery.
+            self.streamed(set_id).contig_bounds()
+        except UnsortedVcfError as e:
+            warnings.warn(
+                f"auto-selected streaming ingest found an unsorted VCF "
+                f"({e}); falling back to the in-memory parse — peak host "
+                "memory is O(file), not O(chunk). Sort the input to "
+                "restore bounded-memory streaming, or pass "
+                "--stream-chunk-bytes 0 to choose the in-memory path "
+                "explicitly and skip this probe.",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+            with self._lock:
+                self._no_stream.add(set_id)
+                self._streamed.pop(set_id, None)
+            return False
+        return True
+
+    def _packed_blocks(
+        self,
+        view: "_PackedVcf",
+        shard: Contig,
+        block_size: int,
+        min_allele_frequency: Optional[float],
+        counters: Optional[StreamCounters] = None,
+        shard_index: Optional[int] = None,
+    ) -> Iterator[Dict]:
+        """Dense blocks for ONE shard window from the in-memory packed
+        view — the shared body of the packed fast path and the unsorted-VCF
+        fallback (whose ``counters`` must match what the streaming pass
+        would have recorded: pre-filter rows per shard, post-filter kept
+        variants)."""
+        positions, af, hv = view.window(shard)
+        if counters is not None and shard_index is not None and len(positions):
+            counters.shard_rows[shard_index] = counters.shard_rows.get(
+                shard_index, 0
+            ) + len(positions)
+        if min_allele_frequency is not None:
+            # The reference's rule (``VariantsPca.scala:136-148``): strictly
+            # greater, first AF value, records without AF dropped (NaN here;
+            # NaN > t is False, so absent/unparseable AF never passes).
+            keep = af > min_allele_frequency
+            positions, af, hv = positions[keep], af[keep], hv[keep]
+        for off in range(0, len(positions), block_size):
+            hv_block = hv[off : off + block_size]
+            nonzero = hv_block.any(axis=1)
+            if not nonzero.any():
+                continue
+            if counters is not None:
+                counters.variants += int(nonzero.sum())
+            yield {
+                "positions": positions[off : off + block_size][nonzero],
+                "has_variation": hv_block[nonzero].astype(np.uint8),
+                "af": af[off : off + block_size][nonzero],
+            }
+
     def stream_genotype_blocks(
         self,
         variant_set_id: str,
@@ -1156,13 +1248,31 @@ class FileGenomicsSource(GenomicsSource):
         counters: Optional[StreamCounters] = None,
     ) -> Iterator[Dict]:
         """One bounded-memory pass serving EVERY shard window (file order;
-        the Gramian sum commutes). See ``_StreamedVcf.stream_blocks``."""
-        return self.streamed(variant_set_id).stream_blocks(
-            shards,
-            block_size=block_size,
-            min_allele_frequency=min_allele_frequency,
-            counters=counters,
-        )
+        the Gramian sum commutes). See ``_StreamedVcf.stream_blocks``.
+
+        When the set was auto-selected for streaming but fails the
+        sortedness probe (:meth:`_auto_stream_verified`), the same block
+        stream — identical dicts, identical counter accounting — is served
+        from the in-memory packed view instead, so a caller that already
+        chose the streaming path degrades without re-planning."""
+        if self._auto_stream_verified(variant_set_id):
+            yield from self.streamed(variant_set_id).stream_blocks(
+                shards,
+                block_size=block_size,
+                min_allele_frequency=min_allele_frequency,
+                counters=counters,
+            )
+            return
+        view = self.packed(variant_set_id)
+        for idx, shard in enumerate(shards):
+            yield from self._packed_blocks(
+                view,
+                shard,
+                block_size,
+                min_allele_frequency,
+                counters=counters,
+                shard_index=idx,
+            )
 
     # ------------------------------------------------------ packed fast path
 
@@ -1204,7 +1314,9 @@ class FileGenomicsSource(GenomicsSource):
         bound. Multi-window callers on streaming sets must use
         :meth:`stream_genotype_blocks`, which serves every window in one
         pass (the driver does)."""
-        if self.wants_streaming(variant_set_id):
+        if self.wants_streaming(variant_set_id) and self._auto_stream_verified(
+            variant_set_id
+        ):
             yield from self.stream_genotype_blocks(
                 variant_set_id,
                 [contig],
@@ -1212,23 +1324,10 @@ class FileGenomicsSource(GenomicsSource):
                 min_allele_frequency=min_allele_frequency,
             )
             return
-        positions, af, hv = self.packed(variant_set_id).window(contig)
-        if min_allele_frequency is not None:
-            # The reference's rule (``VariantsPca.scala:136-148``): strictly
-            # greater, first AF value, records without AF dropped (NaN here;
-            # NaN > t is False, so absent/unparseable AF never passes).
-            keep = af > min_allele_frequency
-            positions, af, hv = positions[keep], af[keep], hv[keep]
-        for off in range(0, len(positions), block_size):
-            hv_block = hv[off : off + block_size]
-            nonzero = hv_block.any(axis=1)
-            if not nonzero.any():
-                continue
-            yield {
-                "positions": positions[off : off + block_size][nonzero],
-                "has_variation": hv_block[nonzero].astype(np.uint8),
-                "af": af[off : off + block_size][nonzero],
-            }
+        yield from self._packed_blocks(
+            self.packed(variant_set_id), contig, block_size,
+            min_allele_frequency,
+        )
 
     def page_requests(
         self, variant_set_id: str, contig: Contig, bases_per_partition: int
@@ -1271,10 +1370,15 @@ class FileGenomicsSource(GenomicsSource):
         lowered = (
             path[:-3] if path and path.endswith(".gz") else (path or "")
         )
-        if self.wants_streaming(variant_set_id):
+        if self.wants_streaming(variant_set_id) and self._auto_stream_verified(
+            variant_set_id
+        ):
             # Lazy discovery: a site-only streaming pass (CHROM/POS/REF —
             # no genotype walk) learns the bounds in O(chunk) memory; the
-            # result matches the packed view's ``contig_bounds``.
+            # result matches the packed view's ``contig_bounds``. The probe
+            # above already ran (and cached) this scan for auto mode;
+            # explicit streaming pays it here, where UnsortedVcfError
+            # remains the documented hard error.
             contigs = [
                 Contig(name, 0, bound)
                 for name, bound in sorted(
@@ -1310,6 +1414,7 @@ __all__ = [
     "FileGenomicsSource",
     "FileClient",
     "StreamCounters",
+    "UnsortedVcfError",
     "af_float",
     "default_ingest_workers",
     "file_set_id",

@@ -70,28 +70,53 @@ def _build_dir() -> str:
     return d
 
 
-def _build(source_path: str) -> str:
-    """Compile one translation unit to a content-addressed .so; returns its
-    path (reusing a previous identical build when present)."""
+#: Sanitizer build modes for the native layer (``graftcheck sanitize``).
+#: Each maps to the compile/link flags of one instrumented build; -O1 keeps
+#: stack traces honest where -O3 would inline them away. UBSan violations
+#: are non-recoverable so a clean exit code MEANS clean.
+SANITIZER_FLAGS = {
+    "asan": ("-fsanitize=address", "-fno-omit-frame-pointer", "-g", "-O1"),
+    "ubsan": (
+        "-fsanitize=undefined",
+        "-fno-sanitize-recover=undefined",
+        "-g",
+        "-O1",
+    ),
+    "tsan": ("-fsanitize=thread", "-g", "-O1"),
+}
+
+
+def _build(
+    source_paths,
+    flags: Tuple[str, ...] = ("-O3", "-shared", "-fPIC"),
+    suffix: str = ".so",
+) -> str:
+    """Compile translation unit(s) to a content-addressed artifact; returns
+    its path (reusing a previous identical build when present). The tag
+    hashes sources + compiler + flags, so a sanitizer build and the release
+    .so coexist in the cache and a flag change rebuilds."""
+    if isinstance(source_paths, str):
+        source_paths = [source_paths]
     compiler = _compiler()
     if compiler is None:
         raise RuntimeError("no C++ compiler on PATH")
-    with open(source_path, "rb") as f:
-        source = f.read()
-    tag = hashlib.sha256(
-        source + compiler.encode() + sys.version.encode()
-    ).hexdigest()[:16]
+    digest = hashlib.sha256()
+    for path in source_paths:
+        with open(path, "rb") as f:
+            digest.update(f.read())
+    digest.update(compiler.encode())
+    digest.update(" ".join(flags).encode())
+    digest.update(sys.version.encode())
+    tag = digest.hexdigest()[:16]
     out = os.path.join(
         _build_dir(),
-        f"{os.path.splitext(os.path.basename(source_path))[0]}-{tag}.so",
+        f"{os.path.splitext(os.path.basename(source_paths[0]))[0]}"
+        f"-{tag}{suffix}",
     )
     if os.path.exists(out):
         return out
     tmp = out + f".build-{os.getpid()}"
-    cmd = [
-        compiler, "-O3", "-shared", "-fPIC", "-std=c++17",
-        "-o", tmp, source_path,
-    ]
+    cmd = [compiler, *flags, "-std=c++17", "-o", tmp, *source_paths]
     proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
     if proc.returncode != 0:
         raise RuntimeError(
@@ -99,6 +124,33 @@ def _build(source_path: str) -> str:
         )
     os.replace(tmp, out)  # atomic: concurrent builders race benignly
     return out
+
+
+def build_sanitizer_harness(mode: str) -> str:
+    """Build the standalone sanitizer replay binary: ``vcfparse.cpp`` +
+    ``native/sanitize_harness.cpp`` under one of :data:`SANITIZER_FLAGS`.
+
+    A standalone executable rather than an instrumented .so: ASan/TSan
+    require their runtime to be the FIRST thing in the process, which a
+    ctypes ``dlopen`` into an uninstrumented CPython cannot guarantee
+    (preload hacks disable the interceptors that matter). The binary also
+    gives TSan a genuine multi-threaded replay of the span entry points —
+    the same concurrency shape as the chunk-parallel ingest engine.
+    Raises ``RuntimeError`` when no compiler is available (callers skip).
+    """
+    if mode not in SANITIZER_FLAGS:
+        raise ValueError(
+            f"unknown sanitizer mode {mode!r}; have {sorted(SANITIZER_FLAGS)}"
+        )
+    sources = [
+        os.path.join(_REPO_NATIVE, "vcfparse.cpp"),
+        os.path.join(_REPO_NATIVE, "sanitize_harness.cpp"),
+    ]
+    for path in sources:
+        if not os.path.exists(path):
+            raise RuntimeError(f"missing native source {path}")
+    flags = SANITIZER_FLAGS[mode] + ("-pthread",)
+    return _build(sources, flags=flags, suffix=f"-{mode}")
 
 
 def vcf_library() -> Optional[ctypes.CDLL]:
@@ -372,6 +424,8 @@ def scan_vcf_sites_chunk(text: bytes):
 
 __all__ = [
     "MalformedVcfLine",
+    "SANITIZER_FLAGS",
+    "build_sanitizer_harness",
     "vcf_library",
     "native_unavailable_reason",
     "parse_vcf_arrays",

@@ -2,11 +2,18 @@
 component where a parsing divergence or memory error would corrupt ingest
 silently — fuzz the whole VCF grammar surface, not just handwritten files).
 
-Lives apart from ``test_files.py`` so the hypothesis dependency (declared
-only under the ``test`` extra) skips THIS module alone on the bare seed
-image instead of erroring the whole handwritten-fixture suite's collection;
-``test_streaming.py`` borrows :func:`_vcf_documents` for its own fuzz test
-under the same guard.
+Two fuzzing tiers share one grammar:
+
+- hypothesis strategies (``_vcf_documents``) explore the grammar randomly —
+  they need the optional ``test`` extra, so they skip (without erroring the
+  module) on the bare seed image; ``test_streaming.py`` borrows
+  ``_vcf_documents`` under the same guard;
+- the DETERMINISTIC corpus (``spark_examples_tpu/check/corpus.py``) pins the
+  same grammar plus handwritten edge documents as a fixed, reproducible
+  set — replayed here through the parity properties on EVERY image, and
+  replayed under ASAN/UBSAN/TSAN by ``graftcheck sanitize`` / ``ci.sh
+  --sanitize`` (the sanitizer tier checks memory/race safety over exactly
+  the documents whose semantics these tests pin).
 """
 
 import os
@@ -15,89 +22,12 @@ import tempfile
 import numpy as np
 import pytest
 
-pytest.importorskip("hypothesis")
+try:
+    from hypothesis import given, settings, strategies as st
 
-from hypothesis import given, settings, strategies as st  # noqa: E402
-
-_gt_alleles = st.one_of(
-    st.just("."),
-    st.integers(min_value=0, max_value=12).map(str),
-)
-_gt_field = st.builds(
-    lambda alleles, sep: sep.join(alleles),
-    st.lists(_gt_alleles, min_size=1, max_size=3),
-    st.sampled_from(["/", "|"]),
-)
-_af_value = st.one_of(
-    st.just("0.5"),
-    st.floats(
-        min_value=0.0, max_value=1.0, allow_nan=False, allow_infinity=False
-    ).map(repr),
-    st.sampled_from(
-        [
-            "1e-3", ".5", "5.", "+0.25", "-0", "0,0.5", "junk", "",
-            "0.2_5", "0.5 ", " 0.5", "0x1A", "inf", "nan", "1e999",
-            "0." + "1" * 70, "0.5" + " " * 61,
-        ]
-    ),
-)
-_info_field = st.one_of(
-    st.just("."),
-    st.just("DB"),
-    st.just("NS=3;DP=14"),
-    _af_value.map(lambda v: f"AF={v}"),
-    _af_value.map(lambda v: f"NS=2;AF={v};DB"),
-    st.just("XAF=9"),  # must NOT match as AF
-)
-_format_field = st.sampled_from(["GT", "GT:DP", "DP:GT", "DP"])
-
-
-@st.composite
-def _vcf_documents(draw):
-    n_samples = draw(st.integers(min_value=0, max_value=5))
-    n_records = draw(st.integers(min_value=0, max_value=12))
-    crlf = draw(st.booleans())
-    lines = ["##fileformat=VCFv4.2"]
-    header = "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT" + "".join(
-        f"\tS{i}" for i in range(n_samples)
-    )
-    # A sample-free VCF has no FORMAT column either.
-    if n_samples == 0:
-        header = header[: header.rindex("\tFORMAT")]
-    lines.append(header)
-    for r in range(n_records):
-        contig = draw(st.sampled_from(["1", "17", "chr2", "X"]))
-        pos = draw(st.integers(min_value=1, max_value=10_000))
-        ref = draw(st.sampled_from(["A", "AT", "GCC"]))
-        fields = [
-            contig,
-            str(pos),
-            draw(st.sampled_from([".", f"rs{r}"])),
-            ref,
-            draw(st.sampled_from([".", "G", "G,T"])),
-            ".",
-            ".",
-            draw(_info_field),
-        ]
-        if n_samples:
-            fmt = draw(_format_field)
-            fields.append(fmt)
-            # Sometimes fewer sample columns than the header declares.
-            n_cols = draw(
-                st.sampled_from([n_samples, max(0, n_samples - 1)])
-            )
-            for _ in range(n_cols):
-                gt = draw(_gt_field)
-                subfields = {
-                    "GT": gt,
-                    "GT:DP": f"{gt}:7",
-                    "DP:GT": f"7:{gt}",
-                    "DP": "7",
-                }[fmt]
-                fields.append(subfields)
-        lines.append("\t".join(fields))
-    eol = "\r\n" if crlf else "\n"
-    return eol.join(lines) + eol
+    HAVE_HYPOTHESIS = True
+except ImportError:  # the bare seed image: deterministic tiers still run
+    HAVE_HYPOTHESIS = False
 
 
 def _group_by_contig(contigs, positions, ends, af, hv):
@@ -116,64 +46,192 @@ def _group_by_contig(contigs, positions, ends, af, hv):
     return out
 
 
-@settings(max_examples=40, deadline=None)
-@given(document=_vcf_documents())
-def test_fuzz_native_parser_matches_python(document):
-    from spark_examples_tpu.sources.files import _python_vcf_arrays
-    from spark_examples_tpu.utils import native as native_mod
-
-    if native_mod.vcf_library() is None:
-        pytest.skip(f"no native build: {native_mod.native_unavailable_reason()}")
-
-    native = native_mod.parse_vcf_arrays(document.encode())
-    fd, path = tempfile.mkstemp(suffix=".vcf")
-    try:
-        with os.fdopen(fd, "w", newline="") as f:
-            f.write(document)
-        python = _python_vcf_arrays(path, "fuzz")
-    finally:
-        os.unlink(path)
-
-    by_native = _group_by_contig(*native)
-    by_python = _group_by_contig(*python)
-    assert set(by_native) == set(by_python)
-    for contig in by_native:
-        pos_n, end_n, af_n, hv_n = by_native[contig]
-        pos_p, end_p, af_p, hv_p = by_python[contig]
-        np.testing.assert_array_equal(pos_n, pos_p)
-        np.testing.assert_array_equal(end_n, end_p)
-        np.testing.assert_array_equal(hv_n, hv_p)
-        np.testing.assert_array_equal(np.isnan(af_n), np.isnan(af_p))
-        np.testing.assert_array_equal(
-            af_n[~np.isnan(af_n)], af_p[~np.isnan(af_p)]
-        )
+def _assert_same_arrays(a, b):
+    """Array-tuple equality with NaN-aware float comparison."""
+    for x, y in zip(a, b):
+        if np.issubdtype(np.asarray(x).dtype, np.floating):
+            np.testing.assert_array_equal(np.isnan(x), np.isnan(y))
+            np.testing.assert_array_equal(x[~np.isnan(x)], y[~np.isnan(y)])
+        else:
+            np.testing.assert_array_equal(x, y)
 
 
-@settings(max_examples=25, deadline=None)
-@given(
-    document=_vcf_documents(),
-    workers=st.sampled_from([2, 3, 5]),
-)
-def test_fuzz_chunk_parallel_parse_matches_serial(document, workers):
-    """Property: for ANY fuzzed VCF document and ANY worker count, the
-    chunk-parallel native parse reassembles the EXACT serial arrays —
-    the parity invariant of the chunk-parallel ingest engine."""
+# ---------------------------------------------------------------------------
+# Deterministic corpus tier: always collected, native build permitting.
+# ---------------------------------------------------------------------------
+
+
+def test_corpus_chunk_parallel_parse_matches_serial():
+    """The chunk-parallel parity invariant over the WHOLE deterministic
+    corpus: for every document — including the malformed and truncated edge
+    cases — the span-parallel parse reproduces the serial outcome exactly
+    (same arrays, or the same file-level malformed-line error)."""
+    from spark_examples_tpu.check.corpus import corpus_documents
     from spark_examples_tpu.sources.files import _native_parallel_vcf_arrays
     from spark_examples_tpu.utils import native as native_mod
 
     if native_mod.vcf_library() is None:
         pytest.skip(f"no native build: {native_mod.native_unavailable_reason()}")
 
-    text = document.encode()
-    serial = native_mod.parse_vcf_arrays(text)
-    parallel = _native_parallel_vcf_arrays(text, workers)
-    assert parallel is not None
-    for a, b in zip(serial, parallel):
-        if np.issubdtype(np.asarray(a).dtype, np.floating):
-            np.testing.assert_array_equal(np.isnan(a), np.isnan(b))
-            np.testing.assert_array_equal(a[~np.isnan(a)], b[~np.isnan(b)])
-        else:
-            np.testing.assert_array_equal(a, b)
+    parity_checked = 0
+    for i, text in enumerate(corpus_documents()):
+        try:
+            serial = native_mod.parse_vcf_arrays(text)
+            serial_error = None
+        except ValueError as e:
+            serial, serial_error = None, e
+        for workers in (2, 5):
+            if serial_error is not None:
+                with pytest.raises(ValueError) as excinfo:
+                    _native_parallel_vcf_arrays(text, workers)
+                if isinstance(serial_error, native_mod.MalformedVcfLine):
+                    assert isinstance(
+                        excinfo.value, native_mod.MalformedVcfLine
+                    ), f"corpus[{i}] workers={workers}"
+                    assert excinfo.value.ordinal == serial_error.ordinal
+                continue
+            parallel = _native_parallel_vcf_arrays(text, workers)
+            assert parallel is not None
+            _assert_same_arrays(serial, parallel)
+            parity_checked += 1
+    assert parity_checked >= 20
+
+
+# ---------------------------------------------------------------------------
+# Hypothesis tier: random exploration of the same grammar (test extra).
+# ---------------------------------------------------------------------------
+
+if HAVE_HYPOTHESIS:
+    _gt_alleles = st.one_of(
+        st.just("."),
+        st.integers(min_value=0, max_value=12).map(str),
+    )
+    _gt_field = st.builds(
+        lambda alleles, sep: sep.join(alleles),
+        st.lists(_gt_alleles, min_size=1, max_size=3),
+        st.sampled_from(["/", "|"]),
+    )
+    _af_value = st.one_of(
+        st.just("0.5"),
+        st.floats(
+            min_value=0.0, max_value=1.0, allow_nan=False, allow_infinity=False
+        ).map(repr),
+        st.sampled_from(
+            [
+                "1e-3", ".5", "5.", "+0.25", "-0", "0,0.5", "junk", "",
+                "0.2_5", "0.5 ", " 0.5", "0x1A", "inf", "nan", "1e999",
+                "0." + "1" * 70, "0.5" + " " * 61,
+            ]
+        ),
+    )
+    _info_field = st.one_of(
+        st.just("."),
+        st.just("DB"),
+        st.just("NS=3;DP=14"),
+        _af_value.map(lambda v: f"AF={v}"),
+        _af_value.map(lambda v: f"NS=2;AF={v};DB"),
+        st.just("XAF=9"),  # must NOT match as AF
+    )
+    _format_field = st.sampled_from(["GT", "GT:DP", "DP:GT", "DP"])
+
+    @st.composite
+    def _vcf_documents(draw):
+        n_samples = draw(st.integers(min_value=0, max_value=5))
+        n_records = draw(st.integers(min_value=0, max_value=12))
+        crlf = draw(st.booleans())
+        lines = ["##fileformat=VCFv4.2"]
+        header = (
+            "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT"
+            + "".join(f"\tS{i}" for i in range(n_samples))
+        )
+        # A sample-free VCF has no FORMAT column either.
+        if n_samples == 0:
+            header = header[: header.rindex("\tFORMAT")]
+        lines.append(header)
+        for r in range(n_records):
+            contig = draw(st.sampled_from(["1", "17", "chr2", "X"]))
+            pos = draw(st.integers(min_value=1, max_value=10_000))
+            ref = draw(st.sampled_from(["A", "AT", "GCC"]))
+            fields = [
+                contig,
+                str(pos),
+                draw(st.sampled_from([".", f"rs{r}"])),
+                ref,
+                draw(st.sampled_from([".", "G", "G,T"])),
+                ".",
+                ".",
+                draw(_info_field),
+            ]
+            if n_samples:
+                fmt = draw(_format_field)
+                fields.append(fmt)
+                # Sometimes fewer sample columns than the header declares.
+                n_cols = draw(
+                    st.sampled_from([n_samples, max(0, n_samples - 1)])
+                )
+                for _ in range(n_cols):
+                    gt = draw(_gt_field)
+                    subfields = {
+                        "GT": gt,
+                        "GT:DP": f"{gt}:7",
+                        "DP:GT": f"7:{gt}",
+                        "DP": "7",
+                    }[fmt]
+                    fields.append(subfields)
+            lines.append("\t".join(fields))
+        eol = "\r\n" if crlf else "\n"
+        return eol.join(lines) + eol
+
+    @settings(max_examples=40, deadline=None)
+    @given(document=_vcf_documents())
+    def test_fuzz_native_parser_matches_python(document):
+        from spark_examples_tpu.sources.files import _python_vcf_arrays
+        from spark_examples_tpu.utils import native as native_mod
+
+        if native_mod.vcf_library() is None:
+            pytest.skip(
+                f"no native build: {native_mod.native_unavailable_reason()}"
+            )
+
+        native = native_mod.parse_vcf_arrays(document.encode())
+        fd, path = tempfile.mkstemp(suffix=".vcf")
+        try:
+            with os.fdopen(fd, "w", newline="") as f:
+                f.write(document)
+            python = _python_vcf_arrays(path, "fuzz")
+        finally:
+            os.unlink(path)
+
+        by_native = _group_by_contig(*native)
+        by_python = _group_by_contig(*python)
+        assert set(by_native) == set(by_python)
+        for contig in by_native:
+            _assert_same_arrays(by_native[contig], by_python[contig])
+
+    @settings(max_examples=25, deadline=None)
+    @given(
+        document=_vcf_documents(),
+        workers=st.sampled_from([2, 3, 5]),
+    )
+    def test_fuzz_chunk_parallel_parse_matches_serial(document, workers):
+        """Property: for ANY fuzzed VCF document and ANY worker count, the
+        chunk-parallel native parse reassembles the EXACT serial arrays —
+        the parity invariant of the chunk-parallel ingest engine."""
+        from spark_examples_tpu.sources.files import (
+            _native_parallel_vcf_arrays,
+        )
+        from spark_examples_tpu.utils import native as native_mod
+
+        if native_mod.vcf_library() is None:
+            pytest.skip(
+                f"no native build: {native_mod.native_unavailable_reason()}"
+            )
+
+        text = document.encode()
+        serial = native_mod.parse_vcf_arrays(text)
+        parallel = _native_parallel_vcf_arrays(text, workers)
+        assert parallel is not None
+        _assert_same_arrays(serial, parallel)
 
 
 # SAM parser roundtrip property: generated SAM lines → _parse_sam wire dicts
@@ -181,106 +239,109 @@ def test_fuzz_chunk_parallel_parse_matches_serial(document, workers):
 # to diff against (unlike the VCF parsers), so the property pins the wire
 # contract: every SAM column must survive into the Read model byte-exactly.
 
-_cigar_ops = st.sampled_from(list("MIDNSHP=X"))
-_cigar_st = st.lists(
-    st.tuples(st.integers(min_value=1, max_value=250), _cigar_ops),
-    min_size=1,
-    max_size=4,
-).map(lambda units: "".join(f"{n}{op}" for n, op in units))
+if HAVE_HYPOTHESIS:
+    _cigar_ops = st.sampled_from(list("MIDNSHP=X"))
+    _cigar_st = st.lists(
+        st.tuples(st.integers(min_value=1, max_value=250), _cigar_ops),
+        min_size=1,
+        max_size=4,
+    ).map(lambda units: "".join(f"{n}{op}" for n, op in units))
 
-
-@st.composite
-def _sam_records(draw):
-    length = draw(st.integers(min_value=1, max_value=60))
-    seq = draw(
-        st.one_of(
-            st.just("*"),
-            st.text(alphabet="ACGTN", min_size=length, max_size=length),
+    @st.composite
+    def _sam_records(draw):
+        length = draw(st.integers(min_value=1, max_value=60))
+        seq = draw(
+            st.one_of(
+                st.just("*"),
+                st.text(alphabet="ACGTN", min_size=length, max_size=length),
+            )
         )
-    )
-    qual = (
-        "*"
-        if seq == "*" or draw(st.booleans())
-        else "".join(
-            chr(33 + q)
-            for q in draw(
-                st.lists(
-                    st.integers(min_value=0, max_value=60),
-                    min_size=len(seq),
-                    max_size=len(seq),
+        qual = (
+            "*"
+            if seq == "*" or draw(st.booleans())
+            else "".join(
+                chr(33 + q)
+                for q in draw(
+                    st.lists(
+                        st.integers(min_value=0, max_value=60),
+                        min_size=len(seq),
+                        max_size=len(seq),
+                    )
                 )
             )
         )
-    )
-    rnext = draw(st.sampled_from(["*", "=", "11"]))
-    pnext = 0 if rnext == "*" else draw(st.integers(min_value=1, max_value=10**6))
-    return {
-        "qname": draw(st.sampled_from(["r1", "frag.2", "x:y"])),
-        "flag": draw(st.integers(min_value=0, max_value=4095)),
-        "rname": draw(st.sampled_from(["17", "chr4"])),
-        "pos": draw(st.integers(min_value=1, max_value=10**7)),
-        "mapq": draw(st.integers(min_value=0, max_value=255)),
-        "cigar": draw(_cigar_st),
-        "rnext": rnext,
-        "pnext": pnext,
-        "tlen": draw(st.integers(min_value=-500, max_value=500)),
-        "seq": seq,
-        "qual": qual,
-    }
-
-
-@settings(max_examples=60, deadline=None)
-@given(records=st.lists(_sam_records(), min_size=0, max_size=8))
-def test_fuzz_sam_roundtrips_through_read_builder(records):
-    import tempfile
-
-    from spark_examples_tpu.models.read import ReadBuilder
-    from spark_examples_tpu.sources.files import _parse_sam
-
-    text = "@HD\tVN:1.6\n" + "".join(
-        "\t".join(
-            str(r[k])
-            for k in (
-                "qname", "flag", "rname", "pos", "mapq", "cigar",
-                "rnext", "pnext", "tlen", "seq", "qual",
-            )
+        rnext = draw(st.sampled_from(["*", "=", "11"]))
+        pnext = (
+            0 if rnext == "*" else draw(st.integers(min_value=1, max_value=10**6))
         )
-        + "\n"
-        for r in records
-    )
-    fd, path = tempfile.mkstemp(suffix=".sam")
-    try:
-        with os.fdopen(fd, "w") as f:
-            f.write(text)
-        _, tables = _parse_sam(path, "fuzz")
-    finally:
-        os.unlink(path)
+        return {
+            "qname": draw(st.sampled_from(["r1", "frag.2", "x:y"])),
+            "flag": draw(st.integers(min_value=0, max_value=4095)),
+            "rname": draw(st.sampled_from(["17", "chr4"])),
+            "pos": draw(st.integers(min_value=1, max_value=10**7)),
+            "mapq": draw(st.integers(min_value=0, max_value=255)),
+            "cigar": draw(_cigar_st),
+            "rnext": rnext,
+            "pnext": pnext,
+            "tlen": draw(st.integers(min_value=-500, max_value=500)),
+            "seq": seq,
+            "qual": qual,
+        }
 
-    parsed = {}
-    for contig, (starts, recs) in tables.items():
-        for wire in recs:
-            key, read = ReadBuilder.build(wire)
-            parsed[wire["id"]] = (key, read)
-    assert len(parsed) == len(records)
+    @settings(max_examples=60, deadline=None)
+    @given(records=st.lists(_sam_records(), min_size=0, max_size=8))
+    def test_fuzz_sam_roundtrips_through_read_builder(records):
+        import tempfile
 
-    for i, r in enumerate(records):
-        key, read = parsed[f"fuzz:{i + 1}"]  # line 0 is the header
-        assert key.sequence == r["rname"]
-        assert read.position == r["pos"] - 1  # 1-based POS → 0-based
-        assert read.cigar == r["cigar"]  # letters survive the op round trip
-        assert read.mapping_quality == r["mapq"]
-        assert read.fragment_name == r["qname"]
-        assert read.fragment_length == r["tlen"]
-        assert read.aligned_sequence == ("" if r["seq"] == "*" else r["seq"])
-        if r["qual"] == "*":
-            assert read.aligned_quality == ()
-        else:
-            assert read.aligned_quality == tuple(
-                ord(c) - 33 for c in r["qual"]
+        from spark_examples_tpu.models.read import ReadBuilder
+        from spark_examples_tpu.sources.files import _parse_sam
+
+        text = "@HD\tVN:1.6\n" + "".join(
+            "\t".join(
+                str(r[k])
+                for k in (
+                    "qname", "flag", "rname", "pos", "mapq", "cigar",
+                    "rnext", "pnext", "tlen", "seq", "qual",
+                )
             )
-        if r["rnext"] == "*":
-            assert read.mate_position is None
-        else:
-            assert read.mate_position == r["pnext"] - 1
-            expected = r["rname"] if r["rnext"] == "=" else r["rnext"]
-            assert read.mate_reference_name == expected
+            + "\n"
+            for r in records
+        )
+        fd, path = tempfile.mkstemp(suffix=".sam")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(text)
+            _, tables = _parse_sam(path, "fuzz")
+        finally:
+            os.unlink(path)
+
+        parsed = {}
+        for contig, (starts, recs) in tables.items():
+            for wire in recs:
+                key, read = ReadBuilder.build(wire)
+                parsed[wire["id"]] = (key, read)
+        assert len(parsed) == len(records)
+
+        for i, r in enumerate(records):
+            key, read = parsed[f"fuzz:{i + 1}"]  # line 0 is the header
+            assert key.sequence == r["rname"]
+            assert read.position == r["pos"] - 1  # 1-based POS → 0-based
+            assert read.cigar == r["cigar"]  # letters survive the round trip
+            assert read.mapping_quality == r["mapq"]
+            assert read.fragment_name == r["qname"]
+            assert read.fragment_length == r["tlen"]
+            assert read.aligned_sequence == (
+                "" if r["seq"] == "*" else r["seq"]
+            )
+            if r["qual"] == "*":
+                assert read.aligned_quality == ()
+            else:
+                assert read.aligned_quality == tuple(
+                    ord(c) - 33 for c in r["qual"]
+                )
+            if r["rnext"] == "*":
+                assert read.mate_position is None
+            else:
+                assert read.mate_position == r["pnext"] - 1
+                expected = r["rname"] if r["rnext"] == "=" else r["rnext"]
+                assert read.mate_reference_name == expected
