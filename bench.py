@@ -213,16 +213,15 @@ def _run_ingest_measurements(tmpdir: str, device) -> dict:
         acc.finalize_device()
     finally:
         prefetch.close()
+    # Structured overlap accounting straight from the iterator (the same
+    # dict the run manifest embeds); the one-line report rides along for
+    # humans reading the JSON.
     overlap = {
         "wall_seconds": round(wall, 3),
-        "parse_busy_seconds": round(prefetch.producer_seconds, 3),
-        "parse_blocked_on_feed_seconds": round(
-            prefetch.producer_blocked_seconds, 3
-        ),
-        "feeder_waited_on_parse_seconds": round(
-            prefetch.consumer_wait_seconds, 3
-        ),
-        "blocks": prefetch.items,
+        **{
+            key: round(value, 3) if isinstance(value, float) else value
+            for key, value in prefetch.overlap_stats().items()
+        },
         "report": prefetch.overlap_report(),
     }
 
@@ -332,8 +331,38 @@ def _run_config(name: str, device) -> dict:
     result = driver.compute_pca(S)  # fetches the (N, num_pc) components
     wall = time.perf_counter() - start
 
+    # Per-config numbers come from the run MANIFEST (obs/manifest.py) — the
+    # same schema-validated document ``--metrics-json`` writes — not from
+    # driver internals: what this benchmark reports is what any operator's
+    # manifest would say.
+    from spark_examples_tpu.obs.manifest import (
+        build_run_manifest,
+        manifest_metric_value,
+        validate_manifest,
+    )
+    from spark_examples_tpu.obs.metrics import (
+        DEVICEGEN_DISPATCHES,
+        INGEST_SITES_SCANNED,
+    )
+
+    manifest = build_run_manifest(
+        conf=conf,
+        spans=driver.spans,
+        registry=driver.registry,
+        io_stats=driver.io_stats,
+    )
+    schema_errors = validate_manifest(manifest)
+    assert not schema_errors, schema_errors
     acc = driver._device_gen_acc
-    sites_scanned = int(driver._device_gen_scanned)
+
+    def metric(name):
+        value = manifest_metric_value(manifest, name)
+        assert value is not None, f"manifest missing metric {name!r}"
+        return int(value)
+
+    sites_scanned = metric(INGEST_SITES_SCANNED)
+    variant_rows = int(manifest["io_stats"]["variants"])
+    dispatches = metric(DEVICEGEN_DISPATCHES)
     assert len(result) == total_columns
     assert all(len(pcs) == 2 for _, pcs in result)
 
@@ -351,10 +380,10 @@ def _run_config(name: str, device) -> dict:
         "vs_baseline": round(baseline / wall, 2) if baseline else None,
         "details": {
             "sites_scanned": sites_scanned,
-            "variant_rows_accumulated": int(driver.io_stats.variants),
+            "variant_rows_accumulated": variant_rows,
             "sites_per_sec_per_chip": round(sites_scanned / wall / chips_used),
             "chips_used": chips_used,
-            "device_dispatches": acc.dispatches,
+            "device_dispatches": dispatches,
             "block_size": BLOCK,
             "blocks_per_dispatch": k_resolved,
             "compile_seconds_excluded": round(compile_seconds, 3),

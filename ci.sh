@@ -6,7 +6,10 @@
 #   2. lint  — graftcheck lint (JAX-pitfall linter; the tree must be
 #      clean or carry justified disables) + the mypy baseline gate
 #      (skips with a notice when mypy is not installed).
-#   3. sanitize (opt-in: `ci.sh --sanitize`) — ASAN/UBSAN/TSAN replay of
+#   3. obs smoke — a tiny synthetic PCA run with --metrics-json and a
+#      1 s heartbeat; the produced run manifest must validate against the
+#      schema (obs/manifest.py:validate_manifest) and carry I/O stats.
+#   4. sanitize (opt-in: `ci.sh --sanitize`) — ASAN/UBSAN/TSAN replay of
 #      the VCF fuzz corpus against the native parser; skips gracefully
 #      when no C++ compiler is available.
 # Run from the repo root. Exit code: first failing stage wins, tier-1 first.
@@ -32,6 +35,34 @@ lint_rc=0
 env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck lint spark_examples_tpu || lint_rc=$?
 env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck typecheck || lint_rc=$?
 
+echo "== observability smoke (run manifest schema) =="
+obs_rc=0
+OBS_TMP=$(mktemp -d)
+env JAX_PLATFORMS=cpu SPARK_EXAMPLES_TPU_NO_CACHE=1 \
+  python -m spark_examples_tpu variants-pca \
+    --num-samples 8 --references 1:0:50000 \
+    --metrics-json "$OBS_TMP/manifest.json" --heartbeat-seconds 1 \
+    > "$OBS_TMP/stdout.log" 2> "$OBS_TMP/stderr.log" || obs_rc=$?
+if [ "$obs_rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python - "$OBS_TMP/manifest.json" <<'PYEOF' || obs_rc=$?
+import sys
+from spark_examples_tpu.obs.manifest import read_manifest, validate_manifest
+doc = read_manifest(sys.argv[1])
+errors = validate_manifest(doc)
+if errors:
+    print("manifest INVALID:\n  " + "\n  ".join(errors))
+    sys.exit(1)
+if doc["io_stats"] is None or doc["io_stats"]["variants"] <= 0:
+    print("manifest has no I/O stats from the smoke run")
+    sys.exit(1)
+print(f"manifest OK ({len(doc['metrics'])} metrics, "
+      f"{len(doc['spans'])} root spans)")
+PYEOF
+else
+  echo "obs smoke run failed (rc=$obs_rc):"; tail -20 "$OBS_TMP/stderr.log"
+fi
+rm -rf "$OBS_TMP"
+
 san_rc=0
 if [ "$SANITIZE" = "1" ]; then
   echo "== sanitizer stage (graftcheck sanitize) =="
@@ -40,4 +71,5 @@ fi
 
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 if [ "$lint_rc" -ne 0 ]; then exit "$lint_rc"; fi
+if [ "$obs_rc" -ne 0 ]; then exit "$obs_rc"; fi
 exit "$san_rc"

@@ -45,6 +45,9 @@ _LOCK_CTORS = (
 #: How far above a lock construction the ``# lock order:`` comment may sit.
 _LOCK_COMMENT_WINDOW = 3
 
+#: Spellings for GC009's finding text (the common augmented operators).
+_AUG_OPS = {"Add": "+", "Sub": "-", "Mult": "*", "BitOr": "|"}
+
 
 def _dotted(node: ast.AST, alias: Dict[str, str]) -> Optional[str]:
     """Canonical dotted name of a Name/Attribute chain, with the leading
@@ -331,6 +334,33 @@ class _LintVisitor(ast.NodeVisitor):
             for target in node.targets:
                 if isinstance(target, ast.Name):
                     self._jnp_names[-1].add(target.id)
+        self.generic_visit(node)
+
+    # ------------------------------------------------- GC009 (stats bypass)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        """GC009: ``x.y += n`` where ``x`` is a stats/counters object —
+        the mutation bypasses the owner's lock/registry-backed methods.
+        Matched on the holder's name (any dotted segment named ``stats``/
+        ``counters`` or suffixed ``_stats``/``_counters``), so the rule
+        follows the objects wherever they are threaded."""
+        target = node.target
+        if isinstance(target, ast.Attribute):
+            base = _dotted(target.value, self.alias)
+            if base is not None and any(
+                seg in ("stats", "counters")
+                or seg.endswith("_stats")
+                or seg.endswith("_counters")
+                for seg in base.split(".")
+            ):
+                self.emit(
+                    "GC009",
+                    node,
+                    f"direct `{base}.{target.attr} {_AUG_OPS.get(type(node.op).__name__, 'op')}= ...` "
+                    "bypasses the stats object's accounting methods (lock "
+                    "+ metrics registry); use its add_*() method so the "
+                    "count is thread-safe and lands in the run manifest",
+                )
         self.generic_visit(node)
 
     # ----------------------------------------------------------------- call

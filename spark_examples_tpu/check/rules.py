@@ -38,6 +38,11 @@ HOT_PATH_GLOBS = ("ops/*", "pipeline/*")
 #: (a ``# lock order:`` comment on or just above the creation line).
 INGEST_GLOBS = ("sources/*", "pipeline/datasets.py", "utils/native.py")
 
+#: Telemetry scope: pipeline code whose counters must flow through the
+#: metrics registry (``obs/metrics.py``) via the owning object's methods —
+#: a bare ``stats.x += n`` bypasses both the lock and the manifest.
+TELEMETRY_GLOBS = ("ops/*", "pipeline/*", "sources/*")
+
 
 @dataclass(frozen=True)
 class Rule:
@@ -125,6 +130,17 @@ RULES: Dict[str, Rule] = {
             "print() inside a jitted function runs at trace time only "
             "(once per compilation, with tracers, not values); use "
             "jax.debug.print for runtime values.",
+        ),
+        Rule(
+            "GC009",
+            "ad-hoc-stats-mutation",
+            "Direct augmented assignment on a stats/counters object "
+            "(`io_stats.requests += n`, `self.counters.x += 1`) bypasses "
+            "the owner's accounting methods — and with them the lock and "
+            "the metrics registry, so the mutation races concurrent "
+            "workers and never reaches the run manifest; route it through "
+            "an add_*() method.",
+            scope=TELEMETRY_GLOBS,
         ),
     ]
 }
@@ -220,6 +236,7 @@ __all__ = [
     "RULES",
     "HOT_PATH_GLOBS",
     "INGEST_GLOBS",
+    "TELEMETRY_GLOBS",
     "parse_disables",
     "apply_disables",
 ]

@@ -15,6 +15,16 @@ output):
 
     python -m spark_examples_tpu variants-pca --source file \\
         --input-files cohort.vcf.gz --ingest-workers 8
+
+Observability (``obs/``; README "Observability"): ``--heartbeat-seconds N``
+emits a stderr progress line every N seconds (sites/sec, partition ETA,
+prefetch queue, dispatch depth, device memory); ``--metrics-json PATH``
+writes the schema-versioned run manifest (config echo, stage spans, all
+metrics, I/O stats, overlap accounting) that ``bench.py`` and CI consume;
+``--profile-dir`` adds the ``jax.profiler`` device trace:
+
+    python -m spark_examples_tpu variants-pca --all-references \\
+        --heartbeat-seconds 30 --metrics-json run.json
 """
 
 from __future__ import annotations

@@ -142,6 +142,32 @@ def _build_base_parser(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
     parser.add_argument(
         "--seed", type=int, default=42, help="Synthetic-source base seed."
     )
+    # Observability (obs/): background progress heartbeat + machine-readable
+    # run manifest. Both default off, so stdout/stderr are byte-identical to
+    # telemetry-free runs unless asked for.
+    parser.add_argument(
+        "--heartbeat-seconds",
+        type=float,
+        default=0.0,
+        help=(
+            "Emit a progress line to stderr every N seconds during the run "
+            "(sites scanned + rate, partition progress with ETA, prefetch "
+            "queue occupancy, dispatch pipeline depth, device memory when "
+            "the backend reports it — obs/heartbeat.py). 0 = off (default)."
+        ),
+    )
+    parser.add_argument(
+        "--metrics-json",
+        default=None,
+        metavar="PATH",
+        help=(
+            "Write the schema-versioned end-of-run manifest here: config "
+            "echo, hierarchical stage spans, every registry metric, I/O "
+            "stats, and ingest-overlap accounting (obs/manifest.py). The "
+            "numbers match the printed epilogue exactly; bench.py and CI "
+            "consume this instead of scraping stdout."
+        ),
+    )
     # Multi-host initialization (jax.distributed) — the analog of pointing
     # the reference at a Spark cluster master (GenomicsConf.scala:50-57).
     # With these set, jax.devices() spans all hosts and the device mesh
@@ -174,6 +200,8 @@ class GenomicsConf:
     num_samples: int = 2504
     num_samples_per_set: Optional[List[int]] = None
     seed: int = 42
+    heartbeat_seconds: float = 0.0
+    metrics_json: Optional[str] = None
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
@@ -217,6 +245,11 @@ class GenomicsConf:
                 raise ValueError("--num-samples needs at least one value")
             conf.num_samples = sizes[0]
             conf.num_samples_per_set = sizes if len(sizes) > 1 else None
+        if conf.heartbeat_seconds < 0:
+            raise ValueError(
+                f"--heartbeat-seconds must be >= 0 (0 = off), got "
+                f"{conf.heartbeat_seconds}"
+            )
         if conf.ingest_workers is not None and conf.ingest_workers < 0:
             raise ValueError(
                 f"--ingest-workers must be >= 0 (0 = serial oracle path), "

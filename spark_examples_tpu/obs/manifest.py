@@ -1,0 +1,277 @@
+"""Machine-readable run manifest (``--metrics-json PATH``).
+
+The end-of-run epilogue used to be four print-only reports (I/O stats,
+stage timings, the overlap line, the TSV); machines re-ran the pipeline
+and hand-rolled their own dicts (``bench.py``). The manifest is the
+structured superset: one schema-versioned JSON document with the config
+echo, the hierarchical span tree, every registry metric, the I/O stats
+block (numerically identical to the printed report — both read the same
+registry), the ingest-overlap accounting, and compile-cache state.
+
+Schema: ``{"id": "spark-examples-tpu/run-manifest", "version": 1}``.
+:func:`validate_manifest` is the hand-rolled structural validator (no
+jsonschema dependency in the image) used by tests and the ``ci.sh`` smoke
+stage; bump ``MANIFEST_VERSION`` and extend the validator together.
+
+Multi-host: under ``jax.distributed`` each process carries per-process
+I/O counters. :func:`build_run_manifest` aggregates them across processes
+through :func:`spark_examples_tpu.parallel.multihost.aggregate_host_counts`
+(a real collective over the global mesh) into ``multihost.io_stats_global``
+— every process writes the same global totals, so stats parity holds for
+whichever process's manifest a scheduler collects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Dict, List, Mapping, Optional
+
+MANIFEST_ID = "spark-examples-tpu/run-manifest"
+MANIFEST_VERSION = 1
+
+#: The I/O stats fields, in report order (``pipeline/stats.py.__str__``).
+IO_STAT_FIELDS = (
+    "partitions",
+    "reference_bases",
+    "variants",
+    "requests",
+    "unsuccessful_responses",
+    "io_exceptions",
+)
+
+
+def _json_safe(value):
+    """Config echo must serialize whatever a conf dataclass carries."""
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    if isinstance(value, (list, tuple)):
+        return [_json_safe(v) for v in value]
+    if isinstance(value, Mapping):
+        return {str(k): _json_safe(v) for k, v in value.items()}
+    if dataclasses.is_dataclass(value):
+        return _json_safe(dataclasses.asdict(value))
+    return repr(value)
+
+
+def _compile_cache_block() -> Optional[Dict]:
+    """Persistent compile-cache attribution (cold vs warm), mirroring
+    ``bench.py``'s reading of the config value ``utils/cache.py`` sets."""
+    try:
+        import jax
+
+        directory = jax.config.jax_compilation_cache_dir
+        if not directory:
+            return {"dir": None, "entries": 0}
+        return {"dir": directory, "entries": len(os.listdir(directory))}
+    except Exception:
+        return None
+
+
+def _process_block() -> Dict:
+    try:
+        import jax
+
+        return {"index": int(jax.process_index()), "count": int(jax.process_count())}
+    except Exception:
+        return {"index": 0, "count": 1}
+
+
+def build_manifest(
+    config: Optional[Mapping] = None,
+    spans: Optional[List[Dict]] = None,
+    metrics: Optional[Dict] = None,
+    io_stats: Optional[Dict] = None,
+    overlap: Optional[Dict] = None,
+    multihost: Optional[Dict] = None,
+) -> Dict:
+    """Assemble a manifest from already-snapshotted parts (the low-level
+    form; :func:`build_run_manifest` snapshots a live driver)."""
+    return {
+        "schema": {"id": MANIFEST_ID, "version": MANIFEST_VERSION},
+        "created_unix": time.time(),
+        "config": _json_safe(dict(config) if config else {}),
+        "spans": spans or [],
+        "metrics": metrics or {},
+        "io_stats": io_stats,
+        "overlap": overlap,
+        "compile_cache": _compile_cache_block(),
+        "process": _process_block(),
+        "multihost": multihost,
+    }
+
+
+def build_run_manifest(conf=None, spans=None, registry=None, io_stats=None,
+                       overlap=None) -> Dict:
+    """Snapshot a live run: ``conf`` (dataclass or mapping), a
+    :class:`~spark_examples_tpu.obs.spans.SpanRecorder`, a
+    :class:`~spark_examples_tpu.obs.metrics.MetricsRegistry`, the driver's
+    ``VariantsDatasetStats`` (or ``None`` when stats are disabled), and the
+    structured overlap dict from ``PrefetchIterator.overlap_stats()``."""
+    config = (
+        dataclasses.asdict(conf)
+        if dataclasses.is_dataclass(conf)
+        else dict(conf or {})
+    )
+    stats_block = io_stats.as_dict() if io_stats is not None else None
+    multihost_block = None
+    process = _process_block()
+    if stats_block is not None and process["count"] > 1:
+        from spark_examples_tpu.parallel.multihost import aggregate_host_counts
+
+        totals = aggregate_host_counts(
+            [stats_block[f] for f in IO_STAT_FIELDS]
+        )
+        multihost_block = {
+            "process_count": process["count"],
+            "io_stats_global": dict(zip(IO_STAT_FIELDS, totals)),
+        }
+    return build_manifest(
+        config=config,
+        spans=spans.as_list() if spans is not None else [],
+        metrics=registry.as_dict() if registry is not None else {},
+        io_stats=stats_block,
+        overlap=overlap,
+        multihost=multihost_block,
+    )
+
+
+# ------------------------------------------------------------------ validate
+
+
+def validate_manifest(doc) -> List[str]:
+    """Structural validation; returns the list of problems (empty = valid).
+
+    Checks schema identity/version, required top-level keys, the span tree
+    shape (recursively), the metrics export shape, and the I/O stats block
+    fields — the contract ``bench.py`` and the CI smoke stage consume."""
+    errors: List[str] = []
+    if not isinstance(doc, Mapping):
+        return ["manifest is not a JSON object"]
+
+    schema = doc.get("schema")
+    if not isinstance(schema, Mapping):
+        errors.append("missing 'schema' object")
+    else:
+        if schema.get("id") != MANIFEST_ID:
+            errors.append(f"schema.id {schema.get('id')!r} != {MANIFEST_ID!r}")
+        if schema.get("version") != MANIFEST_VERSION:
+            errors.append(
+                f"schema.version {schema.get('version')!r} != {MANIFEST_VERSION}"
+            )
+
+    for key, kind in (
+        ("created_unix", (int, float)),
+        ("config", Mapping),
+        ("spans", list),
+        ("metrics", Mapping),
+        ("process", Mapping),
+    ):
+        if key not in doc:
+            errors.append(f"missing {key!r}")
+        elif not isinstance(doc[key], kind):
+            errors.append(f"{key!r} has wrong type {type(doc[key]).__name__}")
+
+    def check_span(span, path: str) -> None:
+        if not isinstance(span, Mapping):
+            errors.append(f"span at {path} is not an object")
+            return
+        if not isinstance(span.get("name"), str):
+            errors.append(f"span at {path} missing string 'name'")
+        seconds = span.get("seconds")
+        if seconds is not None and (
+            not isinstance(seconds, (int, float)) or seconds < 0
+        ):
+            errors.append(f"span {span.get('name')!r} has bad seconds {seconds!r}")
+        if not isinstance(span.get("synced"), bool):
+            errors.append(f"span {span.get('name')!r} missing bool 'synced'")
+        children = span.get("children")
+        if not isinstance(children, list):
+            errors.append(f"span {span.get('name')!r} missing list 'children'")
+        else:
+            for i, child in enumerate(children):
+                check_span(child, f"{path}/{span.get('name')}[{i}]")
+
+    for i, span in enumerate(doc.get("spans") or []):
+        check_span(span, f"spans[{i}]")
+
+    metrics = doc.get("metrics")
+    if isinstance(metrics, Mapping):
+        for name, family in metrics.items():
+            if not isinstance(family, Mapping):
+                errors.append(f"metric {name!r} is not an object")
+                continue
+            if family.get("type") not in ("counter", "gauge", "histogram"):
+                errors.append(f"metric {name!r} has bad type {family.get('type')!r}")
+            if not isinstance(family.get("values"), list):
+                errors.append(f"metric {name!r} missing list 'values'")
+
+    io_stats = doc.get("io_stats")
+    if io_stats is not None:
+        if not isinstance(io_stats, Mapping):
+            errors.append("'io_stats' is neither null nor an object")
+        else:
+            for field in IO_STAT_FIELDS:
+                if not isinstance(io_stats.get(field), int):
+                    errors.append(f"io_stats.{field} missing or not an int")
+
+    overlap = doc.get("overlap")
+    if overlap is not None and not isinstance(overlap, Mapping):
+        errors.append("'overlap' is neither null nor an object")
+    return errors
+
+
+# ----------------------------------------------------------------------- I/O
+
+
+def write_manifest(path: str, doc: Mapping) -> None:
+    """Write atomically (rename) so a crashed run never leaves a truncated
+    manifest for a scheduler to half-parse. The temp name is per-process:
+    multi-host processes pointed at one shared path must not interleave
+    writes into a common ``.tmp`` — last rename wins cleanly instead."""
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def read_manifest(path: str) -> Dict:
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def manifest_metric_value(
+    doc: Mapping, name: str, labels: Optional[Mapping[str, str]] = None, default=None
+):
+    """Read one metric series out of a manifest (the consumer-side mirror
+    of ``MetricsRegistry.value`` — what ``bench.py`` uses)."""
+    family = (doc.get("metrics") or {}).get(name)
+    if not family:
+        return default
+    want = {k: str(v) for k, v in (labels or {}).items()}
+    for entry in family.get("values", []):
+        if entry.get("labels", {}) == want:
+            if "value" in entry:
+                return entry["value"]
+            # Histogram series: the snapshot (buckets/sum/count), labels
+            # stripped — a well-defined shape rather than the raw entry.
+            return {k: v for k, v in entry.items() if k != "labels"}
+    return default
+
+
+__all__ = [
+    "MANIFEST_ID",
+    "MANIFEST_VERSION",
+    "IO_STAT_FIELDS",
+    "build_manifest",
+    "build_run_manifest",
+    "validate_manifest",
+    "write_manifest",
+    "read_manifest",
+    "manifest_metric_value",
+]

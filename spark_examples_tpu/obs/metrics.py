@@ -1,0 +1,470 @@
+"""Thread-safe metrics registry: named, labeled counters / gauges / histograms.
+
+One :class:`MetricsRegistry` per run (the driver owns it), so concurrent
+runs and tests never cross-contaminate; components that run standalone
+(``bench.py`` component benchmarks, the public API) create private
+registries. All mutation is lock-protected per metric child — ingest worker
+threads, the prefetch producer, and the driver thread all write
+concurrently.
+
+Two exports, one data model:
+
+- :meth:`MetricsRegistry.as_dict` — the JSON form embedded in the run
+  manifest (``obs/manifest.py``);
+- :meth:`MetricsRegistry.prometheus_text` — the Prometheus text exposition
+  format, for scraping a long-running job's state out of a heartbeat dump
+  or a sidecar.
+
+Registration is idempotent: asking for an existing name with the same type
+and label names returns the existing family; a mismatch raises (two
+subsystems silently sharing one name with different meanings is exactly the
+ad-hoc-counter failure mode this registry replaces).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+#: Default histogram bucket upper bounds (seconds-oriented; +Inf implied).
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0,
+)
+
+
+class MetricError(ValueError):
+    """Invalid metric registration or use (name/type/label mismatch)."""
+
+
+#: Well-known gauge names the heartbeat samples (``obs/heartbeat.py``) —
+#: ONE spelling and help string, shared by every producer, so a typo
+#: cannot silently register a second family the heartbeat never reads.
+INGEST_SITES_SCANNED = "ingest_sites_scanned"
+INGEST_PARTITIONS_PLANNED = "ingest_partitions_planned"
+INGEST_PARTITIONS_DONE = "ingest_partitions_done"
+PREFETCH_QUEUE_DEPTH = "prefetch_queue_depth"
+PREFETCH_QUEUE_OCCUPANCY = "prefetch_queue_occupancy"
+GRAMIAN_INFLIGHT_DISPATCHES = "gramian_inflight_dispatches"
+DEVICEGEN_DISPATCHES = "devicegen_dispatches"
+
+#: Registry-backed stats counter the heartbeat's per-shard progress reads
+#: (registered by ``pipeline/stats.py:_STAT_METRICS``, spelled once here).
+IO_PARTITIONS_TOTAL = "io_partitions_total"
+
+_WELL_KNOWN_GAUGE_HELP = {
+    INGEST_SITES_SCANNED: (
+        "Candidate sites scanned so far (heartbeat progress)."
+    ),
+    INGEST_PARTITIONS_PLANNED: (
+        "Shard windows this run will process (heartbeat ETA base)."
+    ),
+    INGEST_PARTITIONS_DONE: (
+        "Shard windows the run has reached so far."
+    ),
+    PREFETCH_QUEUE_DEPTH: "Bound of the prefetch queue.",
+    PREFETCH_QUEUE_OCCUPANCY: (
+        "Parsed blocks currently waiting in the prefetch queue."
+    ),
+    GRAMIAN_INFLIGHT_DISPATCHES: (
+        "Flushed device updates currently left in flight "
+        "(the double-buffered feed depth)."
+    ),
+    DEVICEGEN_DISPATCHES: (
+        "Fused generate+accumulate device dispatches issued."
+    ),
+}
+
+
+def well_known_gauge(registry: "MetricsRegistry", name: str):
+    """Register (idempotently) one of the heartbeat's well-known gauges
+    with its canonical help text."""
+    return registry.gauge(name, _WELL_KNOWN_GAUGE_HELP[name])
+
+
+def _check_name(name: str) -> str:
+    if not _NAME_RE.match(name or ""):
+        raise MetricError(f"invalid metric name {name!r}")
+    return name
+
+
+class _Child:
+    """One (labels → value) series of a family."""
+
+    def __init__(self, labels: Tuple[Tuple[str, str], ...]):
+        self._labels = labels
+        # lock order: leaf lock, taken last; no other lock is acquired
+        # while holding it (mutations are single-value updates).
+        self._lock = threading.Lock()
+
+    @property
+    def labels_dict(self) -> Dict[str, str]:
+        return dict(self._labels)
+
+
+class Counter(_Child):
+    """Monotonic counter."""
+
+    def __init__(self, labels):
+        super().__init__(labels)
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise MetricError(f"counter increment must be >= 0, got {amount}")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge(_Child):
+    """Settable value; optionally backed by a callable sampled at read
+    time (queue occupancy, in-flight depth — state that lives elsewhere).
+
+    The two modes are exclusive: ``set()`` detaches any function (the
+    owner freezing a live gauge at teardown), while ``inc``/``dec`` on a
+    function-backed gauge raise — the delta would be silently shadowed by
+    the callable on every read, which is exactly the kind of quiet
+    accounting loss this registry exists to prevent.
+    """
+
+    def __init__(self, labels):
+        super().__init__(labels)
+        self._value = 0.0
+        self._fn: Optional[Callable[[], float]] = None
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._fn = None
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            if self._fn is not None:
+                raise MetricError(
+                    "gauge is function-backed; inc/dec would be shadowed "
+                    "by the sampler (set() detaches it first)"
+                )
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        """Sample ``fn`` on every read — the gauge tracks live state
+        without the owner having to push updates."""
+        with self._lock:
+            self._fn = fn
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            fn = self._fn
+            if fn is None:
+                return self._value
+        try:
+            return float(fn())
+        except Exception:
+            return float("nan")
+
+
+class Histogram(_Child):
+    """Fixed-bucket histogram (cumulative counts, Prometheus-style)."""
+
+    def __init__(self, labels, buckets: Sequence[float]):
+        super().__init__(labels)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise MetricError("histogram needs at least one bucket bound")
+        self._counts = [0] * (len(self.buckets) + 1)  # +1 for +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def snapshot(self) -> Dict[str, object]:
+        """Cumulative bucket counts keyed by upper bound, plus sum/count."""
+        with self._lock:
+            counts = list(self._counts)
+            total, n = self._sum, self._count
+        cumulative: Dict[str, int] = {}
+        running = 0
+        for bound, c in zip(self.buckets, counts[:-1]):
+            running += c
+            cumulative[_format_bound(bound)] = running
+        cumulative["+Inf"] = running + counts[-1]
+        return {"buckets": cumulative, "sum": total, "count": n}
+
+    @property
+    def value(self) -> Dict[str, object]:
+        return self.snapshot()
+
+
+def _format_bound(bound: float) -> str:
+    if math.isinf(bound):
+        return "+Inf"
+    text = repr(bound)
+    return text[:-2] if text.endswith(".0") else text
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class _Family:
+    """A named metric with a fixed label-name set; children per label set."""
+
+    def __init__(
+        self,
+        name: str,
+        kind: str,
+        help_text: str,
+        labelnames: Tuple[str, ...],
+        buckets: Optional[Sequence[float]] = None,
+    ):
+        self.name = _check_name(name)
+        self.kind = kind
+        self.help = help_text
+        self.labelnames = labelnames
+        self._buckets = buckets
+        # lock order: family lock before any child lock (child creation);
+        # never the reverse.
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[Tuple[str, str], ...], _Child] = {}
+        if not labelnames:
+            self._default = self.labels()
+
+    def labels(self, **labels: str) -> _Child:
+        if set(labels) != set(self.labelnames):
+            raise MetricError(
+                f"{self.name}: expected labels {sorted(self.labelnames)}, "
+                f"got {sorted(labels)}"
+            )
+        key = tuple((k, str(labels[k])) for k in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                if self.kind == "histogram":
+                    child = Histogram(key, self._buckets or DEFAULT_BUCKETS)
+                else:
+                    child = _KINDS[self.kind](key)
+                self._children[key] = child
+            return child
+
+    # Label-free convenience: the family IS its single child.
+    def inc(self, amount: float = 1.0) -> None:
+        self._require_default().inc(amount)  # type: ignore[union-attr]
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._require_default().dec(amount)  # type: ignore[union-attr]
+
+    def set(self, value: float) -> None:
+        self._require_default().set(value)  # type: ignore[union-attr]
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        self._require_default().set_function(fn)  # type: ignore[union-attr]
+
+    def observe(self, value: float) -> None:
+        self._require_default().observe(value)  # type: ignore[union-attr]
+
+    @property
+    def value(self):
+        return self._require_default().value
+
+    def _require_default(self) -> _Child:
+        if self.labelnames:
+            raise MetricError(
+                f"{self.name} is labeled {self.labelnames}; use .labels(...)"
+            )
+        return self._default
+
+    def children(self) -> List[_Child]:
+        with self._lock:
+            return list(self._children.values())
+
+
+class MetricsRegistry:
+    """The registry: one per run (or per standalone component)."""
+
+    def __init__(self) -> None:
+        # lock order: registry lock before family lock; never the reverse.
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    # --------------------------------------------------------- registration
+
+    def _register(
+        self,
+        name: str,
+        kind: str,
+        help_text: str,
+        labelnames: Sequence[str],
+        buckets: Optional[Sequence[float]] = None,
+    ) -> _Family:
+        labelnames = tuple(labelnames)
+        with self._lock:
+            family = self._families.get(name)
+            if family is not None:
+                if family.kind != kind or family.labelnames != labelnames:
+                    raise MetricError(
+                        f"metric {name!r} already registered as "
+                        f"{family.kind}{family.labelnames}; requested "
+                        f"{kind}{labelnames}"
+                    )
+                return family
+            family = _Family(name, kind, help_text, labelnames, buckets)
+            self._families[name] = family
+            return family
+
+    def counter(
+        self, name: str, help_text: str = "", labelnames: Sequence[str] = ()
+    ) -> _Family:
+        return self._register(name, "counter", help_text, labelnames)
+
+    def gauge(
+        self, name: str, help_text: str = "", labelnames: Sequence[str] = ()
+    ) -> _Family:
+        return self._register(name, "gauge", help_text, labelnames)
+
+    def histogram(
+        self,
+        name: str,
+        help_text: str = "",
+        labelnames: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ) -> _Family:
+        return self._register(name, "histogram", help_text, labelnames, buckets)
+
+    # --------------------------------------------------------------- access
+
+    def get(self, name: str) -> Optional[_Family]:
+        with self._lock:
+            return self._families.get(name)
+
+    def value(
+        self, name: str, labels: Optional[Mapping[str, str]] = None, default=None
+    ):
+        """Convenience read (manifest/heartbeat/tests): the value of one
+        series, or ``default`` when the metric or label set is absent."""
+        family = self.get(name)
+        if family is None:
+            return default
+        if not family.labelnames:
+            return family.value
+        want = {k: str(v) for k, v in (labels or {}).items()}
+        for child in family.children():
+            if child.labels_dict == want:
+                return child.value
+        return default
+
+    # -------------------------------------------------------------- exports
+
+    def as_dict(self) -> Dict[str, Dict]:
+        """JSON-safe snapshot: ``{name: {type, help, values: [...]}}`` with
+        one entry per label set (``value`` for counters/gauges; cumulative
+        ``buckets``/``sum``/``count`` for histograms)."""
+        out: Dict[str, Dict] = {}
+        with self._lock:
+            families = sorted(self._families.values(), key=lambda f: f.name)
+        for family in families:
+            values = []
+            for child in family.children():
+                entry: Dict[str, object] = {"labels": child.labels_dict}
+                if family.kind == "histogram":
+                    entry.update(child.snapshot())  # type: ignore[union-attr]
+                else:
+                    value = child.value  # type: ignore[union-attr]
+                    entry["value"] = None if _is_nan(value) else value
+                values.append(entry)
+            out[family.name] = {
+                "type": family.kind,
+                "help": family.help,
+                "values": values,
+            }
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format (v0.0.4)."""
+        lines: List[str] = []
+        with self._lock:
+            families = sorted(self._families.values(), key=lambda f: f.name)
+        for family in families:
+            if family.help:
+                lines.append(f"# HELP {family.name} {family.help}")
+            lines.append(f"# TYPE {family.name} {family.kind}")
+            for child in family.children():
+                label_text = _label_text(child.labels_dict)
+                if family.kind == "histogram":
+                    snap = child.snapshot()  # type: ignore[union-attr]
+                    for bound, count in snap["buckets"].items():
+                        le = _label_text({**child.labels_dict, "le": bound})
+                        lines.append(f"{family.name}_bucket{le} {count}")
+                    lines.append(
+                        f"{family.name}_sum{label_text} {_num(snap['sum'])}"
+                    )
+                    lines.append(
+                        f"{family.name}_count{label_text} {snap['count']}"
+                    )
+                else:
+                    value = child.value  # type: ignore[union-attr]
+                    lines.append(f"{family.name}{label_text} {_num(value)}")
+        return "\n".join(lines) + "\n"
+
+
+def _is_nan(value) -> bool:
+    return isinstance(value, float) and math.isnan(value)
+
+
+def _num(value: float) -> str:
+    if _is_nan(value):
+        return "NaN"
+    if isinstance(value, float) and value.is_integer():
+        return str(int(value))
+    return repr(value)
+
+
+def _label_text(labels: Mapping[str, str]) -> str:
+    if not labels:
+        return ""
+    body = ",".join(
+        f'{k}="{_escape(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + body + "}"
+
+
+def _escape(value: str) -> str:
+    return str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricError",
+    "MetricsRegistry",
+    "DEFAULT_BUCKETS",
+    "INGEST_SITES_SCANNED",
+    "INGEST_PARTITIONS_PLANNED",
+    "INGEST_PARTITIONS_DONE",
+    "PREFETCH_QUEUE_DEPTH",
+    "PREFETCH_QUEUE_OCCUPANCY",
+    "GRAMIAN_INFLIGHT_DISPATCHES",
+    "DEVICEGEN_DISPATCHES",
+    "IO_PARTITIONS_TOTAL",
+    "well_known_gauge",
+]

@@ -34,6 +34,7 @@ Two strategies, mirroring the reference's in-memory/streaming duality:
 from __future__ import annotations
 
 import functools
+import time
 from typing import Optional
 
 import jax
@@ -191,6 +192,68 @@ def data_axis_sum(G: jax.Array, out_shardings=None) -> jax.Array:
         return jnp.sum(G, axis=0, dtype=out_dtype)
 
 
+class _AccumulatorTelemetry:
+    """Optional flush instrumentation shared by both accumulators.
+
+    When a run registry is attached (the driver always attaches its own),
+    every flush feeds ``gramian_flushes_total`` / ``gramian_rows_total``
+    counters and the ``gramian_flush_seconds`` histogram (all labeled by
+    strategy), and ``gramian_inflight_dispatches`` tracks the pipelined
+    feed depth for the heartbeat. At finalize the accumulated host-side
+    flush time attaches to the open span tree as a ``dispatch`` aggregate
+    (one span, not one per flush — a whole-genome run has thousands) and
+    the finalize reduce itself runs under a ``reduce-flush`` span.
+    """
+
+    def __init__(self, registry, spans, strategy: str):
+        self.spans = spans
+        self.flush_seconds_total = 0.0
+        self._flushes = self._rows = self._seconds = self._inflight = None
+        if registry is not None:
+            labels = {"strategy": strategy}
+            self._flushes = registry.counter(
+                "gramian_flushes_total",
+                "Device flushes (one dispatched G += XᵀX update each).",
+                labelnames=("strategy",),
+            ).labels(**labels)
+            self._rows = registry.counter(
+                "gramian_rows_total",
+                "Variant rows accumulated into the Gramian.",
+                labelnames=("strategy",),
+            ).labels(**labels)
+            self._seconds = registry.histogram(
+                "gramian_flush_seconds",
+                "Host-side time per flush (pack + device_put + dispatch).",
+                labelnames=("strategy",),
+            ).labels(**labels)
+            from spark_examples_tpu.obs.metrics import (
+                GRAMIAN_INFLIGHT_DISPATCHES,
+                well_known_gauge,
+            )
+
+            self._inflight = well_known_gauge(
+                registry, GRAMIAN_INFLIGHT_DISPATCHES
+            )
+
+    def record_flush(self, rows: int, seconds: float, in_flight: int) -> None:
+        self.flush_seconds_total += seconds
+        if self._flushes is not None:
+            self._flushes.inc(1)
+            self._rows.inc(rows)
+            self._seconds.observe(seconds)
+            self._inflight.set(in_flight)
+
+    def finalize_span(self):
+        """Context for the finalize reduce; also attaches the flush-time
+        aggregate so the span tree reads ingest → dispatch → reduce-flush."""
+        import contextlib
+
+        if self.spans is None:
+            return contextlib.nullcontext()
+        self.spans.add("dispatch", self.flush_seconds_total)
+        return self.spans.span("reduce-flush")
+
+
 def _unpack_bits(packed: jax.Array, num_columns: int) -> jax.Array:
     """(..., ceil(N/8)) uint8 → (..., N) {0,1} uint8 (np.packbits big-endian
     bit order)."""
@@ -217,7 +280,10 @@ class GramianAccumulator:
         exact_int: bool = False,
         sync_every: int = 1,
         pipeline_depth: Optional[int] = None,
+        registry=None,
+        spans=None,
     ):
+        self.telemetry = _AccumulatorTelemetry(registry, spans, "dense")
         self.num_samples = int(num_samples)
         self.mesh = mesh
         self.block_size = int(block_size)
@@ -281,6 +347,7 @@ class GramianAccumulator:
     def _flush(self) -> None:
         if self._fill == 0:
             return
+        flush_rows, flush_start = self._fill, time.perf_counter()
         block = self._staging
         if self._fill < block.shape[0]:
             # Zero rows contribute nothing to XᵀX — pad instead of masking.
@@ -335,6 +402,9 @@ class GramianAccumulator:
                 jax.block_until_ready(self._in_flight.pop(0))
         elif self._flushes % self.sync_every == 0:
             jax.block_until_ready(self.G)
+        self.telemetry.record_flush(
+            flush_rows, time.perf_counter() - flush_start, len(self._in_flight)
+        )
 
     def finalize_device(self) -> jax.Array:
         """Reduce across the data axis (the one ``psum``); result stays on
@@ -344,7 +414,8 @@ class GramianAccumulator:
         (any device_get degrades later host→device traffic ~50×, measured)."""
         self._flush()
         self._in_flight.clear()  # release held buffers from the pipeline
-        return data_axis_sum(self.G)
+        with self.telemetry.finalize_span():
+            return data_axis_sum(self.G)
 
     def finalize(self) -> np.ndarray:
         """Host copy of :meth:`finalize_device` (tests / host backend)."""
@@ -400,7 +471,10 @@ class ShardedGramianAccumulator:
         block_size: int = 1024,
         exact_int: bool = False,
         sync_every: int = 1,
+        registry=None,
+        spans=None,
     ):
+        self.telemetry = _AccumulatorTelemetry(registry, spans, "sharded")
         self.sync_every = max(1, int(sync_every))
         self._flushes = 0
         if SAMPLES_AXIS not in mesh.shape:
@@ -486,6 +560,7 @@ class ShardedGramianAccumulator:
     def _flush(self) -> None:
         if self._fill == 0:
             return
+        flush_rows, flush_start = self._fill, time.perf_counter()
         block = self._staging
         if self._fill < block.shape[0]:
             block = block.copy()
@@ -504,10 +579,14 @@ class ShardedGramianAccumulator:
         self._flushes += 1
         if self._flushes % self.sync_every == 0:
             jax.block_until_ready(self.G)
+        self.telemetry.record_flush(
+            flush_rows, time.perf_counter() - flush_start, 0
+        )
 
     def finalize(self) -> np.ndarray:
         self._flush()
-        total = data_axis_sum(self.G)
+        with self.telemetry.finalize_span():
+            total = data_axis_sum(self.G)
         full = np.asarray(jax.device_get(total)).astype(np.float64)
         return full[: self.num_samples, : self.num_samples]
 
@@ -516,16 +595,18 @@ class ShardedGramianAccumulator:
         columns/rows (all zero). See :meth:`finalize_sharded` for the
         samples-sharded variant."""
         self._flush()
-        return data_axis_sum(self.G)
+        with self.telemetry.finalize_span():
+            return data_axis_sum(self.G)
 
     def finalize_sharded(self) -> jax.Array:
         """Device-resident finalize: (padded N, padded N) row-sharded over
         ``samples`` — for cohorts where the host copy is undesirable."""
         self._flush()
-        return data_axis_sum(
-            self.G,
-            out_shardings=NamedSharding(self.mesh, P(SAMPLES_AXIS, None)),
-        )
+        with self.telemetry.finalize_span():
+            return data_axis_sum(
+                self.G,
+                out_shardings=NamedSharding(self.mesh, P(SAMPLES_AXIS, None)),
+            )
 
 
 def accumulate_index_rows(

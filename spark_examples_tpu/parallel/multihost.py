@@ -55,6 +55,33 @@ _SPACING = 100
 _MIN_AF = 0.01
 
 
+def aggregate_host_counts(values) -> List[int]:
+    """Sum small per-process host-side integer counters (I/O stats, ingest
+    accounting) across every process of a ``jax.distributed`` run.
+
+    The telemetry analog of the finalize ``psum``: each process's dataset
+    layer counts only what ITS host loop streamed, so a whole-fleet manifest
+    (``obs/manifest.py``) needs one cross-process reduction for its global
+    I/O block. Rides ``process_allgather`` (host-local → global array over
+    the same collectives the Gramian reduce uses), so stats parity holds on
+    any backend the pipeline itself runs on; with one process it is a plain
+    int cast, device-free — single-host runs pay nothing.
+    """
+    import numpy as np
+
+    arr = np.asarray(list(values), dtype=np.int64)
+    if arr.ndim != 1:
+        raise ValueError(f"expected a flat counter vector, got shape {arr.shape}")
+    import jax
+
+    if jax.process_count() == 1:
+        return [int(v) for v in arr]
+    from jax.experimental import multihost_utils
+
+    gathered = np.asarray(multihost_utils.process_allgather(arr))
+    return [int(v) for v in gathered.reshape(jax.process_count(), -1).sum(axis=0)]
+
+
 def child_check(
     coordinator_address: str,
     num_processes: int,
@@ -161,6 +188,17 @@ def child_check(
         ring_full = host_value(ring_sharded)
     ring_gramian = ring_full[: source.num_samples, : source.num_samples]
 
+    # Telemetry parity: the run manifest's cross-process I/O aggregation
+    # (``obs/manifest.py`` → :func:`aggregate_host_counts`) must reduce over
+    # the same process set as the Gramian collectives — each process
+    # contributes (process_id + 1, kept_sites) and every process must read
+    # identical, correct global totals.
+    aggregated = aggregate_host_counts([process_id + 1, int(kept_sites)])
+    counts_ok = aggregated == [
+        num_processes * (num_processes + 1) // 2,
+        int(kept_sites) * num_processes,
+    ]
+
     return {
         "process_id": process_id,
         "num_processes": num_processes,
@@ -176,6 +214,7 @@ def child_check(
         "ring_gramian_ok": bool(
             np.array_equal(ring_gramian.astype(np.int64), oracle)
         ),
+        "counter_aggregation_ok": bool(counts_ok),
         "variant_rows": [int(v) for v in per_set_rows],
         "kept_sites": int(kept_sites),
     }
@@ -309,6 +348,7 @@ def verify_multihost(
         r.returncode == 0 for r in check_runs
     )
     ring_ok = all(c.get("ring_gramian_ok") for c in children)
+    counts_ok = all(c.get("counter_aggregation_ok") for c in children)
     spans = all(
         c.get("result_spans_processes") and c.get("ring_spans_processes")
         for c in children
@@ -320,6 +360,7 @@ def verify_multihost(
         "children": children,
         "gramian_ok": gramian_ok,
         "ring_gramian_ok": ring_ok,
+        "counter_aggregation_ok": counts_ok,
         "result_spans_processes": spans,
     }
 
@@ -376,10 +417,11 @@ def verify_multihost(
                 (run.stderr or "")[-2000:] for run in cli_runs if run.returncode
             ]
         report["ok"] = bool(
-            gramian_ok and ring_ok and spans and cli_ok and identical
+            gramian_ok and ring_ok and counts_ok and spans and cli_ok
+            and identical
         )
     else:
-        report["ok"] = bool(gramian_ok and ring_ok and spans)
+        report["ok"] = bool(gramian_ok and ring_ok and counts_ok and spans)
     return report
 
 
@@ -405,7 +447,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             args.coordinator_address, args.num_processes, args.process_id
         )
         print(_CHILD_TAG + json.dumps(verdict), flush=True)
-        return 0 if verdict["gramian_ok"] and verdict["ring_gramian_ok"] else 1
+        return (
+            0
+            if verdict["gramian_ok"]
+            and verdict["ring_gramian_ok"]
+            and verdict["counter_aggregation_ok"]
+            else 1
+        )
 
     report = verify_multihost(
         num_processes=args.num_processes, local_devices=args.local_devices

@@ -77,25 +77,46 @@ class PrefetchIterator:
     (plus the one the producer is computing), so a slow device feeder stalls
     the parse instead of letting parsed blocks pile up in host memory.
     Exceptions from the source iterator re-raise at the consuming position.
-    Overlap accounting (``producer_seconds``, ``producer_blocked_seconds``,
-    ``consumer_wait_seconds``) feeds the ingest-overlap report in
-    ``bench.py`` and ``--profile-dir`` stage timings: producer-blocked time
-    means the device is the bottleneck, consumer-wait time means parse is.
+    Overlap accounting (:meth:`overlap_stats` — producer-busy,
+    producer-blocked, consumer-wait seconds) feeds the run manifest, the
+    ingest-overlap report in ``bench.py``, and ``--profile-dir`` stage
+    timings: producer-blocked time means the device is the bottleneck,
+    consumer-wait time means parse is. ``registry`` (the run's
+    :class:`~spark_examples_tpu.obs.metrics.MetricsRegistry`, optional)
+    gets a live ``prefetch_queue_occupancy`` gauge for the heartbeat and
+    the final overlap gauges on :meth:`close`; ``spans`` (the run's
+    recorder, optional) gets a ``chunk-parse`` aggregate span.
     """
 
     _DONE = object()
 
-    def __init__(self, iterable, depth: int = 2):
+    def __init__(self, iterable, depth: int = 2, registry=None, spans=None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self.depth = int(depth)
         self._queue: queue.Queue = queue.Queue(maxsize=self.depth)
         self._error: Optional[BaseException] = None
         self._stop = threading.Event()
+        self._registry = registry
+        self._spans = spans
+        self._published = False
         self.producer_seconds = 0.0
         self.producer_blocked_seconds = 0.0
         self.consumer_wait_seconds = 0.0
         self.items = 0
+        self._occupancy_gauge = None
+        if registry is not None:
+            from spark_examples_tpu.obs.metrics import (
+                PREFETCH_QUEUE_DEPTH,
+                PREFETCH_QUEUE_OCCUPANCY,
+                well_known_gauge,
+            )
+
+            well_known_gauge(registry, PREFETCH_QUEUE_DEPTH).set(self.depth)
+            self._occupancy_gauge = well_known_gauge(
+                registry, PREFETCH_QUEUE_OCCUPANCY
+            )
+            self._occupancy_gauge.set_function(self._queue.qsize)
         self._thread = threading.Thread(
             target=self._run, args=(iter(iterable),), daemon=True
         )
@@ -165,17 +186,62 @@ class PrefetchIterator:
         return item
 
     def close(self) -> None:
-        """Stop the producer and release its thread (idempotent)."""
+        """Stop the producer and release its thread (idempotent); publish
+        the final overlap numbers to the registry/span recorder (once)."""
         self._stop.set()
         self._thread.join(timeout=5.0)
+        if self._occupancy_gauge is not None:
+            # Freeze the live gauge: drop the sampler so the run-long
+            # registry stops referencing the dead queue (and its buffered
+            # blocks), keeping the final occupancy for post-mortems.
+            self._occupancy_gauge.set(self._queue.qsize())
+        if not self._published:
+            self._published = True
+            stats = self.overlap_stats()
+            if self._registry is not None:
+                for field, help_text in (
+                    ("parse_busy_seconds", "Producer time spent parsing."),
+                    (
+                        "parse_blocked_on_feed_seconds",
+                        "Producer time blocked on the full queue "
+                        "(device feed is the bottleneck).",
+                    ),
+                    (
+                        "feeder_waited_on_parse_seconds",
+                        "Consumer time waiting on the empty queue "
+                        "(parse is the bottleneck).",
+                    ),
+                ):
+                    self._registry.gauge(
+                        f"ingest_overlap_{field}", help_text
+                    ).set(stats[field])
+                self._registry.counter(
+                    "prefetch_blocks_total",
+                    "Blocks that passed through the prefetch queue.",
+                ).inc(stats["blocks"])
+            if self._spans is not None:
+                self._spans.add("chunk-parse", stats["parse_busy_seconds"])
+
+    def overlap_stats(self) -> dict:
+        """Structured ingest/compute overlap accounting — the manifest's
+        ``overlap`` block; :meth:`overlap_report` is its formatter."""
+        return {
+            "parse_busy_seconds": self.producer_seconds,
+            "parse_blocked_on_feed_seconds": self.producer_blocked_seconds,
+            "feeder_waited_on_parse_seconds": self.consumer_wait_seconds,
+            "blocks": self.items,
+            "queue_depth": self.depth,
+        }
 
     def overlap_report(self) -> str:
-        """One line of ingest/compute overlap accounting."""
+        """One line of ingest/compute overlap accounting (the stdout form
+        of :meth:`overlap_stats`, format unchanged)."""
+        stats = self.overlap_stats()
         return (
-            f"ingest overlap: parse {self.producer_seconds:.3f}s busy, "
-            f"{self.producer_blocked_seconds:.3f}s blocked on device feed "
-            f"(backpressure); feeder waited {self.consumer_wait_seconds:.3f}s "
-            f"on parse; {self.items} blocks through a depth-{self.depth} queue"
+            f"ingest overlap: parse {stats['parse_busy_seconds']:.3f}s busy, "
+            f"{stats['parse_blocked_on_feed_seconds']:.3f}s blocked on device feed "
+            f"(backpressure); feeder waited {stats['feeder_waited_on_parse_seconds']:.3f}s "
+            f"on parse; {stats['blocks']} blocks through a depth-{stats['queue_depth']} queue"
         )
 
 

@@ -149,10 +149,19 @@ class VariantsPcaDriver:
     def __init__(self, conf: PcaConf, source: Optional[GenomicsSource] = None):
         self.conf = conf
         self.source = source if source is not None else make_source(conf)
+        # One telemetry namespace per run: every counter/gauge/span of this
+        # driver's pipeline lands here, and the run manifest
+        # (``--metrics-json``) snapshots exactly this registry+recorder —
+        # concurrent drivers (tests, bench configs) never cross-contaminate.
+        from spark_examples_tpu.obs import MetricsRegistry, SpanRecorder
+
+        self.registry = MetricsRegistry()
+        self.spans = SpanRecorder()
+        self._overlap: Optional[Dict] = None
         # Stats are disabled when resuming from materialized input
         # (``VariantsPca.scala:332-335``).
         self.io_stats: Optional[VariantsDatasetStats] = (
-            None if conf.input_path else VariantsDatasetStats()
+            None if conf.input_path else VariantsDatasetStats(self.registry)
         )
         # Driver-side callset fetch → (indexes, names) (``VariantsPca.scala:97-109``).
         callsets = self.source.search_callsets(conf.variant_set_id)
@@ -380,11 +389,13 @@ class VariantsPcaDriver:
         exact = getattr(self.conf, "exact_similarity", False)
         if self._resolve_sharded(sharded, mesh):
             acc: object = ShardedGramianAccumulator(
-                n, mesh, block_size=self.conf.block_size, exact_int=exact
+                n, mesh, block_size=self.conf.block_size, exact_int=exact,
+                registry=self.registry, spans=self.spans,
             )
         else:
             acc = GramianAccumulator(
-                n, mesh, block_size=self.conf.block_size, exact_int=exact
+                n, mesh, block_size=self.conf.block_size, exact_int=exact,
+                registry=self.registry, spans=self.spans,
             )
         # Duplicate callset indices only arise when a variant set is joined
         # with itself (duplicate ids collapse the column index); only then is
@@ -433,7 +444,8 @@ class VariantsPcaDriver:
         exact = getattr(self.conf, "exact_similarity", False)
         if self._resolve_sharded(sharded, mesh):
             acc: object = ShardedGramianAccumulator(
-                n, mesh, block_size=self.conf.block_size, exact_int=exact
+                n, mesh, block_size=self.conf.block_size, exact_int=exact,
+                registry=self.registry, spans=self.spans,
             )
         else:
             acc = GramianAccumulator(
@@ -442,6 +454,8 @@ class VariantsPcaDriver:
                 block_size=self.conf.block_size,
                 exact_int=exact,
                 pipeline_depth=pipeline_depth,
+                registry=self.registry,
+                spans=self.spans,
             )
         for block in blocks:
             acc.add_rows(block)
@@ -555,22 +569,40 @@ class VariantsPcaDriver:
                 ),
             )
 
+        from spark_examples_tpu.obs.metrics import (
+            INGEST_PARTITIONS_PLANNED,
+            INGEST_SITES_SCANNED,
+            well_known_gauge,
+        )
+
         self._device_gen_scanned = 0
-        for contig in contigs:
+        # One shard enumeration per contig, shared by the planned-work
+        # gauge and the per-contig stats accounting below.
+        shards_by_contig = [
+            (contig, contig.get_shards(conf.bases_per_partition))
+            for contig in contigs
+        ]
+        sites_gauge = well_known_gauge(self.registry, INGEST_SITES_SCANNED)
+        well_known_gauge(self.registry, INGEST_PARTITIONS_PLANNED).set(
+            sum(len(shards) for _, shards in shards_by_contig)
+            * len(conf.variant_set_id)
+        )
+        for contig, shards in shards_by_contig:
             k0, k1 = source.site_grid_range(contig)
             if k1 > k0:
                 acc.add_grid(k0, k1)
             self._device_gen_scanned += k1 - k0
+            sites_gauge.set(self._device_gen_scanned)
             if self.io_stats is not None:
                 # Wire-equivalent accounting: per shard, per variant set
                 # (``SyntheticGenomicsSource.page_requests``).
-                shards = contig.get_shards(conf.bases_per_partition)
                 for _ in conf.variant_set_id:
                     for shard in shards:
                         self.io_stats.add_partition(shard.range)
-                self.io_stats.requests += source.page_requests(
-                    contig, conf.bases_per_partition
-                ) * len(conf.variant_set_id)
+                self.io_stats.add_requests(
+                    source.page_requests(contig, conf.bases_per_partition)
+                    * len(conf.variant_set_id)
+                )
         self._device_gen_acc = acc
         if use_ring:
             # Row-sharded (padded) result; compute_pca routes to the sharded
@@ -578,6 +610,11 @@ class VariantsPcaDriver:
             result = acc.finalize_sharded()
         else:
             result = acc.finalize_device()
+        from spark_examples_tpu.obs.metrics import DEVICEGEN_DISPATCHES
+
+        well_known_gauge(self.registry, DEVICEGEN_DISPATCHES).set(
+            acc.dispatches
+        )
         # Epilogue: record the device-counted variant rows (per variant set,
         # rows with variation in that set's columns — the same count the
         # packed host path reports after its nonzero drop). Doing it here
@@ -641,13 +678,15 @@ class VariantsPcaDriver:
             # 246-263``), and whole-genome counts exceed f32's 2^24 exact
             # range — this is what keeps --exact-similarity exact PAST the
             # accumulator (ops/centering.py:_dtypes).
-            with jax.enable_x64(True):
-                centered = gower_center_sharded(
-                    similarity, sharded_mesh, n_true=n
+            with self.spans.span("center"):
+                with jax.enable_x64(True):
+                    centered = gower_center_sharded(
+                        similarity, sharded_mesh, n_true=n
+                    )
+            with self.spans.span("eigh"):
+                device_components, _ = principal_components_subspace_sharded(
+                    centered, sharded_mesh, self.conf.num_pc, n_true=n
                 )
-            device_components, _ = principal_components_subspace_sharded(
-                centered, sharded_mesh, self.conf.num_pc, n_true=n
-            )
             # any() rather than sum() > 0: entries are non-negative counts,
             # and int32 row sums would overflow at whole-genome scale. Under
             # x64 because the finalize reduce hands back an int64 Gramian.
@@ -667,13 +706,15 @@ class VariantsPcaDriver:
             # same integers (ops/centering.py:_dtypes). The asarray sits
             # INSIDE the x64 block so a float64 host similarity (exact
             # counts past 2^24) is not silently truncated to f32 on entry.
-            with jax.enable_x64(True):
-                S = jnp.asarray(similarity)
-                centered = gower_center(S)
-            centered = centered.astype(jnp.float32)
-            device_components, _ = principal_components_subspace(
-                centered, self.conf.num_pc
-            )
+            with self.spans.span("center"):
+                with jax.enable_x64(True):
+                    S = jnp.asarray(similarity)
+                    centered = gower_center(S)
+                centered = centered.astype(jnp.float32)
+            with self.spans.span("eigh"):
+                device_components, _ = principal_components_subspace(
+                    centered, self.conf.num_pc
+                )
             # All dispatches issued; fetching results is now safe. any()
             # rather than sum() > 0: int32 row sums would overflow at
             # whole-genome scale. Under x64 because S may be the int64
@@ -855,25 +896,81 @@ def run(argv: Sequence[str]) -> List[str]:
     driver = VariantsPcaDriver(conf, source)
     from spark_examples_tpu.utils.tracing import StageTimes, device_trace
 
-    times = StageTimes()
-    with device_trace(conf.profile_dir):
-        # The device path already ends in a synchronous counter fetch (the
-        # stats epilogue); packed/wire paths end in a one-scalar fetch so the
-        # stage wall-clock is honest on asynchronous backends rather than
-        # dispatch-time only (utils/tracing.py).
-        with times.stage("ingest+similarity"):
-            similarity = _similarity_stage(conf, driver, use_device, use_packed)
-            if not use_device:
-                _sync_scalar(similarity)
-        # compute_pca ends in the synchronous components fetch, so its stage
-        # time is honest even on asynchronous remote-attached backends.
-        with times.stage("center+pca"):
-            result = driver.compute_pca(similarity)
+    # Stages record into the driver's span recorder, so the manifest's span
+    # tree and the printed "Stage timings" report are views of one
+    # measurement; deeper phases (chunk-parse, dispatch, reduce-flush,
+    # center, eigh) nest under the stages they ran in.
+    times = StageTimes(recorder=driver.spans)
+    heartbeat = None
+    if getattr(conf, "heartbeat_seconds", 0) and conf.heartbeat_seconds > 0:
+        from spark_examples_tpu.obs.heartbeat import Heartbeat
+
+        heartbeat = Heartbeat(conf.heartbeat_seconds, driver.registry).start()
+    try:
+        with device_trace(conf.profile_dir):
+            # The device path already ends in a synchronous counter fetch
+            # (the stats epilogue); packed/wire paths end in a one-scalar
+            # fetch so the stage wall-clock is honest on asynchronous
+            # backends rather than dispatch-time only (utils/tracing.py).
+            with times.stage("ingest+similarity"):
+                similarity = _similarity_stage(
+                    conf, driver, use_device, use_packed
+                )
+                if not use_device:
+                    _sync_scalar(similarity)
+            # compute_pca ends in the synchronous components fetch, so its
+            # stage time is honest even on asynchronous remote-attached
+            # backends.
+            with times.stage("center+pca"):
+                result = driver.compute_pca(similarity)
+    finally:
+        # Emits-then-stops-cleanly contract: a mid-run exception gets its
+        # last heartbeat, then silence — never a progress line racing the
+        # traceback (or a leaked thread outliving the run).
+        if heartbeat is not None:
+            heartbeat.stop()
     lines = driver.emit_result(result)
     driver.report_io_stats()
     if conf.profile_dir:
         print(str(times))
         print(f"Device trace written to {conf.profile_dir}.")
+    import jax
+
+    if getattr(conf, "metrics_json", None) or jax.process_count() > 1:
+        # Built LAST, after every report printed above, so the manifest
+        # snapshots the same registry state the epilogue rendered — the
+        # numbers are identical by construction, not by parallel
+        # bookkeeping. Multi-controller runs build it on EVERY process
+        # (not only those given --metrics-json): the cross-process counter
+        # aggregation inside is a collective, and a process skipping it
+        # would deadlock the ones that reached it.
+        from spark_examples_tpu.obs.manifest import (
+            build_run_manifest,
+            write_manifest,
+        )
+
+        manifest_doc = build_run_manifest(
+            conf=conf,
+            spans=driver.spans,
+            registry=driver.registry,
+            io_stats=driver.io_stats,
+            overlap=driver._overlap,
+        )
+        if conf.metrics_json:
+            try:
+                write_manifest(conf.metrics_json, manifest_doc)
+            except OSError as e:
+                # A bad path must not destroy hours of completed compute:
+                # the results are already printed/returned — report the
+                # telemetry loss loudly and keep the run's exit intact.
+                import sys
+
+                print(
+                    f"Run manifest NOT written to {conf.metrics_json}: {e}",
+                    file=sys.stderr,
+                )
+            else:
+                print(f"Run manifest written to {conf.metrics_json}.")
     driver.stop()
     return lines
 
@@ -914,11 +1011,17 @@ def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
 
         def feed_rows(row_stream):
             """Run the row stream through the prefetch queue (when enabled)
-            and the double-buffered accumulator; report ingest overlap
-            under --profile-dir."""
+            and the double-buffered accumulator; the structured overlap
+            numbers land in the registry/manifest either way, and the
+            historical one-line report still prints under --profile-dir."""
             prefetch = None
             if ingest_workers > 0:
-                row_stream = prefetch = PrefetchIterator(row_stream, depth=2)
+                row_stream = prefetch = PrefetchIterator(
+                    row_stream,
+                    depth=2,
+                    registry=driver.registry,
+                    spans=driver.spans,
+                )
             try:
                 return driver.get_similarity_rows(
                     row_stream, pipeline_depth=pipeline_depth
@@ -926,6 +1029,7 @@ def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
             finally:
                 if prefetch is not None:
                     prefetch.close()
+                    driver._overlap = prefetch.overlap_stats()
                     if conf.profile_dir:
                         print(prefetch.overlap_report())
 
@@ -934,6 +1038,15 @@ def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
         contigs = conf.get_contigs(source, conf.variant_set_id)
         partitioner = VariantsPartitioner(contigs, conf.bases_per_partition)
         partitions = partitioner.get_partitions(conf.variant_set_id[0])
+        from spark_examples_tpu.obs.metrics import (
+            INGEST_PARTITIONS_DONE,
+            INGEST_PARTITIONS_PLANNED,
+            well_known_gauge,
+        )
+
+        well_known_gauge(driver.registry, INGEST_PARTITIONS_PLANNED).set(
+            len(partitions)
+        )
 
         if not synthetic and source.wants_streaming(conf.variant_set_id[0]):
             # Bounded-memory ingest: ONE pass over the file serves every
@@ -945,7 +1058,7 @@ def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
             # random-access path computes.
             from spark_examples_tpu.sources.files import StreamCounters
 
-            counters = StreamCounters(len(partitions))
+            counters = StreamCounters(len(partitions), registry=driver.registry)
             set_id = conf.variant_set_id[0]
             shard_windows = [p.contig for p in partitions]
 
@@ -960,13 +1073,19 @@ def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
                     yield block["has_variation"]
 
             similarity = feed_rows(streamed_rows())
+            # The pass is over: every window is done, including any past
+            # the file's last record that the cursor never reached — the
+            # heartbeat's progress gauge must converge to planned.
+            well_known_gauge(driver.registry, INGEST_PARTITIONS_DONE).set(
+                len(partitions)
+            )
             # get_similarity_rows consumed the stream; the counters are
             # complete. Partition/request accounting matches the per-shard
             # path: every shard contributes its range and ≥1 page.
             if driver.io_stats is not None:
                 for part in partitions:
                     driver.io_stats.add_partition(part.range)
-                driver.io_stats.requests += counters.requests()
+                driver.io_stats.add_requests(counters.requests())
                 driver.io_stats.add_variants(counters.variants)
             return similarity
 
@@ -985,7 +1104,7 @@ def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
                     sum(len(b["positions"]) for b in blocks)
                 )
                 # Wire-equivalent page accounting (shared helpers).
-                driver.io_stats.requests += (
+                driver.io_stats.add_requests(
                     source.page_requests(part.contig, conf.bases_per_partition)
                     if synthetic
                     else source.page_requests(

@@ -1,49 +1,110 @@
-"""I/O statistics accumulators.
+"""I/O statistics accumulators — a thin view over the metrics registry.
 
 The reference tracks ingest health with six Spark accumulators flushed from
 executors (``rdd/VariantsRDD.scala:152-172``) and pretty-prints them at the
-end of a run (``VariantsPca.scala:321-326``). Without Spark, the host
-streaming loop is in-process (or one process per host under
-``jax.distributed``), so the accumulators are plain counters aggregated by
-the dataset layer; the report format is kept identical so runs are
-comparable line-for-line.
+end of a run (``VariantsPca.scala:321-326``). The counters now live in a
+:class:`~spark_examples_tpu.obs.metrics.MetricsRegistry` (``io_*_total``,
+thread-safe, exported into the run manifest and the Prometheus text dump);
+this class keeps the reference's accessor surface and the line-for-line
+report format, so runs stay comparable and the printed epilogue is
+numerically identical to the manifest's ``io_stats`` block — both read the
+same registry series.
+
+Mutation goes through the ``add_*`` methods ONLY. The stat names are
+read-only properties: a direct ``stats.requests += n`` — which used to
+silently bypass the lock — now raises, and ``graftcheck`` rule GC009 flags
+the pattern statically in ``ops/``, ``pipeline/``, and ``sources/``.
 """
 
 from __future__ import annotations
 
-import threading
+from typing import Dict, Optional
 
+from spark_examples_tpu.obs.metrics import IO_PARTITIONS_TOTAL, MetricsRegistry
 from spark_examples_tpu.sources.base import ClientCounters
+
+#: stat name → (metric name, help) — the registry series backing each field.
+_STAT_METRICS = {
+    "partitions": (IO_PARTITIONS_TOTAL, "Shards (partitions) processed."),
+    "reference_bases": (
+        "io_reference_bases_total",
+        "Reference bases covered by processed partitions.",
+    ),
+    "requests": ("io_requests_total", "API/page requests issued."),
+    "unsuccessful_responses": (
+        "io_unsuccessful_responses_total",
+        "Unsuccessful (non-2xx) responses.",
+    ),
+    "io_exceptions": ("io_io_exceptions_total", "I/O exceptions raised."),
+    "variants": ("io_variants_total", "Variant records read (pre-drop)."),
+}
+
+
+def _forbidden(name: str):
+    def getter(self) -> int:
+        return int(self._counters[name].value)
+
+    def setter(self, value) -> None:
+        raise AttributeError(
+            f"direct writes to VariantsDatasetStats.{name} bypass the "
+            f"registry accounting; use add_{name}()/add_client() instead"
+        )
+
+    return property(getter, setter)
 
 
 class VariantsDatasetStats:
-    """Mirror of ``VariantsRddStats`` (``rdd/VariantsRDD.scala:152-172``)."""
+    """Mirror of ``VariantsRddStats`` (``rdd/VariantsRDD.scala:152-172``),
+    registry-backed. Pass the run's registry to share one namespace with
+    the rest of the pipeline's telemetry; a private registry is created
+    otherwise (standalone/tests)."""
 
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self.partitions = 0
-        self.reference_bases = 0
-        self.requests = 0
-        self.unsuccessful_responses = 0
-        self.io_exceptions = 0
-        self.variants = 0
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._counters = {
+            stat: self.registry.counter(metric, help_text)
+            for stat, (metric, help_text) in _STAT_METRICS.items()
+        }
+
+    partitions = _forbidden("partitions")
+    reference_bases = _forbidden("reference_bases")
+    requests = _forbidden("requests")
+    unsuccessful_responses = _forbidden("unsuccessful_responses")
+    io_exceptions = _forbidden("io_exceptions")
+    variants = _forbidden("variants")
 
     def add_partition(self, reference_bases: int) -> None:
-        with self._lock:
-            self.partitions += 1
-            self.reference_bases += int(reference_bases)
+        self._counters["partitions"].inc(1)
+        self._counters["reference_bases"].inc(int(reference_bases))
 
     def add_variants(self, n: int) -> None:
-        with self._lock:
-            self.variants += int(n)
+        self._counters["variants"].inc(int(n))
+
+    def add_requests(self, n: int) -> None:
+        """Page/API requests accounted outside a client session (the
+        device-gen and streaming ingest paths compute them arithmetically)."""
+        self._counters["requests"].inc(int(n))
 
     def add_client(self, counters: ClientCounters) -> None:
         """Flush a per-partition client's counters
         (``rdd/VariantsRDD.scala:192-196``)."""
-        with self._lock:
-            self.requests += counters.initialized_requests
-            self.unsuccessful_responses += counters.unsuccessful_responses
-            self.io_exceptions += counters.io_exceptions
+        self._counters["requests"].inc(counters.initialized_requests)
+        self._counters["unsuccessful_responses"].inc(
+            counters.unsuccessful_responses
+        )
+        self._counters["io_exceptions"].inc(counters.io_exceptions)
+
+    def as_dict(self) -> Dict[str, int]:
+        """The manifest's ``io_stats`` block (``obs/manifest.py``) — the
+        same numbers ``__str__`` prints."""
+        return {
+            "partitions": self.partitions,
+            "reference_bases": self.reference_bases,
+            "variants": self.variants,
+            "requests": self.requests,
+            "unsuccessful_responses": self.unsuccessful_responses,
+            "io_exceptions": self.io_exceptions,
+        }
 
     def __str__(self) -> str:
         return (

@@ -38,11 +38,28 @@ class ShardBoundary(enum.Enum):
 
 @dataclass
 class ClientCounters:
-    """I/O health counters (``Client.scala:50-54``)."""
+    """I/O health counters (``Client.scala:50-54``).
+
+    Mutate through the ``add_*`` methods — the one place the counting
+    semantics live (and the seam the graftcheck GC009 rule points ad-hoc
+    ``counters.x += n`` sites at). Each client session is single-threaded
+    (one per partition worker), so plain ints suffice; the aggregation
+    into the registry-backed run stats happens at flush time
+    (``pipeline/stats.py:add_client``).
+    """
 
     initialized_requests: int = 0
     unsuccessful_responses: int = 0
     io_exceptions: int = 0
+
+    def add_request(self, n: int = 1) -> None:
+        self.initialized_requests += n
+
+    def add_unsuccessful_response(self, n: int = 1) -> None:
+        self.unsuccessful_responses += n
+
+    def add_io_exception(self, n: int = 1) -> None:
+        self.io_exceptions += n
 
 
 @dataclass(frozen=True)

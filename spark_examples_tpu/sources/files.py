@@ -825,13 +825,64 @@ class StreamCounters:
     """I/O-stats accounting filled during one streaming pass, mirroring the
     in-memory packed path's numbers exactly: ``requests`` are pages per
     shard over PRE-filter rows (at least one per shard, empty included),
-    ``variants`` are post-filter kept rows."""
+    ``variants`` are post-filter kept rows.
 
-    def __init__(self, num_shards: int, page_size: int = FILE_PAGE_SIZE):
+    ``registry`` (the run's metrics registry, optional) gets live progress
+    gauges as the pass advances — ``ingest_sites_scanned`` (rows attributed
+    to shard windows so far) and ``ingest_partitions_done`` (windows the
+    file-order cursor has reached) — because the driver flushes these
+    counters into its I/O stats only AFTER the stream is fully consumed;
+    without the gauges a multi-hour streaming ingest would heartbeat 0/N
+    the whole way.
+    """
+
+    def __init__(
+        self,
+        num_shards: int,
+        page_size: int = FILE_PAGE_SIZE,
+        registry=None,
+    ):
         self.num_shards = int(num_shards)
         self.page_size = int(page_size)
         self.shard_rows: Dict[int, int] = {}
         self.variants = 0
+        self._rows_seen = 0
+        self._reached: set = set()
+        self._sites_gauge = self._done_gauge = None
+        if registry is not None:
+            from spark_examples_tpu.obs.metrics import (
+                INGEST_PARTITIONS_DONE,
+                INGEST_SITES_SCANNED,
+                well_known_gauge,
+            )
+
+            self._sites_gauge = well_known_gauge(
+                registry, INGEST_SITES_SCANNED
+            )
+            self._done_gauge = well_known_gauge(
+                registry, INGEST_PARTITIONS_DONE
+            )
+
+    def mark_window_reached(self, shard_index: int) -> None:
+        """The file-order cursor reached this window — counted whether or
+        not any record fell inside it, so the heartbeat's done/planned
+        progress converges even with empty shard windows."""
+        self._reached.add(shard_index)
+        if self._done_gauge is not None:
+            self._done_gauge.set(len(self._reached))
+
+    def add_shard_rows(self, shard_index: int, n: int) -> None:
+        """Pre-filter rows attributed to one shard window (page accounting
+        derives from these in :meth:`requests`)."""
+        self.shard_rows[shard_index] = self.shard_rows.get(shard_index, 0) + n
+        self._rows_seen += n
+        if self._sites_gauge is not None:
+            self._sites_gauge.set(self._rows_seen)
+        self.mark_window_reached(shard_index)
+
+    def add_variants(self, n: int) -> None:
+        """Post-filter kept rows."""
+        self.variants += n
 
     def requests(self) -> int:
         nonempty = sum(
@@ -973,6 +1024,10 @@ class _StreamedVcf:
                 run_lo, run_hi = int(pos[0]), int(pos[-1])
                 p = cursor[name]
                 while p < len(lst) and lst[p][1] <= run_lo:
+                    # Window wholly behind the stream — reached (possibly
+                    # empty), never revived.
+                    if counters is not None:
+                        counters.mark_window_reached(lst[p][2])
                     p += 1
                 cursor[name] = p
                 af_run = af[run]
@@ -980,14 +1035,14 @@ class _StreamedVcf:
                 for start, end, idx in lst[p:]:
                     if start > run_hi:
                         break
+                    if counters is not None:
+                        counters.mark_window_reached(idx)
                     lo = int(np.searchsorted(pos, start, side="left"))
                     hi = int(np.searchsorted(pos, end, side="left"))
                     if hi <= lo:
                         continue
                     if counters is not None:
-                        counters.shard_rows[idx] = (
-                            counters.shard_rows.get(idx, 0) + hi - lo
-                        )
+                        counters.add_shard_rows(idx, hi - lo)
                     s_pos, s_af, s_hv = pos[lo:hi], af_run[lo:hi], hv_run[lo:hi]
                     if min_allele_frequency is not None:
                         # The reference's rule (``VariantsPca.scala:
@@ -1001,7 +1056,7 @@ class _StreamedVcf:
                         if not nonzero.any():
                             continue
                         if counters is not None:
-                            counters.variants += int(nonzero.sum())
+                            counters.add_variants(int(nonzero.sum()))
                         yield {
                             "positions": s_pos[off : off + block_size][nonzero],
                             "has_variation": hv_block[nonzero].astype(np.uint8),
@@ -1032,11 +1087,11 @@ class FileClient(GenomicsClient):
                 )
             for record in table.query(contig, start, end, boundary):
                 if emitted % page_size == 0:
-                    self.counters.initialized_requests += 1
+                    self.counters.add_request()
                 emitted += 1
                 yield record
         if emitted == 0:
-            self.counters.initialized_requests += 1  # the empty page
+            self.counters.add_request()  # the empty page
 
     def search_variants(
         self,
@@ -1217,9 +1272,7 @@ class FileGenomicsSource(GenomicsSource):
         variants)."""
         positions, af, hv = view.window(shard)
         if counters is not None and shard_index is not None and len(positions):
-            counters.shard_rows[shard_index] = counters.shard_rows.get(
-                shard_index, 0
-            ) + len(positions)
+            counters.add_shard_rows(shard_index, len(positions))
         if min_allele_frequency is not None:
             # The reference's rule (``VariantsPca.scala:136-148``): strictly
             # greater, first AF value, records without AF dropped (NaN here;
@@ -1232,7 +1285,7 @@ class FileGenomicsSource(GenomicsSource):
             if not nonzero.any():
                 continue
             if counters is not None:
-                counters.variants += int(nonzero.sum())
+                counters.add_variants(int(nonzero.sum()))
             yield {
                 "positions": positions[off : off + block_size][nonzero],
                 "has_variation": hv_block[nonzero].astype(np.uint8),

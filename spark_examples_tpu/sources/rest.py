@@ -88,11 +88,11 @@ class RestClient(GenomicsClient):
         url = f"{self.base_url}/{path}"
         last_error: Optional[Exception] = None
         for attempt in range(self.max_retries):
-            self.counters.initialized_requests += 1
+            self.counters.add_request()
             try:
                 return self.transport(url, payload, self._headers())
             except urllib.error.HTTPError as e:
-                self.counters.unsuccessful_responses += 1
+                self.counters.add_unsuccessful_response()
                 if not _retryable_http(e.code):
                     raise RuntimeError(
                         f"request to {url} failed with HTTP {e.code} "
@@ -100,7 +100,7 @@ class RestClient(GenomicsClient):
                     ) from e
                 last_error = e
             except (urllib.error.URLError, OSError) as e:
-                self.counters.io_exceptions += 1
+                self.counters.add_io_exception()
                 last_error = e
             if attempt + 1 < self.max_retries:
                 ceiling = min(self.backoff_cap, self.backoff_base * (2**attempt))

@@ -633,12 +633,12 @@ class SyntheticClient(GenomicsClient):
                 if site_end <= start or pos >= end:
                     continue
             if emitted % page_size == 0:
-                self.counters.initialized_requests += 1
+                self.counters.add_request()
             emitted += 1
             yield src.variant_json(variant_set_id, contig_name, pos)
         if emitted == 0:
             # Even an empty shard costs one request.
-            self.counters.initialized_requests += 1
+            self.counters.add_request()
 
     def search_reads(
         self,
@@ -663,11 +663,11 @@ class SyntheticClient(GenomicsClient):
                 if boundary is ShardBoundary.OVERLAPS and pos + src.read_length <= start:
                     continue
                 if emitted % page_size == 0:
-                    self.counters.initialized_requests += 1
+                    self.counters.add_request()
                 emitted += 1
                 yield src.read_json(read_group_set_id, contig_name, pos, tile)
         if emitted == 0:
-            self.counters.initialized_requests += 1
+            self.counters.add_request()
 
 
 __all__ = ["SyntheticGenomicsSource", "SyntheticClient"]

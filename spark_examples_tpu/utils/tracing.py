@@ -4,8 +4,9 @@ The reference delegated observability to the Spark UI (stage timelines on
 ports 8080/4040, ``README.md:148-178``) and log4j. The TPU equivalents:
 
 - :class:`StageTimes` — coarse per-stage wall-clock accounting for the
-  driver pipeline (the moral equivalent of the Spark stage timeline),
-  printed after the I/O stats report;
+  driver pipeline, now a thin shim over the hierarchical span recorder
+  (``obs/spans.py``): every stage it times is also a span in the run
+  manifest, while the printed report stays byte-identical;
 - :func:`device_trace` — a ``jax.profiler`` trace context producing a
   TensorBoard-loadable profile of the XLA ops (the fine-grained equivalent
   of drilling into a Spark stage), enabled by ``--profile-dir``.
@@ -14,19 +15,29 @@ Honest-timing note (remote-attached backends): dispatch is asynchronous and
 ``block_until_ready`` can ACK before execution completes, so a stage's wall
 time is only meaningful when the stage ends in a synchronous fetch (the
 driver's PCA stage does) or when ``sync=`` passes a device value to fetch.
+The span recorder carries this as the per-span ``synced`` flag.
 """
 
 from __future__ import annotations
 
 import contextlib
-import time
 from typing import Callable, Dict, List, Optional, Tuple
+
+from spark_examples_tpu.obs.spans import SpanRecorder
 
 
 class StageTimes:
-    """Ordered per-stage wall-clock accounting."""
+    """Ordered per-stage wall-clock accounting, recorded as spans.
 
-    def __init__(self) -> None:
+    ``recorder`` shares the run's :class:`SpanRecorder` (stages nest under
+    whatever span is open, and deeper phases nest under the stages); a
+    private recorder is created otherwise. ``stages`` keeps the historical
+    ``[(name, seconds)]`` list so ``as_dict()`` and the printed report are
+    unchanged.
+    """
+
+    def __init__(self, recorder: Optional[SpanRecorder] = None) -> None:
+        self.recorder = recorder if recorder is not None else SpanRecorder()
         self.stages: List[Tuple[str, float]] = []
 
     @contextlib.contextmanager
@@ -34,13 +45,13 @@ class StageTimes:
         """Time a stage; ``sync`` (if given) is called before closing the
         measurement to force outstanding device work to completion — pass a
         tiny fetch, e.g. ``lambda: jax.device_get(counter)``."""
-        start = time.perf_counter()
+        span = None
         try:
-            yield self
+            with self.recorder.span(name, sync=sync) as span:
+                yield self
         finally:
-            if sync is not None:
-                sync()
-            self.stages.append((name, time.perf_counter() - start))
+            if span is not None and span.seconds is not None:
+                self.stages.append((name, span.seconds))
 
     def as_dict(self) -> Dict[str, float]:
         return dict(self.stages)

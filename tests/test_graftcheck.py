@@ -209,6 +209,45 @@ def test_gc008_print_under_jit():
     assert _ids(lint_source(src, "anywhere.py")) == [("GC008", 5)]
 
 
+def test_gc009_ad_hoc_stats_mutation():
+    bad = textwrap.dedent(
+        """
+        def account(self, io_stats, n):
+            io_stats.requests += n
+            self.counters.initialized_requests += 1
+            self.stream_counters.variants += n
+        """
+    )
+    assert _ids(lint_source(bad, "pipeline/fixture.py")) == [
+        ("GC009", 3),
+        ("GC009", 4),
+        ("GC009", 5),
+    ]
+    # Methods on the owner (`self.x += n` inside the stats class) and
+    # non-stats objects stay clean, as does out-of-scope code.
+    good = textwrap.dedent(
+        """
+        class StreamCounters:
+            def add_variants(self, n):
+                self.variants += n
+
+        def feed(acc, io_stats, n):
+            acc.rows_seen += n
+            io_stats.add_requests(n)
+        """
+    )
+    assert lint_source(good, "sources/fixture.py") == []
+    assert lint_source(bad, "utils/fixture.py") == []
+
+
+def test_gc009_disable_escape_hatch():
+    src = (
+        "def f(io_stats):\n"
+        "    io_stats.requests += 1  # graftcheck: disable=GC009 -- oracle\n"
+    )
+    assert lint_source(src, "pipeline/fixture.py") == []
+
+
 # --------------------------------------------------------------------------
 # Escape hatches.
 # --------------------------------------------------------------------------
