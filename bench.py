@@ -97,6 +97,21 @@ CONFIGS = {
         "num_samples": 25_000,
         "baseline_seconds": None,
     },
+    "large-cohort-sharded": {
+        # The SHARDED large-cohort regime (getSimilarityMatrixStream's
+        # memory-bounded analog): same 25,000-sample chr17 workload forced
+        # through the samples-sharded ring so the bit-packed, overlapped
+        # ring exchange (ops/gramian.py:_ring_tiles) is measured — and its
+        # gramian_ring_bytes manifest counter surfaces packed-vs-unpacked
+        # ICI traffic directly. Needs >= 2 devices for a samples axis; the
+        # mesh is resolved at runtime (all devices on samples).
+        "metric": "large-cohort (25,000 samples) chr17 sharded-ring PCoA wall-clock",
+        "args": ["--references", "17:0:81195210"],
+        "sets": ["bench-1kg"],
+        "num_samples": 25_000,
+        "sharded": True,
+        "baseline_seconds": None,
+    },
     "merged": {
         # The reference's ACTUAL joint-cohort scenario: 1000 Genomes (2,504
         # samples) joined with Platinum (~17 deep genomes) at shared sites
@@ -268,9 +283,23 @@ def _make_driver(conf_args, source):
 
 
 def _run_config(name: str, device) -> dict:
+    import jax
+
     from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource
 
     config = CONFIGS[name]
+    if config.get("sharded") and len(jax.devices()) < 2:
+        return {
+            "metric": config["metric"],
+            "value": None,
+            "unit": "s",
+            "vs_baseline": None,
+            "details": {
+                "skipped": "sharded ring needs >= 2 devices for a samples "
+                f"axis; have {len(jax.devices())}",
+                "device": str(device),
+            },
+        }
     n_sets = len(config["sets"])
     n_samples = config.get("num_samples", N_SAMPLES)
     cohort_sizes = config.get("cohort_sizes")
@@ -300,6 +329,13 @@ def _run_config(name: str, device) -> dict:
     ]
     if BLOCKS_PER_DISPATCH is not None:
         base_args += ["--blocks-per-dispatch", str(BLOCKS_PER_DISPATCH)]
+    if config.get("sharded"):
+        # All devices on the samples axis: the ring spans the whole chip
+        # set and every device holds one row tile of the padded Gramian.
+        base_args += [
+            "--mesh-shape", f"1,{len(jax.devices())}",
+            "--similarity-strategy", "sharded",
+        ]
     source = SyntheticGenomicsSource(
         num_samples=n_samples,
         seed=42,
@@ -342,6 +378,8 @@ def _run_config(name: str, device) -> dict:
     )
     from spark_examples_tpu.obs.metrics import (
         DEVICEGEN_DISPATCHES,
+        DEVICEGEN_SITES_CAPACITY,
+        GRAMIAN_RING_BYTES,
         INGEST_SITES_SCANNED,
     )
 
@@ -366,9 +404,23 @@ def _run_config(name: str, device) -> dict:
     assert len(result) == total_columns
     assert all(len(pcs) == 2 for _, pcs in result)
 
-    # Device ingest data-parallelizes over the mesh data axis when more than
-    # one chip is visible — report throughput per chip actually used.
-    chips_used = getattr(acc, "data_parallel", 1)
+    # Dispatch padding waste: grid capacity dispatched (tail-group padding
+    # included) vs the valid sites inside it — the fixed small-run overhead
+    # that puts brca1 ~3 orders of magnitude below whole-genome throughput.
+    sites_capacity = metric(DEVICEGEN_SITES_CAPACITY)
+    padding_waste = (
+        round(1.0 - sites_scanned / sites_capacity, 4) if sites_capacity else 0.0
+    )
+    # Ring-exchange ICI traffic (sharded configs only): straight from the
+    # manifest counter, so packed-vs-unpacked is visible per artifact.
+    ring_bytes = manifest_metric_value(manifest, GRAMIAN_RING_BYTES)
+
+    # Device ingest parallelizes over the mesh — report throughput per chip
+    # actually used: data axis × samples axis (the ring accumulator puts
+    # every chip to work on the samples axis even at data_parallel=1).
+    chips_used = getattr(acc, "data_parallel", 1) * getattr(
+        acc, "samples_parallel", 1
+    )
     baseline = config["baseline_seconds"]
     return {
         "metric": (
@@ -384,6 +436,13 @@ def _run_config(name: str, device) -> dict:
             "sites_per_sec_per_chip": round(sites_scanned / wall / chips_used),
             "chips_used": chips_used,
             "device_dispatches": dispatches,
+            "sites_capacity_dispatched": sites_capacity,
+            "dispatch_padding_waste_fraction": padding_waste,
+            **(
+                {"gramian_ring_bytes": int(ring_bytes)}
+                if ring_bytes is not None
+                else {}
+            ),
             "block_size": BLOCK,
             "blocks_per_dispatch": k_resolved,
             "compile_seconds_excluded": round(compile_seconds, 3),
@@ -468,9 +527,26 @@ def main() -> None:
             "value": r["value"],
             "unit": r["unit"],
             "vs_baseline": r["vs_baseline"],
-            "sites_scanned": r["details"]["sites_scanned"],
-            "sites_per_sec_per_chip": r["details"]["sites_per_sec_per_chip"],
-            "compile_seconds_excluded": r["details"]["compile_seconds_excluded"],
+            # .get: a skipped config (e.g. sharded ring on one device)
+            # reports only its skip reason.
+            "sites_scanned": r["details"].get("sites_scanned"),
+            "sites_per_sec_per_chip": r["details"].get("sites_per_sec_per_chip"),
+            "compile_seconds_excluded": r["details"].get(
+                "compile_seconds_excluded"
+            ),
+            "dispatch_padding_waste_fraction": r["details"].get(
+                "dispatch_padding_waste_fraction"
+            ),
+            **(
+                {"gramian_ring_bytes": r["details"]["gramian_ring_bytes"]}
+                if "gramian_ring_bytes" in r["details"]
+                else {}
+            ),
+            **(
+                {"skipped": r["details"]["skipped"]}
+                if "skipped" in r["details"]
+                else {}
+            ),
         }
         for name, r in results.items()
     }

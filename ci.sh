@@ -9,7 +9,12 @@
 #   3. obs smoke — a tiny synthetic PCA run with --metrics-json and a
 #      1 s heartbeat; the produced run manifest must validate against the
 #      schema (obs/manifest.py:validate_manifest) and carry I/O stats.
-#   4. sanitize (opt-in: `ci.sh --sanitize`) — ASAN/UBSAN/TSAN replay of
+#   4. sharded-ring smoke — a 4-virtual-device sharded run (tiny synthetic
+#      cohort) twice: packed ring (--ring-pack-bits on) vs the unpacked
+#      oracle (off). Result rows must be byte-identical and the manifests'
+#      gramian_ring_bytes must show the >= 8x packed traffic reduction —
+#      the ring path can never regress silently on a CPU-only runner.
+#   5. sanitize (opt-in: `ci.sh --sanitize`) — ASAN/UBSAN/TSAN replay of
 #      the VCF fuzz corpus against the native parser; skips gracefully
 #      when no C++ compiler is available.
 # Run from the repo root. Exit code: first failing stage wins, tier-1 first.
@@ -63,6 +68,54 @@ else
 fi
 rm -rf "$OBS_TMP"
 
+echo "== sharded-ring smoke (4 virtual devices, packed vs oracle) =="
+ring_rc=0
+RING_TMP=$(mktemp -d)
+# N=64 over a samples axis of 4 keeps the local width (16) a multiple of 8
+# in BOTH wire formats, so the two runs do identical work and the traffic
+# ratio is exactly 8 (no ragged-byte slack in the assertion).
+ring_flags="--num-samples 64 --references 1:0:400000 --mesh-shape 1,4 \
+  --similarity-strategy sharded --block-size 64"
+for mode in on off; do
+  env JAX_PLATFORMS=cpu SPARK_EXAMPLES_TPU_PLATFORM=cpu \
+      SPARK_EXAMPLES_TPU_NO_CACHE=1 \
+      XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    python -m spark_examples_tpu variants-pca $ring_flags \
+      --ring-pack-bits "$mode" --metrics-json "$RING_TMP/$mode.json" \
+      > "$RING_TMP/$mode.out" 2> "$RING_TMP/$mode.err" || ring_rc=$?
+done
+if [ "$ring_rc" -eq 0 ]; then
+  # Result rows only (lines with tabs): the manifest-path echo differs.
+  grep -P "\t" "$RING_TMP/on.out" > "$RING_TMP/on.tsv"
+  grep -P "\t" "$RING_TMP/off.out" > "$RING_TMP/off.tsv"
+  if ! cmp -s "$RING_TMP/on.tsv" "$RING_TMP/off.tsv"; then
+    echo "packed ring result rows DIFFER from the --ring-pack-bits off oracle"
+    ring_rc=1
+  fi
+fi
+if [ "$ring_rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python - "$RING_TMP/on.json" "$RING_TMP/off.json" <<'PYEOF' || ring_rc=$?
+import sys
+from spark_examples_tpu.obs.manifest import manifest_metric_value, read_manifest
+from spark_examples_tpu.obs.metrics import GRAMIAN_RING_BYTES
+packed, oracle = (
+    manifest_metric_value(read_manifest(path), GRAMIAN_RING_BYTES)
+    for path in sys.argv[1:3]
+)
+if not packed or not oracle:
+    print(f"manifest missing {GRAMIAN_RING_BYTES} (packed={packed}, oracle={oracle})")
+    sys.exit(1)
+if oracle < 8 * packed:
+    print(f"packed ring traffic not >= 8x smaller: packed={packed} oracle={oracle}")
+    sys.exit(1)
+print(f"ring smoke OK: parity exact, ring bytes {int(oracle)} -> {int(packed)} "
+      f"({oracle / packed:.1f}x reduction)")
+PYEOF
+else
+  echo "sharded-ring smoke failed (rc=$ring_rc):"; tail -20 "$RING_TMP"/*.err
+fi
+rm -rf "$RING_TMP"
+
 san_rc=0
 if [ "$SANITIZE" = "1" ]; then
   echo "== sanitizer stage (graftcheck sanitize) =="
@@ -72,4 +125,5 @@ fi
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 if [ "$lint_rc" -ne 0 ]; then exit "$lint_rc"; fi
 if [ "$obs_rc" -ne 0 ]; then exit "$obs_rc"; fi
+if [ "$ring_rc" -ne 0 ]; then exit "$ring_rc"; fi
 exit "$san_rc"

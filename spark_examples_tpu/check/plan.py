@@ -231,21 +231,101 @@ def _eval_dense_update(report: PlanReport, data: int, conf: PcaConf) -> None:
         )
 
 
+#: Simultaneous per-device buffers of the sharded strategy at peak: the
+#: local G row-tile, its non-donated update output, and the (smaller)
+#: column-block operands rounded up to one more tile.
+_SHARDED_BUFFERS = 3
+
+
 def _eval_sharded_update(
     report: PlanReport, data: int, samples: int, conf: PcaConf
 ) -> None:
     """Trace the sharded ring update through shard_map over an
     ``AbstractMesh`` — the same `_ring_tiles` body the run executes, with
-    the same PartitionSpecs ``ShardedGramianAccumulator`` installs, proven
-    shape-correct with zero devices."""
+    the same PartitionSpecs ``ShardedGramianAccumulator`` installs and the
+    same wire format ``--ring-pack-bits`` selects, proven shape-correct
+    with zero devices. Also the home of the sharded geometry facts: the
+    pack-width-padded cohort (auto-rounded exactly as the accumulators
+    round it), per-device ring buffer bytes, per-flush ICI ring traffic,
+    and the sharded HBM feasibility check."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import PartitionSpec as P
 
-    from spark_examples_tpu.ops.gramian import _ring_tiles
-    from spark_examples_tpu.parallel.mesh import DATA_AXIS, SAMPLES_AXIS
+    from spark_examples_tpu.ops.gramian import (
+        _DEFAULT_DEVICE_BYTES,
+        DENSE_HBM_FRACTION,
+        _ring_tiles,
+        resolve_ring_pack,
+    )
+    from spark_examples_tpu.parallel.mesh import (
+        DATA_AXIS,
+        RING_PACK_MULTIPLE,
+        SAMPLES_AXIS,
+        padded_cohort,
+        ring_traffic_bytes,
+    )
     from spark_examples_tpu.utils.compat import shard_map
+
+    N = int(conf.num_samples)
+    B = int(conf.block_size)
+    pack = resolve_ring_pack(getattr(conf, "ring_pack_bits", "auto"))
+    padded = padded_cohort(N, samples, pack=pack)
+    n_local = padded // samples
+    if pack and n_local % RING_PACK_MULTIPLE:
+        # Unreachable through padded_cohort — a defensive contract check so
+        # a future geometry change cannot silently ship a ragged packed
+        # tile (the ring would shard mid-byte and corrupt columns).
+        report.error(
+            "ring-pack-width",
+            f"packed ring needs a per-device column width divisible by "
+            f"{RING_PACK_MULTIPLE}, got {n_local} "
+            f"(padded cohort {padded} over samples={samples})",
+        )
+        return
+    if padded != N:
+        rule = (
+            f"{RING_PACK_MULTIPLE}x the samples axis (packed-ring "
+            "pack-width invariant)"
+            if pack
+            else f"the samples axis ({samples})"
+        )
+        report.warn(
+            "cohort-padding",
+            f"--num-samples {N} is not a multiple of {rule}; the sharded "
+            f"accumulator auto-rounds the cohort to {padded} "
+            f"(+{(padded - N) * 100.0 / N:.1f}% all-zero pad columns, "
+            "trimmed at finalize)",
+        )
+    width = n_local // RING_PACK_MULTIPLE if pack else n_local
+    report.geometry["ring_pack_bits"] = "packed" if pack else "unpacked"
+    report.geometry["ring_local_columns"] = n_local
+    report.geometry["ring_tile_bytes_per_device"] = B * width
+    report.geometry["ring_bytes_per_flush"] = ring_traffic_bytes(
+        data * B, samples, n_local, pack
+    )
+    # Sharded HBM feasibility against the default budget (the validator
+    # never queries devices): per device, the local (padded/samples, padded)
+    # accumulator tile dominates, times the non-donation working copies.
+    accum_bytes = 4
+    tile_bytes = n_local * padded * accum_bytes
+    report.geometry["sharded_tile_bytes_per_device"] = tile_bytes
+    if (
+        conf.similarity_strategy == "sharded"
+        and _SHARDED_BUFFERS * tile_bytes
+        > DENSE_HBM_FRACTION * _DEFAULT_DEVICE_BYTES
+    ):
+        report.error(
+            "sharded-exceeds-hbm",
+            f"--similarity-strategy sharded with N={N} over samples="
+            f"{samples} needs ~"
+            f"{_SHARDED_BUFFERS * tile_bytes / (1 << 30):.1f} GiB of "
+            f"ring working buffers per device, past "
+            f"{DENSE_HBM_FRACTION:.0%} of the "
+            f"{_DEFAULT_DEVICE_BYTES >> 30} GiB default budget; widen the "
+            "samples axis",
+        )
 
     try:
         from jax.sharding import AbstractMesh
@@ -257,16 +337,6 @@ def _eval_sharded_update(
         )
         return
 
-    N = int(conf.num_samples)
-    B = int(conf.block_size)
-    padded = -(-N // samples) * samples
-    if padded != N:
-        report.warn(
-            "cohort-padding",
-            f"--num-samples {N} is not divisible by the samples axis "
-            f"({samples}); the sharded accumulator pads to {padded} "
-            f"(+{(padded - N) * 100.0 / N:.1f}% wasted rows/columns)",
-        )
     operand = np.int8 if conf.exact_similarity else np.float32
     accum = jnp.int32 if conf.exact_similarity else jnp.float32
     mesh = AbstractMesh(((DATA_AXIS, data), (SAMPLES_AXIS, samples)))
@@ -276,7 +346,7 @@ def _eval_sharded_update(
     def update(G, X):
         def per_slice(G_local, X_local):
             return _ring_tiles(
-                G_local[0], X_local[0], SAMPLES_AXIS, operand
+                G_local[0], X_local[0], SAMPLES_AXIS, operand, packed=pack
             )[None]
 
         return shard_map(
@@ -284,7 +354,8 @@ def _eval_sharded_update(
         )(G, X)
 
     G = jax.ShapeDtypeStruct((data, padded, padded), accum)
-    X = jax.ShapeDtypeStruct((data, B, padded), jnp.uint8)
+    x_width = padded // RING_PACK_MULTIPLE if pack else padded
+    X = jax.ShapeDtypeStruct((data, B, x_width), jnp.uint8)
     try:
         out = jax.eval_shape(update, G, X)
     except Exception as e:
@@ -300,9 +371,11 @@ def _eval_sharded_update(
             f"sharded update maps {G.shape} to {out.shape}",
         )
     else:
+        wire = "bit-packed" if pack else "unpacked"
         report.shape_checks.append(
             f"sharded ring update over abstract {data}x{samples} mesh: "
-            f"({data}, {B}, {padded}) uint8 blocks -> G {out.shape} {out.dtype}"
+            f"({data}, {B}, {x_width}) {wire} uint8 blocks -> "
+            f"G {out.shape} {out.dtype}"
         )
 
 
@@ -351,6 +424,14 @@ def validate_plan(
             "device-ingest-backend",
             "--ingest device requires --pca-backend tpu",
         )
+    try:
+        # Programmatic PcaConf construction bypasses argparse's choices;
+        # validate through the ONE runtime resolver, never a copied set.
+        from spark_examples_tpu.ops.gramian import resolve_ring_pack
+
+        resolve_ring_pack(getattr(conf, "ring_pack_bits", "auto"))
+    except ValueError as e:
+        report.error("ring-pack-bits", str(e))
 
     # -------------------------------------------------------- shard windows
     n_shards: Optional[int] = None

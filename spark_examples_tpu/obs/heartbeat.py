@@ -26,6 +26,8 @@ Well-known metric names sampled (producers register them; see DESIGN.md §9):
   ``--num-reduce-partitions``-bounded shard progress and ETA
 - ``prefetch_queue_occupancy`` / ``prefetch_queue_depth`` (gauges)
 - ``gramian_inflight_dispatches`` (gauge)
+- ``gramian_ring_bytes`` (counter, sharded paths) — cumulative ICI ring
+  traffic, the number ``--ring-pack-bits`` cuts 8×
 - device memory from ``jax.local_devices()[0].memory_stats()`` when the
   backend reports it (TPU does; CPU test devices do not).
 
@@ -43,6 +45,7 @@ from typing import Callable, Optional
 
 from spark_examples_tpu.obs.metrics import (
     GRAMIAN_INFLIGHT_DISPATCHES,
+    GRAMIAN_RING_BYTES,
     INGEST_PARTITIONS_DONE,
     INGEST_PARTITIONS_PLANNED,
     INGEST_SITES_SCANNED,
@@ -51,6 +54,13 @@ from spark_examples_tpu.obs.metrics import (
     PREFETCH_QUEUE_DEPTH,
     PREFETCH_QUEUE_OCCUPANCY,
 )
+
+
+def _bytes_text(count: float) -> str:
+    for bound, unit in ((1 << 30, "GiB"), (1 << 20, "MiB"), (1 << 10, "KiB")):
+        if count >= bound:
+            return f"{count / bound:.1f} {unit}"
+    return f"{int(count)} B"
 
 
 def _device_memory_line() -> Optional[str]:
@@ -199,6 +209,10 @@ class Heartbeat:
         in_flight = self.registry.value(GRAMIAN_INFLIGHT_DISPATCHES)
         if in_flight is not None:
             parts.append(f"dispatch in-flight {int(in_flight)}")
+
+        ring_bytes = self.registry.value(GRAMIAN_RING_BYTES)
+        if ring_bytes:
+            parts.append(f"ring traffic {_bytes_text(ring_bytes)}")
 
         memory = _device_memory_line()
         if memory is not None:

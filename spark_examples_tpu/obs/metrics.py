@@ -50,6 +50,13 @@ PREFETCH_QUEUE_DEPTH = "prefetch_queue_depth"
 PREFETCH_QUEUE_OCCUPANCY = "prefetch_queue_occupancy"
 GRAMIAN_INFLIGHT_DISPATCHES = "gramian_inflight_dispatches"
 DEVICEGEN_DISPATCHES = "devicegen_dispatches"
+DEVICEGEN_SITES_CAPACITY = "devicegen_sites_capacity"
+
+#: Well-known ring-exchange telemetry (sharded Gramian paths). The bytes
+#: counter is the number the bit-packed wire format cuts 8×; CI's
+#: sharded-ring smoke asserts the packed/oracle ratio from run manifests.
+GRAMIAN_RING_BYTES = "gramian_ring_bytes"
+GRAMIAN_RING_FLUSH_SECONDS = "gramian_ring_flush_seconds"
 
 #: Registry-backed stats counter the heartbeat's per-shard progress reads
 #: (registered by ``pipeline/stats.py:_STAT_METRICS``, spelled once here).
@@ -76,6 +83,19 @@ _WELL_KNOWN_GAUGE_HELP = {
     DEVICEGEN_DISPATCHES: (
         "Fused generate+accumulate device dispatches issued."
     ),
+    DEVICEGEN_SITES_CAPACITY: (
+        "Site-grid capacity of every dispatch issued (padding included, "
+        "summed over data slices) — the denominator of the dispatch "
+        "padding-waste fraction against ingest_sites_scanned."
+    ),
+}
+
+_WELL_KNOWN_COUNTER_HELP = {
+    GRAMIAN_RING_BYTES: (
+        "Total ICI bytes moved by ring-exchange ppermutes (sharded "
+        "Gramian); the bit-packed wire format cuts this 8x vs unpacked "
+        "uint8 tiles."
+    ),
 }
 
 
@@ -83,6 +103,14 @@ def well_known_gauge(registry: "MetricsRegistry", name: str):
     """Register (idempotently) one of the heartbeat's well-known gauges
     with its canonical help text."""
     return registry.gauge(name, _WELL_KNOWN_GAUGE_HELP[name])
+
+
+def well_known_counter(registry: "MetricsRegistry", name: str):
+    """Register (idempotently) a well-known counter with its canonical help
+    text — one spelling shared by every producer (``ops/gramian.py``'s
+    flush telemetry and the driver's device-ingest epilogue), the heartbeat,
+    bench.py, and CI's manifest assertions."""
+    return registry.counter(name, _WELL_KNOWN_COUNTER_HELP[name])
 
 
 def _check_name(name: str) -> str:
@@ -464,7 +492,11 @@ __all__ = [
     "PREFETCH_QUEUE_DEPTH",
     "PREFETCH_QUEUE_OCCUPANCY",
     "GRAMIAN_INFLIGHT_DISPATCHES",
+    "GRAMIAN_RING_BYTES",
+    "GRAMIAN_RING_FLUSH_SECONDS",
     "DEVICEGEN_DISPATCHES",
+    "DEVICEGEN_SITES_CAPACITY",
     "IO_PARTITIONS_TOTAL",
     "well_known_gauge",
+    "well_known_counter",
 ]

@@ -506,6 +506,15 @@ class _GridDispatchAccumulator:
     #: once; see :meth:`poke` and the dispatch-loop gating).
     _poked = False
 
+    #: dispatched site-grid CAPACITY (summed over data slices — every slice
+    #: executes the full scan, padding included) vs the VALID sites inside
+    #: it. Their gap is the dispatch padding waste (``bench.py`` reports the
+    #: fraction per config; at small regions the fixed tail-group padding
+    #: dominates wall-clock), and capacity × per-site ring traffic gives
+    #: ``ring_bytes_total`` for the ring accumulator.
+    sites_capacity = 0
+    sites_valid = 0
+
     def add_ranges(self, grid_offsets: np.ndarray, n_valids: np.ndarray) -> None:
         """Data-parallel dispatch: slice d processes grid indices
         ``[grid_offsets[d], grid_offsets[d] + n_valids[d])`` (``n_valids[d]
@@ -547,6 +556,8 @@ class _GridDispatchAccumulator:
                 device_put_global(n_valids, self._scalar_sharding),
             )
         self.dispatches += 1
+        self.sites_capacity += int(cap) * D
+        self.sites_valid += int(n_valids.sum())
 
     #: position of ``blocks_per_dispatch`` in both subclasses' update-key
     #: tuples (``_fused_update`` and ``_ring_update`` share the prefix
@@ -839,7 +850,9 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
             return
         self._dispatch_single(self._update, grid_offset, n_valid)
 
-    def _dispatch_single(self, update, grid_offset: int, n_valid: int) -> None:
+    def _dispatch_single(
+        self, update, grid_offset: int, n_valid: int, cap: Optional[int] = None
+    ) -> None:
         self._maybe_poke()
         with jax.enable_x64(True):
             self.G, self.variant_rows, self.kept_sites = update(
@@ -850,6 +863,10 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
                 jnp.asarray(np.int64(n_valid)),
             )
         self.dispatches += 1
+        self.sites_capacity += int(
+            self.sites_per_dispatch if cap is None else cap
+        )
+        self.sites_valid += int(n_valid)
 
     def add_grid(self, first_index: int, last_index: int) -> None:
         """Single-slice fast path keeps scalar dispatches; data-parallel
@@ -868,7 +885,7 @@ class DeviceGenGramianAccumulator(_GridDispatchAccumulator):
             tail_update, tail = self._tail_spec()
             while off < last_index:
                 self._dispatch_single(
-                    tail_update, off, min(tail, last_index - off)
+                    tail_update, off, min(tail, last_index - off), cap=tail
                 )
                 off += tail
 
@@ -918,6 +935,7 @@ def _ring_update(
     n_pops: int,
     mesh,
     set_sizes: Optional[Tuple[int, ...]] = None,
+    pack: bool = False,
 ):
     """Memoized scanned generate→ring-accumulate program for one static
     configuration (warmup and measured accumulators share one compiled
@@ -926,11 +944,16 @@ def _ring_update(
     source's population count (see :func:`_fused_update`). ``set_sizes``
     makes the column space a multi-set concatenation
     (:func:`generate_column_block`); ``variant_rows`` is then per set —
-    a row counts for set s when ANY of set s's columns vary."""
+    a row counts for set s when ANY of set s's columns vary. ``pack``
+    selects the bit-packed ring wire format: generated columns are packed
+    on device (8 genotypes/byte) before the first ``ppermute``, so the ring
+    moves ⅛ the ICI bytes; requires ``padded`` to satisfy the pack-width
+    invariant (local width a multiple of 8 —
+    ``parallel/mesh.py:padded_cohort``)."""
     from spark_examples_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from spark_examples_tpu.ops.gramian import _ring_tiles
+    from spark_examples_tpu.ops.gramian import _pack_bits_device, _ring_tiles
     from spark_examples_tpu.parallel.mesh import DATA_AXIS, SAMPLES_AXIS
 
     operand_dtype = np.dtype(operand_name)
@@ -1010,11 +1033,21 @@ def _ring_update(
                 # Same materialization barrier as the dense update: the ring
                 # exchange dots the local column block against every rotated
                 # tile, so a fused generation chain would recompute per tile
-                # AND per ring step.
-                x_cols = jax.lax.optimization_barrier(
-                    hv.astype(operand_dtype)
+                # AND per ring step. Under the packed wire format the
+                # barrier sits on the PACKED tile — the ⅛-size buffer is
+                # what the ring circulates, and packing right after
+                # generation keeps the u32 chain materialized exactly once.
+                if pack:
+                    x_cols = jax.lax.optimization_barrier(
+                        _pack_bits_device(hv.astype(jnp.uint8))
+                    )
+                else:
+                    x_cols = jax.lax.optimization_barrier(
+                        hv.astype(operand_dtype)
+                    )
+                g_l = _ring_tiles(
+                    g_l, x_cols, SAMPLES_AXIS, operand_dtype, packed=pack
                 )
-                g_l = _ring_tiles(g_l, x_cols, SAMPLES_AXIS, operand_dtype)
                 return (g_l, rows_l, kept_l), None
 
             (g_l, rows_l, kept_l), _ = jax.lax.scan(
@@ -1070,15 +1103,24 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
         n_pops: Optional[int] = None,
         set_sizes: Optional[Sequence[int]] = None,
         pops_per_set: Optional[Sequence[np.ndarray]] = None,
+        pack_bits: str = "auto",
     ):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from spark_examples_tpu.ops.gramian import _operand_dtypes
-        from spark_examples_tpu.parallel.mesh import DATA_AXIS, SAMPLES_AXIS
+        from spark_examples_tpu.ops.gramian import (
+            _operand_dtypes,
+            resolve_ring_pack,
+        )
+        from spark_examples_tpu.parallel.mesh import (
+            DATA_AXIS,
+            SAMPLES_AXIS,
+            padded_cohort,
+        )
 
         if SAMPLES_AXIS not in mesh.shape or mesh.shape[SAMPLES_AXIS] < 2:
             raise ValueError("ring device ingest needs a samples axis >= 2")
         self.mesh = mesh
+        self.pack = resolve_ring_pack(pack_bits)
         self.num_samples = int(num_samples)
         vs_keys = (
             tuple(int(k) for k in vs_key)
@@ -1117,9 +1159,11 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
             self.total_columns = self.num_samples
         self.samples_parallel = mesh.shape[SAMPLES_AXIS]
         self.data_parallel = mesh.shape.get(DATA_AXIS, 1)
-        self.padded = (
-            -(-self.total_columns // self.samples_parallel)
-            * self.samples_parallel
+        # Packed wire format pads the column space to 8× the samples axis
+        # (pack-width invariant); pad columns generate all-zero and finalize
+        # trims them, exactly like the plain samples-axis padding.
+        self.padded = padded_cohort(
+            self.total_columns, self.samples_parallel, pack=self.pack
         )
         self.n_local = self.padded // self.samples_parallel
         self.block_size = int(block_size)
@@ -1166,6 +1210,7 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
             else int(np.asarray(pops, dtype=np.int32).max()) + 1,
             mesh,
             self.set_sizes,
+            self.pack,
         )
         self._update = _ring_update(*self._update_key)
         self._tail_blocks = max(1, self.blocks_per_dispatch // 8)
@@ -1173,6 +1218,19 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
 
     def _compile_update(self, key):
         return _ring_update(*key)
+
+    @property
+    def ring_bytes_total(self) -> int:
+        """Total ICI bytes the ring exchanges have moved so far: every
+        dispatched site (padding included — padded rows ride the ring too)
+        costs one (samples-1)-step circulation of its row's column tiles
+        (``parallel/mesh.py:ring_traffic_bytes``). Deterministic host-side
+        arithmetic, published as ``gramian_ring_bytes`` by the driver."""
+        from spark_examples_tpu.parallel.mesh import ring_traffic_bytes
+
+        return ring_traffic_bytes(
+            self.sites_capacity, self.samples_parallel, self.n_local, self.pack
+        )
 
     def finalize_sharded(self) -> jax.Array:
         """(padded, padded) Gramian, row-sharded over ``samples`` — feeds

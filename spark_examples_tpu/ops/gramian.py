@@ -29,6 +29,17 @@ Two strategies, mirroring the reference's in-memory/streaming duality:
   ring exchange (``ppermute``) of sample-column blocks, so no device ever
   materializes the full N×N — the ~50K-samples/~20GB regime
   (``VariantsPca.scala:216-217``) at TPU HBM sizes.
+
+The ring wire format is BIT-PACKED by default (``--ring-pack-bits``): tiles
+circulate as ``(B, n_local/8)`` uint8 (8 genotypes/byte — ⅛ the ICI traffic
+of unpacked uint8) and are unpacked on device per step, and the ring loop is
+double-buffered — the ``ppermute`` for step k+1 is issued before the dot of
+step k consumes its tile, so XLA overlaps the ICI transfer with the MXU
+matmul instead of alternating them (the decomposed collective-matmul
+pattern; see DESIGN.md §7.4). ``--ring-pack-bits off`` keeps the unpacked
+wire as the bit-exact parity oracle. Host staging packs the same way before
+``device_put``, so host→device transfer shrinks 8× too (the dense path's
+``np.packbits`` trick, applied to the sharded staging buffer).
 """
 
 from __future__ import annotations
@@ -50,7 +61,25 @@ from spark_examples_tpu.parallel.mesh import (
     DATA_AXIS,
     SAMPLES_AXIS,
     device_put_global,
+    padded_cohort,
+    ring_traffic_bytes,
 )
+
+
+def resolve_ring_pack(pack_bits: str) -> bool:
+    """``--ring-pack-bits`` → whether the ring circulates packed tiles.
+
+    ``off`` is the unpacked bit-exact oracle; ``on`` packs; ``auto`` (the
+    default) currently equals ``on`` — the pack/unpack is a cheap VPU
+    shift-and-mask on every backend while the 8× traffic cut always helps,
+    so there is nothing for auto to decide yet (the spelling reserves room
+    for a future platform-conditional rule without a flag migration).
+    """
+    if pack_bits not in ("auto", "on", "off"):
+        raise ValueError(
+            f"--ring-pack-bits must be one of auto/on/off, got {pack_bits!r}"
+        )
+    return pack_bits != "off"
 
 
 def _operand_dtypes(exact_int: bool, mesh: Optional[Mesh] = None):
@@ -199,16 +228,35 @@ class _AccumulatorTelemetry:
     every flush feeds ``gramian_flushes_total`` / ``gramian_rows_total``
     counters and the ``gramian_flush_seconds`` histogram (all labeled by
     strategy), and ``gramian_inflight_dispatches`` tracks the pipelined
-    feed depth for the heartbeat. At finalize the accumulated host-side
-    flush time attaches to the open span tree as a ``dispatch`` aggregate
-    (one span, not one per flush — a whole-genome run has thousands) and
-    the finalize reduce itself runs under a ``reduce-flush`` span.
+    feed depth for the heartbeat. The sharded strategy additionally feeds
+    the ``gramian_ring_bytes`` counter (total ICI bytes its ring exchanges
+    moved — ``parallel/mesh.py:ring_traffic_bytes``, the number the packed
+    wire format cuts 8×) and the per-flush ``gramian_ring_flush_seconds``
+    histogram, both surfaced in the run manifest and the heartbeat. At
+    finalize the accumulated host-side flush time attaches to the open span
+    tree as a ``dispatch`` aggregate (one span, not one per flush — a
+    whole-genome run has thousands) and the finalize reduce itself runs
+    under a ``reduce-flush`` span.
     """
 
     def __init__(self, registry, spans, strategy: str):
         self.spans = spans
         self.flush_seconds_total = 0.0
         self._flushes = self._rows = self._seconds = self._inflight = None
+        self._ring_bytes = self._ring_seconds = None
+        if registry is not None and strategy == "sharded":
+            from spark_examples_tpu.obs.metrics import (
+                GRAMIAN_RING_BYTES,
+                GRAMIAN_RING_FLUSH_SECONDS,
+                well_known_counter,
+            )
+
+            self._ring_bytes = well_known_counter(registry, GRAMIAN_RING_BYTES)
+            self._ring_seconds = registry.histogram(
+                GRAMIAN_RING_FLUSH_SECONDS,
+                "Host-side seconds per ring-exchange flush "
+                "(pack + device_put + ring dispatch).",
+            )
         if registry is not None:
             labels = {"strategy": strategy}
             self._flushes = registry.counter(
@@ -243,6 +291,11 @@ class _AccumulatorTelemetry:
             self._seconds.observe(seconds)
             self._inflight.set(in_flight)
 
+    def record_ring(self, nbytes: int, seconds: float) -> None:
+        if self._ring_bytes is not None:
+            self._ring_bytes.inc(nbytes)
+            self._ring_seconds.observe(seconds)
+
     def finalize_span(self):
         """Context for the finalize reduce; also attaches the flush-time
         aggregate so the span tree reads ingest → dispatch → reduce-flush."""
@@ -262,6 +315,19 @@ def _unpack_bits(packed: jax.Array, num_columns: int) -> jax.Array:
     return bits.reshape(*packed.shape[:-1], packed.shape[-1] * 8)[
         ..., :num_columns
     ]
+
+
+def _pack_bits_device(bits: jax.Array) -> jax.Array:
+    """(..., N) {0,1} uint8 → (..., N/8) uint8, ``N % 8 == 0`` — the exact
+    on-device inverse of :func:`_unpack_bits` (np.packbits big-endian bit
+    order, verified against NumPy in tests). A cheap VPU shift-and-sum; the
+    device-generation ring packs its generated columns with this before the
+    first ``ppermute`` so the wire format matches the host-packed path."""
+    *lead, n = bits.shape
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    grouped = bits.reshape(*lead, n // 8, 8).astype(jnp.uint8) << shifts
+    # Exact in uint8: 8 disjoint-bit terms sum to at most 255.
+    return jnp.sum(grouped, axis=-1, dtype=jnp.uint8)
 
 
 class GramianAccumulator:
@@ -422,47 +488,81 @@ class GramianAccumulator:
         return np.asarray(jax.device_get(self.finalize_device())).astype(np.float64)
 
 
-def _ring_tiles(G_local, X_cols, samples_axis: str, operand_dtype):
+def _ring_tiles(G_local, X_cols, samples_axis: str, operand_dtype, packed=False):
     """One block's ring update, executed per device inside shard_map.
 
     ``G_local``: (N_local, N) — this device's row tile of the Gramian.
-    ``X_cols``: (B, N_local) uint8 — this block's columns for this device's
-    samples; ppermuted around the ring in uint8 (1 byte/entry over ICI) and
-    cast to the MXU operand dtype per step. Each of the D steps computes one
-    (N_local, N_local) output tile while the next column block is in flight.
+    ``X_cols``: this block's columns for this device's samples — ``(B,
+    N_local)`` {0,1}/count uint8, or ``(B, N_local/8)`` bit-packed uint8
+    when ``packed`` (np.packbits big-endian; ``N_local % 8 == 0``, the
+    pack-width invariant ``parallel/mesh.py:padded_cohort`` guarantees).
+    Packed tiles move ⅛ the bytes per ``ppermute`` and are unpacked on
+    device per step (a cheap VPU shift-and-mask fused ahead of the dot).
+
+    Double-buffered ring: the loop issues the ``ppermute`` for step k+1
+    BEFORE the dot of step k consumes its tile, so the transfer and the
+    matmul have no mutual dependency and XLA's async collectives overlap
+    ICI with the MXU instead of alternating them; the last step's tile
+    arrives while step D-2 computes and is consumed outside the loop — D-1
+    permutes total (the old serialized loop paid D, one of them wasted on
+    returning the tile to its owner).
     """
     D = axis_size(samples_axis)
     i = lax.axis_index(samples_axis)
-    n_local = X_cols.shape[1]
-    x_mine_t = X_cols.astype(operand_dtype).T  # (N_local, B)
+    n_local = X_cols.shape[1] * 8 if packed else X_cols.shape[1]
 
-    def body(k, carry):
-        G, cur = carry
-        j = (i + k) % D  # owner of `cur`'s sample columns
-        tile = jnp.matmul(
-            x_mine_t, cur.astype(operand_dtype), preferred_element_type=G.dtype
+    def unpack(tile):
+        return _unpack_bits(tile, n_local) if packed else tile
+
+    x_mine_t = unpack(X_cols).astype(operand_dtype).T  # (N_local, B)
+    if packed:
+        # Materialize the unpacked own-operand once: it feeds all D dots,
+        # and without the barrier XLA re-fuses the unpack+cast into each
+        # dot's operand producers (same rationale as _dense_update).
+        x_mine_t = lax.optimization_barrier(x_mine_t)
+
+    def dot_into(G, tile, k):
+        j = (i + k) % D  # owner of `tile`'s sample columns
+        t = jnp.matmul(
+            x_mine_t, unpack(tile).astype(operand_dtype),
+            preferred_element_type=G.dtype,
         )  # (N_local, N_local)
         # Explicit int32 indices: under enable_x64 the literal 0 would
         # otherwise promote to int64 and mismatch the axis-index dtype.
         col = (j * n_local).astype(jnp.int32)
         zero = jnp.int32(0)
-        G = lax.dynamic_update_slice(
+        return lax.dynamic_update_slice(
             G,
-            lax.dynamic_slice(G, (zero, col), (n_local, n_local)) + tile,
+            lax.dynamic_slice(G, (zero, col), (n_local, n_local)) + t,
             (zero, col),
         )
-        cur = lax.ppermute(
-            cur, samples_axis, [((p + 1) % D, p) for p in range(D)]
-        )
-        return G, cur
 
-    G_local, _ = lax.fori_loop(0, D, body, (G_local, X_cols))
-    return G_local
+    if D == 1:
+        return dot_into(G_local, X_cols, 0)
+    perm = [((p + 1) % D, p) for p in range(D)]
+
+    def body(k, carry):
+        G, cur = carry
+        # Issue step k+1's transfer first; the dot below shares no data
+        # dependency with it, so the ICI permute runs behind the matmul.
+        nxt = lax.ppermute(cur, samples_axis, perm)
+        return dot_into(G, cur, k), nxt
+
+    G_local, last = lax.fori_loop(0, D - 1, body, (G_local, X_cols))
+    return dot_into(G_local, last, D - 1)
 
 
 class ShardedGramianAccumulator:
     """Sharded strategy: Gramian row-tiles over the ``samples`` axis, ring
-    exchange per block, optional data-parallel axis on top."""
+    exchange per block, optional data-parallel axis on top.
+
+    ``pack_bits`` selects the ring wire format (``--ring-pack-bits``):
+    packed tiles move 8× fewer bytes per ``ppermute`` AND the host staging
+    ships bit-packed (8× less host→device traffic); ``off`` keeps the
+    unpacked uint8 wire as the bit-exact oracle. Count-valued blocks
+    (same-set joins, entries > 1) cannot pack and transparently ride the
+    unpacked kernel per flush — exactness never depends on the wire format.
+    """
 
     def __init__(
         self,
@@ -473,6 +573,7 @@ class ShardedGramianAccumulator:
         sync_every: int = 1,
         registry=None,
         spans=None,
+        pack_bits: str = "auto",
     ):
         self.telemetry = _AccumulatorTelemetry(registry, spans, "sharded")
         self.sync_every = max(1, int(sync_every))
@@ -480,23 +581,23 @@ class ShardedGramianAccumulator:
         if SAMPLES_AXIS not in mesh.shape:
             raise ValueError(f"mesh must have a {SAMPLES_AXIS!r} axis")
         self.mesh = mesh
+        self.pack = resolve_ring_pack(pack_bits)
         self.samples_parallel = mesh.shape[SAMPLES_AXIS]
         self.data_parallel = mesh.shape.get(DATA_AXIS, 1)
-        if num_samples % self.samples_parallel != 0:
-            # Pad the cohort to a multiple of the samples axis; padded
-            # columns are all-zero and are trimmed in finalize().
-            self._padded = (
-                (num_samples + self.samples_parallel - 1)
-                // self.samples_parallel
-                * self.samples_parallel
-            )
-        else:
-            self._padded = num_samples
+        # Cohort padding: a multiple of the samples axis (equal column tiles
+        # per device) and, under the packed wire format, of 8× that (every
+        # device's tile a whole number of bytes — the pack-width invariant).
+        # Padded columns are all-zero and are trimmed in finalize().
+        self._padded = padded_cohort(
+            num_samples, self.samples_parallel, pack=self.pack
+        )
         self.num_samples = int(num_samples)
+        self.n_local = self._padded // self.samples_parallel
         self.block_size = int(block_size)
         self.exact_int = bool(exact_int)
         self.operand_dtype, self.accum_dtype = _operand_dtypes(exact_int, mesh)
         self._entry_bound = 0
+        self.ring_bytes_total = 0
 
         rows = self.data_parallel * self.block_size
         self._staging = np.zeros((rows, self._padded), dtype=np.uint8)
@@ -505,6 +606,10 @@ class ShardedGramianAccumulator:
 
         data_axis = DATA_AXIS if DATA_AXIS in mesh.shape else None
         g_spec = P(data_axis, SAMPLES_AXIS, None)
+        # One spec serves both wire formats: the packed block shards its
+        # (padded/8)-wide byte dim over ``samples`` and the byte boundary
+        # coincides with every shard boundary (pack-width invariant), so
+        # each device's shard is exactly its own columns, packed.
         x_spec = P(data_axis, None, SAMPLES_AXIS)
         self._g_sharding = NamedSharding(mesh, g_spec)
         self._x_sharding = NamedSharding(mesh, x_spec)
@@ -517,8 +622,13 @@ class ShardedGramianAccumulator:
 
         self._g_spec, self._x_spec = g_spec, x_spec
         self._update = self._build_update(self.operand_dtype)
+        self._update_packed = (
+            self._build_update(self.operand_dtype, packed=True)
+            if self.pack
+            else None
+        )
 
-    def _build_update(self, operand_dtype):
+    def _build_update(self, operand_dtype, packed: bool = False):
         mesh, g_spec, x_spec = self.mesh, self._g_spec, self._x_spec
 
         @jax.jit
@@ -526,7 +636,8 @@ class ShardedGramianAccumulator:
             def per_slice(G_local, X_local):
                 # Leading data-axis dim is size 1 locally; drop it.
                 return _ring_tiles(
-                    G_local[0], X_local[0], SAMPLES_AXIS, operand_dtype
+                    G_local[0], X_local[0], SAMPLES_AXIS, operand_dtype,
+                    packed=packed,
                 )[None]
 
             return shard_map(
@@ -572,16 +683,37 @@ class ShardedGramianAccumulator:
         ):
             # The scanned update closes over the operand dtype — rebuild it.
             self._update = self._build_update(self.operand_dtype)
+            if self.pack:
+                self._update_packed = self._build_update(
+                    self.operand_dtype, packed=True
+                )
         self._entry_bound = next_bound
         X = block.reshape(self.data_parallel, self.block_size, self._padded)
-        self.G = self._update(self.G, device_put_global(X, self._x_sharding))
+        # Count-valued rows (same-set joins) cannot bit-pack; they ride the
+        # unpacked kernel for this flush — same geometry, same result.
+        use_packed = self.pack and max_count <= 1
+        if use_packed:
+            # Host staging ships packed: ⅛ the host→device bytes, and the
+            # ring circulates the packed tiles as-is (np.packbits allocates
+            # fresh, so the reused staging buffer is never in flight).
+            Xd = device_put_global(np.packbits(X, axis=-1), self._x_sharding)
+            self.G = self._update_packed(self.G, Xd)
+        else:
+            self.G = self._update(self.G, device_put_global(X, self._x_sharding))
         self._fill = 0
         self._flushes += 1
         if self._flushes % self.sync_every == 0:
             jax.block_until_ready(self.G)
-        self.telemetry.record_flush(
-            flush_rows, time.perf_counter() - flush_start, 0
+        flush_seconds = time.perf_counter() - flush_start
+        flush_ring_bytes = ring_traffic_bytes(
+            self.data_parallel * self.block_size,
+            self.samples_parallel,
+            self.n_local,
+            use_packed,
         )
+        self.ring_bytes_total += flush_ring_bytes
+        self.telemetry.record_ring(flush_ring_bytes, flush_seconds)
+        self.telemetry.record_flush(flush_rows, flush_seconds, 0)
 
     def finalize(self) -> np.ndarray:
         self._flush()
@@ -658,4 +790,5 @@ __all__ = [
     "ShardedGramianAccumulator",
     "data_axis_sum",
     "gramian_reference",
+    "resolve_ring_pack",
 ]

@@ -55,6 +55,48 @@ SAMPLES_AXIS = "samples"
 
 PLATFORM_ENV = "SPARK_EXAMPLES_TPU_PLATFORM"
 
+#: Genotypes per byte on the packed ring wire (np.packbits bit order). The
+#: pack-width invariant follows from it: every device's local column width
+#: must be a whole number of bytes, i.e. a multiple of this.
+RING_PACK_MULTIPLE = 8
+
+
+def padded_cohort(num_columns: int, samples_parallel: int, pack: bool = True) -> int:
+    """Column count after cohort padding for the sharded ring Gramian.
+
+    The cohort pads up to a multiple of the ``samples`` axis so every device
+    owns an equal column tile; with the bit-packed ring wire format the tile
+    additionally pads to a multiple of ``RING_PACK_MULTIPLE`` columns per
+    device (a packed tile is a whole number of bytes, and a byte boundary
+    must coincide with every shard boundary so each device's shard of the
+    host-packed block is exactly its own columns). Pad columns are all-zero
+    and contribute nothing to XᵀX; finalize trims them. ONE rule, shared by
+    ``ops/gramian.py``, ``ops/devicegen.py`` and the device-free plan
+    validator (``check/plan.py``) — the geometry the validator accepts is the
+    geometry the accumulators build.
+    """
+    multiple = int(samples_parallel) * (RING_PACK_MULTIPLE if pack else 1)
+    return -(-int(num_columns) // multiple) * multiple
+
+
+def ring_traffic_bytes(
+    rows: int, samples_parallel: int, n_local: int, packed: bool
+) -> int:
+    """Total ICI bytes one ring pass moves for ``rows`` variant rows.
+
+    Each of the ``samples_parallel`` devices sends its ``(rows, width)``
+    column tile ``samples_parallel - 1`` times around the ring; ``width`` is
+    ``n_local`` bytes unpacked or ``n_local / 8`` packed (``n_local % 8 == 0``
+    under the pack-width invariant — :func:`padded_cohort`). ``rows`` summed
+    over data-parallel slices gives the whole-mesh total (each slice runs its
+    own ring). The one audited formula behind the ``gramian_ring_bytes``
+    telemetry (``obs/metrics.py``) and the plan validator's traffic facts.
+    """
+    width = (
+        int(n_local) // RING_PACK_MULTIPLE if packed else int(n_local)
+    )
+    return int(rows) * int(samples_parallel) * (int(samples_parallel) - 1) * width
+
 
 def apply_platform_override() -> Optional[str]:
     """Honor ``SPARK_EXAMPLES_TPU_PLATFORM`` (e.g. ``cpu``) before any
@@ -282,6 +324,9 @@ __all__ = [
     "DATA_AXIS",
     "SAMPLES_AXIS",
     "PLATFORM_ENV",
+    "RING_PACK_MULTIPLE",
+    "padded_cohort",
+    "ring_traffic_bytes",
     "apply_platform_override",
     "distributed_init",
     "host_value",

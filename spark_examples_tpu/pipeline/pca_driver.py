@@ -391,6 +391,7 @@ class VariantsPcaDriver:
             acc: object = ShardedGramianAccumulator(
                 n, mesh, block_size=self.conf.block_size, exact_int=exact,
                 registry=self.registry, spans=self.spans,
+                pack_bits=getattr(self.conf, "ring_pack_bits", "auto"),
             )
         else:
             acc = GramianAccumulator(
@@ -446,6 +447,7 @@ class VariantsPcaDriver:
             acc: object = ShardedGramianAccumulator(
                 n, mesh, block_size=self.conf.block_size, exact_int=exact,
                 registry=self.registry, spans=self.spans,
+                pack_bits=getattr(self.conf, "ring_pack_bits", "auto"),
             )
         else:
             acc = GramianAccumulator(
@@ -522,6 +524,7 @@ class VariantsPcaDriver:
                 pops_per_set=[
                     source.populations_for(v) for v in conf.variant_set_id
                 ],
+                pack_bits=getattr(conf, "ring_pack_bits", "auto"),
             )
         elif use_ring:
             # Sharded strategy, fully on device: each samples-slice
@@ -540,6 +543,7 @@ class VariantsPcaDriver:
                 blocks_per_dispatch=blocks_per_dispatch,
                 exact_int=True,
                 n_pops=source.n_pops,
+                pack_bits=getattr(conf, "ring_pack_bits", "auto"),
             )
         else:
             # Asymmetric joint cohorts (per-set sizes) ride the same kernel
@@ -582,17 +586,34 @@ class VariantsPcaDriver:
             (contig, contig.get_shards(conf.bases_per_partition))
             for contig in contigs
         ]
-        sites_gauge = well_known_gauge(self.registry, INGEST_SITES_SCANNED)
         well_known_gauge(self.registry, INGEST_PARTITIONS_PLANNED).set(
             sum(len(shards) for _, shards in shards_by_contig)
             * len(conf.variant_set_id)
         )
+        sites_gauge = well_known_gauge(self.registry, INGEST_SITES_SCANNED)
+        ring_counter = None
+        if use_ring:
+            from spark_examples_tpu.obs.metrics import (
+                GRAMIAN_RING_BYTES,
+                well_known_counter,
+            )
+
+            # Deterministic host-side accounting of the ICI ring traffic
+            # (the device-generation ring has no host flush to instrument);
+            # same counter the host-fed sharded accumulator feeds. Advanced
+            # per contig so the heartbeat's "ring traffic" segment is live
+            # during ingest, not a post-finalize surprise.
+            ring_counter = well_known_counter(self.registry, GRAMIAN_RING_BYTES)
+        ring_bytes_published = 0
         for contig, shards in shards_by_contig:
             k0, k1 = source.site_grid_range(contig)
             if k1 > k0:
                 acc.add_grid(k0, k1)
             self._device_gen_scanned += k1 - k0
             sites_gauge.set(self._device_gen_scanned)
+            if ring_counter is not None:
+                ring_counter.inc(acc.ring_bytes_total - ring_bytes_published)
+                ring_bytes_published = acc.ring_bytes_total
             if self.io_stats is not None:
                 # Wire-equivalent accounting: per shard, per variant set
                 # (``SyntheticGenomicsSource.page_requests``).
@@ -610,10 +631,20 @@ class VariantsPcaDriver:
             result = acc.finalize_sharded()
         else:
             result = acc.finalize_device()
-        from spark_examples_tpu.obs.metrics import DEVICEGEN_DISPATCHES
+        from spark_examples_tpu.obs.metrics import (
+            DEVICEGEN_DISPATCHES,
+            DEVICEGEN_SITES_CAPACITY,
+        )
 
         well_known_gauge(self.registry, DEVICEGEN_DISPATCHES).set(
             acc.dispatches
+        )
+        # Dispatched grid capacity vs the valid sites inside it — the
+        # padding-waste denominator bench.py reports per config (the fixed
+        # tail-group overhead that dominates small regions). Ring traffic
+        # was already published incrementally inside the ingest loop.
+        well_known_gauge(self.registry, DEVICEGEN_SITES_CAPACITY).set(
+            acc.sites_capacity
         )
         # Epilogue: record the device-counted variant rows (per variant set,
         # rows with variation in that set's columns — the same count the
