@@ -6,6 +6,12 @@
 #   2. lint  — graftcheck lint (JAX-pitfall linter; the tree must be
 #      clean or carry justified disables) + the mypy baseline gate
 #      (skips with a notice when mypy is not installed).
+#   2b. ir — graftcheck ir (jaxpr-level audit of the real Gramian kernels:
+#      ring overlap schedule, donation contract, packed-wire dtype flow,
+#      jaxpr ring bytes == ring_traffic_bytes) + graftcheck lockgraph
+#      (static lock-acquisition-order graph of the ingest/obs layer must
+#      be acyclic and free of sync/queue-under-lock); the DOT graph
+#      artifact is left under the stage's run dir (path echoed).
 #   3. obs smoke — a tiny synthetic PCA run with --metrics-json and a
 #      1 s heartbeat; the produced run manifest must validate against the
 #      schema (obs/manifest.py:validate_manifest) and carry I/O stats.
@@ -39,6 +45,18 @@ echo "== lint stage (graftcheck) =="
 lint_rc=0
 env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck lint spark_examples_tpu || lint_rc=$?
 env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck typecheck || lint_rc=$?
+
+echo "== ir stage (graftcheck ir + lockgraph) =="
+ir_rc=0
+IR_TMP=$(mktemp -d /tmp/graftcheck-ir.XXXXXX)
+env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck ir || ir_rc=$?
+env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck lockgraph \
+  --dot "$IR_TMP/lockgraph.dot" || ir_rc=$?
+if [ -s "$IR_TMP/lockgraph.dot" ]; then
+  echo "lock-order DOT artifact: $IR_TMP/lockgraph.dot"
+else
+  echo "lockgraph DOT artifact missing"; ir_rc=1
+fi
 
 echo "== observability smoke (run manifest schema) =="
 obs_rc=0
@@ -124,6 +142,7 @@ fi
 
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 if [ "$lint_rc" -ne 0 ]; then exit "$lint_rc"; fi
+if [ "$ir_rc" -ne 0 ]; then exit "$ir_rc"; fi
 if [ "$obs_rc" -ne 0 ]; then exit "$obs_rc"; fi
 if [ "$ring_rc" -ne 0 ]; then exit "$ring_rc"; fi
 exit "$san_rc"

@@ -1,12 +1,24 @@
 """``graftcheck`` — the static-analysis subsystem.
 
-Three parts, one CLI (``python -m spark_examples_tpu graftcheck ...``):
+Six parts, one CLI (``python -m spark_examples_tpu graftcheck ...``),
+layered by how deep they look:
 
 - ``lint``   — AST-walking JAX-pitfall linter tuned to this repo
   (``linter.py``; rule catalogue in ``rules.py``). The concurrent ingest
   engine and the device pipeline fail *silently* (host-sync stalls,
   recompilation storms, data races), so the failure classes tier-1 cannot
   observe are pinned as lint rules instead.
+- ``ir``     — jaxpr-level kernel auditor (``ir.py``): traces the REAL
+  Gramian kernels (dense, ring, device-generation) over ``AbstractMesh``es
+  and proves the contracts source text cannot show — the ring's
+  communication/compute overlap (D-1 independent ppermutes), the
+  accumulator donation contract cross-checked against the AST disables,
+  packed-uint8-until-unpack dtype flow, no f64, and jaxpr-derived ring
+  traffic equal to ``parallel/mesh.py:ring_traffic_bytes`` exactly.
+- ``lockgraph`` — static lock-acquisition-order analysis of the threaded
+  ingest/telemetry layer (``lockgraph.py``): rejects order cycles and
+  locks held across device syncs / blocking queue ops; emits the graph
+  as a DOT artifact.
 - ``plan``   — device-free pipeline dry-run (``plan.py``): the full flag
   surface is validated with ``jax.eval_shape`` over ``ShapeDtypeStruct``
   operands and an ``AbstractMesh``, so a 2-hour whole-genome run cannot die
@@ -14,8 +26,9 @@ Three parts, one CLI (``python -m spark_examples_tpu graftcheck ...``):
 - ``sanitize`` — ASAN/UBSAN/TSAN replay of the VCF fuzz corpus against the
   native parser (``sanitize.py``), turning the PR-1 concurrency claims into
   continuously-checked invariants.
-- ``typecheck`` — baseline-gated mypy over ``config.py`` + ``check/``
-  (``typecheck.py``): new type errors fail, committed debt does not.
+- ``typecheck`` — baseline-gated mypy, two tiers (``config.py``
+  permissive; ``check/`` + ``obs/`` ``--strict``): new type errors fail,
+  committed debt does not.
 """
 
 from spark_examples_tpu.check.rules import Finding, Rule, RULES

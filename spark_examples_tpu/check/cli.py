@@ -5,6 +5,10 @@ Dispatched from the package CLI (``python -m spark_examples_tpu graftcheck
 on them:
 
     graftcheck lint [PATH...] [--json]        0 clean / 1 findings
+    graftcheck ir [--json] [--mesh D,S ...] [--num-samples N]
+                  [--block-size B]           0 clean / 1 findings
+    graftcheck lockgraph [PATH...] [--json] [--dot FILE]
+                                              0 acyclic+clean / 1 findings
     graftcheck plan <pca flags> [--plan-devices N] [--json]
                                               0 plan OK / 2 rejected
     graftcheck sanitize [--modes m1,m2] [--strict]
@@ -56,6 +60,106 @@ def _cmd_lint(argv: Sequence[str]) -> int:
         verdict = "clean" if not findings else f"{len(findings)} finding(s)"
         print(f"graftcheck lint: {checked} file(s), {verdict}")
     return 1 if findings else 0
+
+
+def _cmd_ir(argv: Sequence[str]) -> int:
+    from spark_examples_tpu.check.ir import default_specs, run_audit
+
+    parser = argparse.ArgumentParser(prog="graftcheck ir")
+    parser.add_argument(
+        "--json", action="store_true", help="Emit the machine-readable report."
+    )
+    parser.add_argument(
+        "--mesh",
+        action="append",
+        default=None,
+        metavar="D,S",
+        help=(
+            "Abstract mesh shape(s) to audit (repeatable, e.g. --mesh 1,4 "
+            "--mesh 2,2). Default: the shipped matrix (1,2), (1,4), (2,2)."
+        ),
+    )
+    parser.add_argument(
+        "--num-samples",
+        type=int,
+        default=64,
+        help="Aligned cohort width for the audit geometry (default 64).",
+    )
+    parser.add_argument(
+        "--block-size",
+        type=int,
+        default=8,
+        help="Variant block size for the audit geometry (default 8).",
+    )
+    ns = parser.parse_args(list(argv))
+    meshes = None
+    if ns.mesh:
+        try:
+            meshes = tuple(
+                tuple(int(p) for p in spec.split(",")) for spec in ns.mesh
+            )
+            if any(len(m) != 2 or m[0] < 1 or m[1] < 1 for m in meshes):
+                raise ValueError(meshes)
+        except ValueError:
+            print(
+                f"graftcheck ir: --mesh expects positive 'data,samples' "
+                f"pairs, got {ns.mesh}",
+                file=sys.stderr,
+            )
+            return 2
+    specs = default_specs(
+        num_samples=ns.num_samples,
+        ragged_samples=ns.num_samples + 36,
+        block_size=ns.block_size,
+        **({"meshes": meshes} if meshes is not None else {}),
+    )
+    report = run_audit(specs)
+    print(report.to_json() if ns.json else report.format())
+    return 0 if report.ok else 1
+
+
+def _cmd_lockgraph(argv: Sequence[str]) -> int:
+    from spark_examples_tpu.check.lockgraph import (
+        build_lock_graph,
+        default_lock_paths,
+    )
+
+    parser = argparse.ArgumentParser(prog="graftcheck lockgraph")
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="Files or package trees to analyze (default: this package).",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="Emit the machine-readable report."
+    )
+    parser.add_argument(
+        "--dot",
+        default=None,
+        metavar="FILE",
+        help="Write the acquisition-order graph as a DOT artifact.",
+    )
+    ns = parser.parse_args(list(argv))
+    paths = ns.paths or default_lock_paths()
+    for path in paths:
+        if not os.path.exists(path):
+            print(
+                f"graftcheck lockgraph: no such path {path!r}", file=sys.stderr
+            )
+            return 2
+    graph = build_lock_graph(paths)
+    if ns.dot:
+        try:
+            with open(ns.dot, "w", encoding="utf-8") as f:
+                f.write(graph.to_dot())
+        except OSError as e:
+            print(
+                f"graftcheck lockgraph: cannot write --dot {ns.dot!r}: {e}",
+                file=sys.stderr,
+            )
+            return 2
+    print(graph.to_json() if ns.json else graph.format())
+    return 0 if graph.ok else 1
 
 
 def _cmd_plan(argv: Sequence[str]) -> int:
@@ -113,6 +217,8 @@ def _cmd_typecheck(argv: Sequence[str]) -> int:
 
 _SUBCOMMANDS = {
     "lint": _cmd_lint,
+    "ir": _cmd_ir,
+    "lockgraph": _cmd_lockgraph,
     "plan": _cmd_plan,
     "sanitize": _cmd_sanitize,
     "typecheck": _cmd_typecheck,

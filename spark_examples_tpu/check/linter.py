@@ -48,6 +48,25 @@ _LOCK_COMMENT_WINDOW = 3
 #: Spellings for GC009's finding text (the common augmented operators).
 _AUG_OPS = {"Add": "+", "Sub": "-", "Mult": "*", "BitOr": "|"}
 
+#: Canonical dotted names that resolve to shard_map (GC010's second
+#: decoration context — a shard_map body executes per device under trace,
+#: where a host numpy call is just as wrong as under jit).
+_SHARD_MAP_NAMES = (
+    "shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "spark_examples_tpu.utils.compat.shard_map",
+)
+
+#: numpy calls that are trace-time constants, not host compute: dtype
+#: constructors used as astype/array arguments. These run on Python
+#: scalars/metadata, never on traced values, and are pervasive legitimate
+#: idiom in kernel signatures (``operand_dtype=np.int8``).
+_NP_DTYPE_CTORS = frozenset(
+    {"numpy.dtype", "numpy.int8", "numpy.int32", "numpy.int64",
+     "numpy.uint8", "numpy.uint32", "numpy.uint64", "numpy.float32",
+     "numpy.bool_"}
+)
+
 
 def _dotted(node: ast.AST, alias: Dict[str, str]) -> Optional[str]:
     """Canonical dotted name of a Name/Attribute chain, with the leading
@@ -134,6 +153,21 @@ def _jit_decoration(
     return None
 
 
+def _shard_map_decoration(dec: ast.expr, alias: Dict[str, str]) -> bool:
+    """Whether ``dec`` applies shard_map (bare, factory, or partial form) —
+    the traced-body context GC010 shares with jit."""
+    if _dotted(dec, alias) in _SHARD_MAP_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        fn_name = _dotted(dec.func, alias)
+        if fn_name in _SHARD_MAP_NAMES:
+            return True
+        if fn_name in ("functools.partial", "partial") and dec.args:
+            if _dotted(dec.args[0], alias) in _SHARD_MAP_NAMES:
+                return True
+    return False
+
+
 def _static_param_names(
     args: ast.arguments, jit_kwargs: Dict[str, ast.expr]
 ) -> Set[str]:
@@ -170,6 +204,7 @@ class _LintVisitor(ast.NodeVisitor):
         self._loop_depth = 0
         self._func_depth = 0
         self._jit_stack: List[_JitContext] = []
+        self._shard_map_depth = 0
         #: Per-function-scope set of names assigned from jnp expressions.
         self._jnp_names: List[Set[str]] = []
 
@@ -202,6 +237,12 @@ class _LintVisitor(ast.NodeVisitor):
             jit_kwargs = _jit_decoration(dec, self.alias)
             if jit_kwargs is not None:
                 break
+        sm_decorated = any(
+            _shard_map_decoration(dec, self.alias)
+            for dec in getattr(node, "decorator_list", [])
+        )
+        if sm_decorated:
+            self._shard_map_depth += 1
         ctx = None
         if jit_kwargs is not None:
             static = _static_param_names(node.args, jit_kwargs)
@@ -221,6 +262,8 @@ class _LintVisitor(ast.NodeVisitor):
         self._func_depth -= 1
         if ctx is not None:
             self._jit_stack.pop()
+        if sm_decorated:
+            self._shard_map_depth -= 1
 
     visit_FunctionDef = _visit_function
     visit_AsyncFunctionDef = _visit_function
@@ -428,6 +471,27 @@ class _LintVisitor(ast.NodeVisitor):
                 node,
                 f"print() inside jitted {self._jit_stack[-1].fn_name!r} "
                 "runs at trace time with tracers; use jax.debug.print",
+            )
+
+        # GC010: host numpy call inside a traced kernel body.
+        if (
+            (self._jit_stack or self._shard_map_depth)
+            and name
+            and name.startswith("numpy.")
+            and name not in _NP_DTYPE_CTORS
+        ):
+            where = (
+                f"jitted {self._jit_stack[-1].fn_name!r}"
+                if self._jit_stack
+                else "a shard_map-decorated kernel"
+            )
+            self.emit(
+                "GC010",
+                node,
+                f"{name.replace('numpy', 'np')}(...) inside {where} runs "
+                "on the HOST at trace time: it crashes on tracers or "
+                "silently bakes a trace-time constant into the compiled "
+                "program; use the jnp equivalent",
             )
 
         # GC001: implicit device→host sync in hot paths.

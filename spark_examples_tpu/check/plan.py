@@ -248,15 +248,12 @@ def _eval_sharded_update(
     pack-width-padded cohort (auto-rounded exactly as the accumulators
     round it), per-device ring buffer bytes, per-flush ICI ring traffic,
     and the sharded HBM feasibility check."""
-    import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax.sharding import PartitionSpec as P
 
     from spark_examples_tpu.ops.gramian import (
         _DEFAULT_DEVICE_BYTES,
         DENSE_HBM_FRACTION,
-        _ring_tiles,
         resolve_ring_pack,
     )
     from spark_examples_tpu.parallel.mesh import (
@@ -266,7 +263,6 @@ def _eval_sharded_update(
         padded_cohort,
         ring_traffic_bytes,
     )
-    from spark_examples_tpu.utils.compat import shard_map
 
     N = int(conf.num_samples)
     B = int(conf.block_size)
@@ -328,7 +324,8 @@ def _eval_sharded_update(
         )
 
     try:
-        from jax.sharding import AbstractMesh
+        # Capability probe only — the IR audit below constructs the mesh.
+        from jax.sharding import AbstractMesh  # noqa: F401
     except ImportError:
         report.warn(
             "no-abstract-mesh",
@@ -337,45 +334,67 @@ def _eval_sharded_update(
         )
         return
 
-    operand = np.int8 if conf.exact_similarity else np.float32
     accum = jnp.int32 if conf.exact_similarity else jnp.float32
-    mesh = AbstractMesh(((DATA_AXIS, data), (SAMPLES_AXIS, samples)))
-    g_spec = P(DATA_AXIS, SAMPLES_AXIS, None)
-    x_spec = P(DATA_AXIS, None, SAMPLES_AXIS)
-
-    def update(G, X):
-        def per_slice(G_local, X_local):
-            return _ring_tiles(
-                G_local[0], X_local[0], SAMPLES_AXIS, operand, packed=pack
-            )[None]
-
-        return shard_map(
-            per_slice, mesh=mesh, in_specs=(g_spec, x_spec), out_specs=g_spec
-        )(G, X)
-
-    G = jax.ShapeDtypeStruct((data, padded, padded), accum)
     x_width = padded // RING_PACK_MULTIPLE if pack else padded
-    X = jax.ShapeDtypeStruct((data, B, x_width), jnp.uint8)
-    try:
-        out = jax.eval_shape(update, G, X)
-    except Exception as e:
+
+    # ONE trace serves both layers: the IR auditor (check/ir.py) runs the
+    # runtime's own build_sharded_update through make_jaxpr over an
+    # AbstractMesh, proving the overlap/donation/dtype/traffic contracts
+    # AND yielding the output signature the shape check needs — no second
+    # eval_shape. The jaxpr-derived ring traffic and static
+    # peak-live-bytes land in the plan report so a whole-genome run can be
+    # sized before a single device is touched; any IR finding is a plan
+    # rejection — the configured kernel would ship without its contracts.
+    from spark_examples_tpu.check.ir import audit_kernel, ring_kernel_spec
+
+    audit = audit_kernel(
+        ring_kernel_spec(
+            data, samples, N, B, pack, exact_int=conf.exact_similarity
+        )
+    )
+    trace_failures = [f for f in audit.findings if f.rule_id == "GI000"]
+    if trace_failures:
         report.error(
             "sharded-update-trace",
-            f"sharded ring update fails to trace on a "
-            f"{data}x{samples} abstract mesh: {e}",
+            f"sharded ring update fails to trace on a {data}x{samples} "
+            f"abstract mesh: {trace_failures[0].detail}",
         )
         return
-    if out.shape != G.shape or out.dtype != G.dtype:
+    g_shape = (data, padded, padded)
+    out_shape = tuple(audit.facts["out_shapes"][0])
+    out_dtype = audit.facts["out_dtypes"][0]
+    if out_shape != g_shape or out_dtype != str(np.dtype(accum)):
         report.error(
             "sharded-update-shape",
-            f"sharded update maps {G.shape} to {out.shape}",
+            f"sharded update maps {g_shape} to {out_shape} {out_dtype}",
         )
     else:
         wire = "bit-packed" if pack else "unpacked"
         report.shape_checks.append(
             f"sharded ring update over abstract {data}x{samples} mesh: "
             f"({data}, {B}, {x_width}) {wire} uint8 blocks -> "
-            f"G {out.shape} {out.dtype}"
+            f"G {out_shape} {out_dtype}"
+        )
+    for finding in audit.findings:
+        report.error(f"ir-{finding.rule_id}", finding.detail)
+    if "ring_bytes_jaxpr" in audit.facts:
+        report.geometry["ring_bytes_per_flush_jaxpr"] = audit.facts[
+            "ring_bytes_jaxpr"
+        ]
+    if "peak_live_bytes" in audit.facts:
+        report.geometry["ring_peak_live_bytes_per_device"] = audit.facts[
+            "peak_live_bytes"
+        ]
+    if "permute_executions" in audit.facts:
+        report.geometry["ring_permute_steps"] = audit.facts[
+            "permute_executions"
+        ]
+    if audit.ok:
+        report.shape_checks.append(
+            f"ring IR audit over abstract {data}x{samples} mesh: "
+            f"{audit.facts.get('permute_executions', 0)} independent "
+            "ppermute(s), donation contract justified, jaxpr ring bytes "
+            "== ring_traffic_bytes"
         )
 
 
@@ -432,6 +451,43 @@ def validate_plan(
         resolve_ring_pack(getattr(conf, "ring_pack_bits", "auto"))
     except ValueError as e:
         report.error("ring-pack-bits", str(e))
+
+    # Observability flags: nonsense here only surfaces at the END of an
+    # hours-long run (the heartbeat thread refusing to start, or the
+    # manifest write failing after the epilogue) — exactly the class of
+    # error the plan validator exists to catch up front. The parse path
+    # rejects a negative heartbeat too; this validates programmatic
+    # PcaConf construction, which bypasses _from_namespace.
+    if conf.heartbeat_seconds < 0:
+        report.error(
+            "heartbeat-seconds",
+            f"--heartbeat-seconds must be >= 0 (0 = off), got "
+            f"{conf.heartbeat_seconds}",
+        )
+    if conf.metrics_json:
+        import os
+
+        parent = os.path.dirname(os.path.abspath(conf.metrics_json)) or "."
+        if not os.path.isdir(parent):
+            report.error(
+                "metrics-json-parent",
+                f"--metrics-json {conf.metrics_json}: parent directory "
+                f"{parent} does not exist; the run manifest write would "
+                "fail AFTER the run completed",
+            )
+        elif not os.access(parent, os.W_OK):
+            report.error(
+                "metrics-json-parent",
+                f"--metrics-json {conf.metrics_json}: parent directory "
+                f"{parent} is not writable; the run manifest write would "
+                "fail AFTER the run completed",
+            )
+        elif os.path.isdir(conf.metrics_json):
+            report.error(
+                "metrics-json-parent",
+                f"--metrics-json {conf.metrics_json} is a directory; the "
+                "manifest needs a file path",
+            )
 
     # -------------------------------------------------------- shard windows
     n_shards: Optional[int] = None

@@ -142,8 +142,131 @@ RULES: Dict[str, Rule] = {
             "an add_*() method.",
             scope=TELEMETRY_GLOBS,
         ),
+        Rule(
+            "GC010",
+            "host-numpy-under-jit",
+            "A host `np.*` call inside a jit/shard_map-decorated kernel "
+            "either crashes on tracers (TracerArrayConversionError) or "
+            "silently runs once at trace time on the host, baking its "
+            "result into the compiled program; use the jnp equivalent "
+            "(or hoist the host computation out of the kernel).",
+            scope=("ops/*",),
+        ),
     ]
 }
+
+
+#: ``graftcheck ir`` rule catalogue (``check/ir.py``): audits of the TRACED
+#: jaxpr of the real Gramian kernels — contracts the AST layer cannot see.
+#: GI findings anchor to a kernel name, not a source line, so their
+#: ``path`` is the kernel's audit name and ``line`` is 0; justification
+#: happens through the cross-checked GC005 AST disables (GI002), not
+#: per-line escape hatches.
+IR_RULES: Dict[str, Rule] = {
+    rule.id: rule
+    for rule in [
+        Rule(
+            "GI000",
+            "kernel-trace-failure",
+            "The kernel fails to trace to a jaxpr at all under the audit "
+            "geometry; none of its IR contracts can be vouched for.",
+        ),
+        Rule(
+            "GI001",
+            "ring-overlap-broken",
+            "A ring step's ppermute and that step's dot_general share a "
+            "data dependency, so XLA must serialize the ICI transfer "
+            "against the MXU matmul — the communication/compute overlap "
+            "the double-buffered ring exists for silently vanishes.",
+        ),
+        Rule(
+            "GI002",
+            "accumulator-donation-contract",
+            "A jitted accumulator update neither donates the accumulator "
+            "buffer nor carries the justified GC005 AST disable (or "
+            "carries a disable that no longer matches the traced "
+            "donation) — the IR and AST layers have drifted.",
+        ),
+        Rule(
+            "GI003",
+            "packed-wire-upcast",
+            "A bit-packed uint8 wire tile is widened or consumed by "
+            "compute before the designated unpack "
+            "(shift-and-mask), so the ring/PCIe wire silently loses its "
+            "8-genotypes-per-byte format — 8x the traffic, or wrong math.",
+        ),
+        Rule(
+            "GI004",
+            "f64-in-kernel",
+            "A float64 value appears inside a device kernel: some input "
+            "promoted through a silent weak-type/x64 rule. f64 halves MXU "
+            "throughput and doubles HBM; every kernel dtype is an "
+            "explicit f32/int32/uint8 contract.",
+        ),
+        Rule(
+            "GI005",
+            "ring-traffic-mismatch",
+            "The ICI bytes the traced jaxpr actually moves (ppermute "
+            "operand bytes x scan trip counts x devices) disagree with "
+            "the audited formula parallel/mesh.py:ring_traffic_bytes — "
+            "the telemetry/plan numbers no longer describe the kernel.",
+        ),
+        Rule(
+            "GI006",
+            "ring-permute-count",
+            "A ring pass does not execute exactly samples_axis - 1 "
+            "ppermutes; an extra permute (the old return-to-owner step) "
+            "wastes one full tile circulation per block, a missing one "
+            "drops a device's columns.",
+        ),
+    ]
+}
+
+
+#: ``graftcheck lockgraph`` rule catalogue (``check/lockgraph.py``): static
+#: lock-acquisition-order analysis of the threaded ingest/telemetry layer.
+#: GL findings anchor to real source lines, so the standard
+#: ``# graftcheck: disable=GLnnn -- why`` escape hatch applies.
+LOCK_RULES: Dict[str, Rule] = {
+    rule.id: rule
+    for rule in [
+        Rule(
+            "GL001",
+            "lock-order-cycle",
+            "The static lock-acquisition graph contains a cycle: two "
+            "threads taking the member locks in opposite orders deadlock. "
+            "Break the cycle or document a single global order.",
+        ),
+        Rule(
+            "GL002",
+            "device-sync-under-lock",
+            "A lock is held across block_until_ready: every thread "
+            "needing the lock stalls behind a device round-trip (seconds "
+            "on remote-attached backends). Sync first, then take the "
+            "lock.",
+        ),
+        Rule(
+            "GL003",
+            "blocking-queue-op-under-lock",
+            "A lock is held across a blocking queue put/get: if the "
+            "consumer that would drain the queue needs the same lock, the "
+            "backpressure becomes a deadlock. Move the queue op outside "
+            "the critical section (or use the _nowait form).",
+        ),
+        Rule(
+            "GL004",
+            "self-reacquire",
+            "A non-reentrant threading.Lock is (possibly) acquired while "
+            "already held on the same call path — an immediate "
+            "self-deadlock. Use RLock only if the recursion is "
+            "intentional; otherwise split the critical section.",
+        ),
+    ]
+}
+
+
+#: Every rule id any graftcheck layer can emit, for Finding.rule lookup.
+ALL_RULES: Dict[str, Rule] = {**RULES, **IR_RULES, **LOCK_RULES}
 
 
 @dataclass
@@ -158,7 +281,7 @@ class Finding:
 
     @property
     def rule(self) -> Rule:
-        return RULES[self.rule_id]
+        return ALL_RULES[self.rule_id]
 
     def format(self) -> str:
         return (
@@ -234,6 +357,9 @@ __all__ = [
     "Rule",
     "Finding",
     "RULES",
+    "IR_RULES",
+    "LOCK_RULES",
+    "ALL_RULES",
     "HOT_PATH_GLOBS",
     "INGEST_GLOBS",
     "TELEMETRY_GLOBS",

@@ -1,12 +1,19 @@
 """Baseline-gated static typing (``graftcheck typecheck``).
 
-``mypy`` over the typed core (``config.py`` + the whole ``check/``
-subsystem), gated by a COMMITTED baseline (``check/mypy_baseline.txt``):
-errors present in the baseline are existing debt and pass; any error NOT
-in the baseline fails the gate. The baseline stores normalized lines
-(``path: severity: message [code]`` — no line numbers, so unrelated edits
-that shift lines don't invalidate it). Shrink the baseline as debt is paid
-by re-running with ``--update-baseline``.
+``mypy`` over the typed core, gated by a COMMITTED baseline
+(``check/mypy_baseline.txt``): errors present in the baseline are existing
+debt and pass; any error NOT in the baseline fails the gate. The baseline
+stores normalized lines (``path: severity: message [code]`` — no line
+numbers, so unrelated edits that shift lines don't invalidate it). Shrink
+the baseline as debt is paid by re-running with ``--update-baseline``.
+
+Two tiers, one gate:
+
+- ``TARGETS`` (``config.py``) run with the permissive flag set — the
+  user-facing flag contract, annotated but not yet strict;
+- ``STRICT_TARGETS`` (the whole ``check/`` subsystem and ``obs/``) run
+  under ``--strict``: the checker that gates everyone else's code and the
+  telemetry layer hold themselves to the highest tier.
 
 Images without mypy (the seed image is one) skip with a notice and exit 0
 — the lint stage must not fail on a missing optional tool — unless
@@ -19,18 +26,24 @@ import os
 import re
 import subprocess
 import sys
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple  # noqa: F401
 
 _CHECK_DIR = os.path.dirname(os.path.abspath(__file__))
 _PACKAGE_DIR = os.path.dirname(_CHECK_DIR)
 BASELINE_PATH = os.path.join(_CHECK_DIR, "mypy_baseline.txt")
 
-#: What the gate covers. Deliberately the typed core only: config parsing
-#: (the user-facing contract) and the checker itself; the numerics modules
-#: earn coverage as annotations land.
+#: The permissive tier: config parsing (the user-facing contract); the
+#: numerics modules earn coverage as annotations land.
 TARGETS = (
     os.path.join(_PACKAGE_DIR, "config.py"),
+)
+
+#: The ``--strict`` tier: the checker itself (it gates everyone else's
+#: code, so it holds itself to the highest standard) and the telemetry
+#: subsystem (its registry/manifest types ARE its wire contract).
+STRICT_TARGETS = (
     _CHECK_DIR,
+    os.path.join(_PACKAGE_DIR, "obs"),
 )
 
 _MYPY_FLAGS = (
@@ -38,6 +51,14 @@ _MYPY_FLAGS = (
     "--no-error-summary",
     "--no-color-output",
     "--hide-error-context",
+)
+
+#: ``--strict`` minus the follow-imports noise: strict targets import the
+#: (unannotated) numerics modules, whose debt belongs to THEIR tier, not
+#: this one.
+_STRICT_FLAGS = _MYPY_FLAGS + (
+    "--strict",
+    "--follow-imports=silent",
 )
 
 _LINE_RE = re.compile(r"^(?P<path>[^:]+):(?P<line>\d+):(?:\d+:)?\s*(?P<rest>.*)$")
@@ -76,10 +97,10 @@ def _load_baseline() -> List[str]:
         ]
 
 
-def _run_mypy() -> Optional[Tuple[List[str], str]]:
-    """→ (normalized diagnostics, raw output), or None when mypy is not
-    installed."""
-    cmd = [sys.executable, "-m", "mypy", *_MYPY_FLAGS, *TARGETS]
+def _mypy_invocation(
+    flags: Sequence[str], targets: Sequence[str]
+) -> Optional[Tuple[List[str], str]]:
+    cmd = [sys.executable, "-m", "mypy", *flags, *targets]
     try:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=600
@@ -99,6 +120,19 @@ def _run_mypy() -> Optional[Tuple[List[str], str]]:
         if norm is not None and ": error:" in norm:
             diagnostics.append(norm)
     return diagnostics, proc.stdout or ""
+
+
+def _run_mypy() -> Optional[Tuple[List[str], str]]:
+    """→ (normalized diagnostics from both tiers, raw output), or None when
+    mypy is not installed. The strict tier's diagnostics merge into the one
+    baseline — a single gate, two strictness levels."""
+    base = _mypy_invocation(_MYPY_FLAGS, TARGETS)
+    if base is None:
+        return None
+    strict = _mypy_invocation(_STRICT_FLAGS, STRICT_TARGETS)
+    if strict is None:
+        return base
+    return base[0] + strict[0], base[1] + strict[1]
 
 
 def run_typecheck(strict: bool = False, update_baseline: bool = False) -> int:
@@ -146,4 +180,4 @@ def run_typecheck(strict: bool = False, update_baseline: bool = False) -> int:
     return 0
 
 
-__all__ = ["BASELINE_PATH", "TARGETS", "run_typecheck"]
+__all__ = ["BASELINE_PATH", "STRICT_TARGETS", "TARGETS", "run_typecheck"]

@@ -16,6 +16,17 @@ output):
     python -m spark_examples_tpu variants-pca --source file \\
         --input-files cohort.vcf.gz --ingest-workers 8
 
+Static analysis (``check/``; README "graftcheck"): ``graftcheck lint``
+(AST JAX-pitfall linter), ``graftcheck ir`` (jaxpr-level audit of the real
+Gramian kernels: ring overlap, donation contract, packed-wire dtype flow,
+traffic/liveness facts), ``graftcheck lockgraph`` (static
+lock-acquisition-order graph of the threaded ingest layer, DOT artifact),
+``graftcheck plan`` (device-free flag/geometry/kernel-shape validation),
+``graftcheck sanitize`` / ``graftcheck typecheck``:
+
+    python -m spark_examples_tpu graftcheck ir --json
+    python -m spark_examples_tpu graftcheck lockgraph --dot lockorder.dot
+
 Observability (``obs/``; README "Observability"): ``--heartbeat-seconds N``
 emits a stderr progress line every N seconds (sites/sec, partition ETA,
 prefetch queue, dispatch depth, device memory); ``--metrics-json PATH``

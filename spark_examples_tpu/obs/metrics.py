@@ -331,6 +331,9 @@ class MetricsRegistry:
 
     def __init__(self) -> None:
         # lock order: registry lock before family lock; never the reverse.
+        # This is the one real ordering edge in the shipped tree
+        # (registry -> family, via family construction in _register) and
+        # `graftcheck lockgraph` verifies the graph stays acyclic.
         self._lock = threading.Lock()
         self._families: Dict[str, _Family] = {}
 

@@ -552,6 +552,47 @@ def _ring_tiles(G_local, X_cols, samples_axis: str, operand_dtype, packed=False)
     return dot_into(G_local, last, D - 1)
 
 
+def build_sharded_update(mesh, operand_dtype, packed: bool = False,
+                         g_spec=None, x_spec=None):
+    """The jitted ring-exchange Gramian update for ``mesh``.
+
+    ONE construction site shared by three callers so they can never drift:
+    :class:`ShardedGramianAccumulator` (the runtime), the device-free plan
+    validator (``check/plan.py``, over an ``AbstractMesh``), and the IR
+    auditor (``check/ir.py``, which walks the traced jaxpr of exactly this
+    function to prove the overlap/donation/dtype/traffic contracts). Works
+    with a concrete ``Mesh`` or an ``AbstractMesh`` — nothing here touches
+    a device.
+
+    ``g_spec``/``x_spec`` default to the accumulator's shardings (data axis
+    only when the mesh has one); pass them explicitly to match a
+    pre-computed accumulator layout.
+    """
+    data_axis = DATA_AXIS if DATA_AXIS in mesh.shape else None
+    if g_spec is None:
+        g_spec = P(data_axis, SAMPLES_AXIS, None)
+    if x_spec is None:
+        x_spec = P(data_axis, None, SAMPLES_AXIS)
+
+    @jax.jit
+    def update(G, X):  # graftcheck: disable=GC005 -- same non-donation policy as _dense_update (measured ~10x throughput loss from donated-buffer serialization); the pipeline holds prior G references, which donation would invalidate. graftcheck ir cross-checks this disable against the traced donated_invars (GI002).
+        def per_slice(G_local, X_local):
+            # Leading data-axis dim is size 1 locally; drop it.
+            return _ring_tiles(
+                G_local[0], X_local[0], SAMPLES_AXIS, operand_dtype,
+                packed=packed,
+            )[None]
+
+        return shard_map(
+            per_slice,
+            mesh=mesh,
+            in_specs=(g_spec, x_spec),
+            out_specs=g_spec,
+        )(G, X)
+
+    return update
+
+
 class ShardedGramianAccumulator:
     """Sharded strategy: Gramian row-tiles over the ``samples`` axis, ring
     exchange per block, optional data-parallel axis on top.
@@ -629,25 +670,9 @@ class ShardedGramianAccumulator:
         )
 
     def _build_update(self, operand_dtype, packed: bool = False):
-        mesh, g_spec, x_spec = self.mesh, self._g_spec, self._x_spec
-
-        @jax.jit
-        def update(G, X):  # graftcheck: disable=GC005 -- same non-donation policy as _dense_update (measured ~10x throughput loss from donated-buffer serialization); the pipeline holds prior G references, which donation would invalidate
-            def per_slice(G_local, X_local):
-                # Leading data-axis dim is size 1 locally; drop it.
-                return _ring_tiles(
-                    G_local[0], X_local[0], SAMPLES_AXIS, operand_dtype,
-                    packed=packed,
-                )[None]
-
-            return shard_map(
-                per_slice,
-                mesh=mesh,
-                in_specs=(g_spec, x_spec),
-                out_specs=g_spec,
-            )(G, X)
-
-        return update
+        return build_sharded_update(
+            self.mesh, operand_dtype, packed, self._g_spec, self._x_spec
+        )
 
     def add_rows(self, rows: np.ndarray) -> None:
         rows = np.asarray(rows, dtype=np.uint8)
@@ -788,6 +813,7 @@ def gramian_reference(rows: np.ndarray) -> np.ndarray:
 __all__ = [
     "GramianAccumulator",
     "ShardedGramianAccumulator",
+    "build_sharded_update",
     "data_axis_sum",
     "gramian_reference",
     "resolve_ring_pack",

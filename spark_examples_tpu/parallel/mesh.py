@@ -90,7 +90,12 @@ def ring_traffic_bytes(
     under the pack-width invariant — :func:`padded_cohort`). ``rows`` summed
     over data-parallel slices gives the whole-mesh total (each slice runs its
     own ring). The one audited formula behind the ``gramian_ring_bytes``
-    telemetry (``obs/metrics.py``) and the plan validator's traffic facts.
+    telemetry (``obs/metrics.py``) and the plan validator's traffic facts;
+    ``graftcheck ir`` (``check/ir.py``) cross-validates it against the
+    bytes the traced kernel jaxprs actually move (ppermute operand bytes x
+    scan trip counts x devices) and fails CI on any divergence (GI005), so
+    a wire-format or ring-schedule change can never silently decouple the
+    reported traffic from the real traffic.
     """
     width = (
         int(n_local) // RING_PACK_MULTIPLE if packed else int(n_local)

@@ -1149,6 +1149,10 @@ class FileGenomicsSource(GenomicsSource):
         #: Sets whose AUTO-selected streaming failed the coordinate-order
         #: probe and fell back to the in-memory path (with a warning).
         self._no_stream: set = set()
+        # The leaf-ness below is machine-checked: `graftcheck lockgraph`
+        # builds the static acquisition-order graph and fails CI if this
+        # node ever grows an edge into a cycle, or is held across a device
+        # sync / blocking queue op (check/lockgraph.py, GL001-GL004).
         # lock order: leaf lock guarding the parsed-view caches; held only
         # around dict get/insert (parses happen inside, but never take
         # another lock — the parse pool's workers are lock-free).

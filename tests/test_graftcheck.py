@@ -248,6 +248,70 @@ def test_gc009_disable_escape_hatch():
     assert lint_source(src, "pipeline/fixture.py") == []
 
 
+def test_gc010_host_numpy_under_jit():
+    bad = textwrap.dedent(
+        """
+        import jax
+        import numpy as np
+        @jax.jit
+        def kernel(G, X):
+            mask = np.asarray(X)
+            return G + np.sum(mask)
+        """
+    )
+    assert _ids(lint_source(bad, "ops/fixture.py")) == [
+        ("GC010", 6),
+        ("GC010", 7),
+    ]
+
+
+def test_gc010_shard_map_decoration_and_scope():
+    bad = textwrap.dedent(
+        """
+        import functools
+        import numpy as np
+        from spark_examples_tpu.utils.compat import shard_map
+        @functools.partial(shard_map, mesh=None, in_specs=(), out_specs=())
+        def per_device(x):
+            return np.packbits(x)
+        """
+    )
+    assert _ids(lint_source(bad, "ops/fixture.py")) == [("GC010", 7)]
+    # The same code outside ops/ (tests, host staging) is legitimate.
+    assert lint_source(bad, "sources/fixture.py") == []
+    # Undecorated host code in ops/ is the normal staging path.
+    host = textwrap.dedent(
+        """
+        import numpy as np
+        def stage(rows):
+            return np.packbits(rows, axis=-1)
+        """
+    )
+    assert lint_source(host, "ops/fixture.py") == []
+
+
+def test_gc010_dtype_constructors_and_escape_hatch():
+    # np dtype constructors are trace-time metadata, not host compute.
+    ok = textwrap.dedent(
+        """
+        import jax
+        import numpy as np
+        @jax.jit
+        def kernel(G, X):
+            return G + X.astype(np.dtype("float32"))
+        """
+    )
+    assert lint_source(ok, "ops/fixture.py") == []
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def kernel(G):\n"
+        "    return G + np.sum(G)  # graftcheck: disable=GC010 -- trace-time constant, measured\n"
+    )
+    assert lint_source(src, "ops/fixture.py") == []
+
+
 # --------------------------------------------------------------------------
 # Escape hatches.
 # --------------------------------------------------------------------------
@@ -430,6 +494,59 @@ def test_plan_touches_no_device_arrays():
     )
     assert report.ok
     assert len(jax.live_arrays()) == before  # eval_shape only — no buffers
+
+
+def test_plan_rejects_negative_heartbeat():
+    # The parse path rejects it as a flag contract…
+    from spark_examples_tpu.check.cli import main
+
+    assert main(["plan", "--heartbeat-seconds", "-5"]) == 2
+    # …and programmatic PcaConf construction (which bypasses
+    # _from_namespace) is caught by validate_plan itself.
+    conf = PcaConf()
+    conf.heartbeat_seconds = -1.0
+    report = validate_plan(conf)
+    assert not report.ok
+    assert "heartbeat-seconds" in _error_codes(report)
+
+
+def test_plan_rejects_unwritable_metrics_json(tmp_path):
+    report = _plan(
+        ["--metrics-json", str(tmp_path / "no_such_dir" / "m.json")]
+    )
+    assert not report.ok
+    assert "metrics-json-parent" in _error_codes(report)
+    # A directory path can't receive the manifest either.
+    report = _plan(["--metrics-json", str(tmp_path)])
+    assert not report.ok
+    assert "metrics-json-parent" in _error_codes(report)
+    # A writable parent passes.
+    report = _plan(["--metrics-json", str(tmp_path / "m.json")])
+    assert report.ok, report.format()
+    from spark_examples_tpu.check.cli import main
+
+    assert (
+        main(["plan", "--metrics-json", str(tmp_path / "x" / "m.json")]) == 2
+    )
+
+
+def test_plan_surfaces_ir_facts_for_sharded_configs():
+    """The sharded plan report carries the jaxpr-derived ring traffic and
+    static liveness facts, and the jaxpr traffic equals the formula-derived
+    fact the report already had — cross-validated every plan run."""
+    report = _plan(
+        ["--mesh-shape", "1,2", "--similarity-strategy", "sharded"],
+        devices=2,
+    )
+    assert report.ok, report.format()
+    geometry = report.geometry
+    assert (
+        geometry["ring_bytes_per_flush_jaxpr"]
+        == geometry["ring_bytes_per_flush"]
+    )
+    assert geometry["ring_peak_live_bytes_per_device"] > 0
+    assert geometry["ring_permute_steps"] == 1  # samples axis 2 -> D-1 = 1
+    assert any("ring IR audit" in c for c in report.shape_checks)
 
 
 # --------------------------------------------------------------------------
