@@ -415,6 +415,14 @@ def _run_config(name: str, device) -> dict:
     # manifest counter, so packed-vs-unpacked is visible per artifact.
     ring_bytes = manifest_metric_value(manifest, GRAMIAN_RING_BYTES)
 
+    # Host-memory headroom (manifest schema v2): measured peak RSS next to
+    # the static bound parallel/mesh.py:host_peak_bytes proves for bounded
+    # ingest paths — BENCH artifacts record how much of the proven budget
+    # each config actually used.
+    host_memory = manifest.get("host_memory") or {}
+    host_peak = host_memory.get("peak_rss_bytes")
+    host_bound = host_memory.get("static_bound_bytes")
+
     # Device ingest parallelizes over the mesh — report throughput per chip
     # actually used: data axis × samples axis (the ring accumulator puts
     # every chip to work on the samples axis even at data_parallel=1).
@@ -441,6 +449,23 @@ def _run_config(name: str, device) -> dict:
             **(
                 {"gramian_ring_bytes": int(ring_bytes)}
                 if ring_bytes is not None
+                else {}
+            ),
+            **(
+                {"host_peak_rss_bytes": int(host_peak)}
+                if host_peak is not None
+                else {}
+            ),
+            **(
+                {
+                    "host_static_bound_bytes": int(host_bound),
+                    "host_mem_headroom_fraction": (
+                        round(1.0 - host_peak / host_bound, 4)
+                        if host_peak is not None and host_bound
+                        else None
+                    ),
+                }
+                if host_bound is not None
                 else {}
             ),
             "block_size": BLOCK,

@@ -12,9 +12,17 @@
 #      (static lock-acquisition-order graph of the ingest/obs layer must
 #      be acyclic and free of sync/queue-under-lock); the DOT graph
 #      artifact is left under the stage's run dir (path echoed).
+#   2c. hostmem — graftcheck hostmem (AST host-memory audit: the tree must
+#      be clean, every O(file) site a justified hostmem(unbounded)
+#      declaration) + the --host-mem-budget smoke on the 4-virtual-device
+#      synthetic config: a generous budget must plan OK, a 1 MiB budget
+#      must exit 2 — the static bound (parallel/mesh.py:host_peak_bytes)
+#      is enforced, not just printed.
 #   3. obs smoke — a tiny synthetic PCA run with --metrics-json and a
 #      1 s heartbeat; the produced run manifest must validate against the
-#      schema (obs/manifest.py:validate_manifest) and carry I/O stats.
+#      schema (obs/manifest.py:validate_manifest), carry I/O stats, and
+#      prove measured peak RSS <= the static host-memory bound (the
+#      runtime half of the hostmem contract).
 #   4. sharded-ring smoke — a 4-virtual-device sharded run (tiny synthetic
 #      cohort) twice: packed ring (--ring-pack-bits on) vs the unpacked
 #      oracle (off). Result rows must be byte-identical and the manifests'
@@ -58,6 +66,22 @@ else
   echo "lockgraph DOT artifact missing"; ir_rc=1
 fi
 
+echo "== hostmem stage (graftcheck hostmem + host-memory budget) =="
+hm_rc=0
+env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck hostmem || hm_rc=$?
+hm_flags="--num-samples 64 --references 1:0:400000 --mesh-shape 1,4 \
+  --similarity-strategy sharded --block-size 64 --plan-devices 4"
+env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck plan $hm_flags \
+  --host-mem-budget 8589934592 > /dev/null || {
+    echo "hostmem budget smoke: in-budget plan REJECTED"; hm_rc=1; }
+env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck plan $hm_flags \
+  --host-mem-budget 1048576 > /dev/null
+if [ "$?" -ne 2 ]; then
+  echo "hostmem budget smoke: over-budget plan did not exit 2"; hm_rc=1
+else
+  echo "hostmem budget smoke OK (in-budget plan OK, over-budget exit 2)"
+fi
+
 echo "== observability smoke (run manifest schema) =="
 obs_rc=0
 OBS_TMP=$(mktemp -d)
@@ -78,8 +102,19 @@ if errors:
 if doc["io_stats"] is None or doc["io_stats"]["variants"] <= 0:
     print("manifest has no I/O stats from the smoke run")
     sys.exit(1)
+hm = doc["host_memory"]
+if not hm["peak_rss_bytes"] or not hm["static_bound_bytes"]:
+    print(f"manifest host_memory incomplete: {hm}")
+    sys.exit(1)
+if hm["peak_rss_bytes"] > hm["static_bound_bytes"]:
+    print("measured peak RSS EXCEEDS the static host-memory bound: "
+          f"{hm['peak_rss_bytes']} > {hm['static_bound_bytes']} "
+          "(parallel/mesh.py:host_peak_bytes no longer describes reality)")
+    sys.exit(1)
 print(f"manifest OK ({len(doc['metrics'])} metrics, "
-      f"{len(doc['spans'])} root spans)")
+      f"{len(doc['spans'])} root spans; host peak RSS "
+      f"{hm['peak_rss_bytes'] >> 20} MiB <= bound "
+      f"{hm['static_bound_bytes'] >> 20} MiB)")
 PYEOF
 else
   echo "obs smoke run failed (rc=$obs_rc):"; tail -20 "$OBS_TMP/stderr.log"
@@ -143,6 +178,7 @@ fi
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 if [ "$lint_rc" -ne 0 ]; then exit "$lint_rc"; fi
 if [ "$ir_rc" -ne 0 ]; then exit "$ir_rc"; fi
+if [ "$hm_rc" -ne 0 ]; then exit "$hm_rc"; fi
 if [ "$obs_rc" -ne 0 ]; then exit "$obs_rc"; fi
 if [ "$ring_rc" -ne 0 ]; then exit "$ring_rc"; fi
 exit "$san_rc"

@@ -9,7 +9,10 @@ on them:
                   [--block-size B]           0 clean / 1 findings
     graftcheck lockgraph [PATH...] [--json] [--dot FILE]
                                               0 acyclic+clean / 1 findings
-    graftcheck plan <pca flags> [--plan-devices N] [--json]
+    graftcheck hostmem [PATH...] [--json]     0 clean (declared sites
+                                              allowed) / 1 findings
+    graftcheck plan <pca flags> [--plan-devices N]
+                  [--host-mem-budget BYTES] [--json]
                                               0 plan OK / 2 rejected
     graftcheck sanitize [--modes m1,m2] [--strict]
                                               0 clean or skipped / 1 FAIL
@@ -162,18 +165,47 @@ def _cmd_lockgraph(argv: Sequence[str]) -> int:
     return 0 if graph.ok else 1
 
 
+def _cmd_hostmem(argv: Sequence[str]) -> int:
+    from spark_examples_tpu.check.hostmem import (
+        audit_paths,
+        default_hostmem_paths,
+    )
+
+    parser = argparse.ArgumentParser(prog="graftcheck hostmem")
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help=(
+            "Files or trees to audit (default: this package's host-staging "
+            "layers — sources/, pipeline/, ops/)."
+        ),
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="Emit the machine-readable report."
+    )
+    ns = parser.parse_args(list(argv))
+    paths = ns.paths or default_hostmem_paths()
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"graftcheck hostmem: no such path {path!r}", file=sys.stderr)
+            return 2
+    report = audit_paths(paths)
+    print(report.to_json() if ns.json else report.format())
+    return 0 if report.ok else 1
+
+
 def _cmd_plan(argv: Sequence[str]) -> int:
     from spark_examples_tpu.check.plan import parse_plan_args, validate_plan
 
     try:
-        conf, plan_devices, json_out = parse_plan_args(argv)
+        conf, plan_devices, json_out, host_mem_budget = parse_plan_args(argv)
     except ValueError as e:
         # Cross-flag contract violations from PcaConf._from_namespace are
         # plan rejections in their own right (e.g. --blocks-per-dispatch 0).
         print(f"  ERROR [flag-contract] {e}")
         print("plan REJECTED")
         return 2
-    report = validate_plan(conf, plan_devices)
+    report = validate_plan(conf, plan_devices, host_mem_budget=host_mem_budget)
     print(report.to_json() if json_out else report.format())
     return 0 if report.ok else 2
 
@@ -219,6 +251,7 @@ _SUBCOMMANDS = {
     "lint": _cmd_lint,
     "ir": _cmd_ir,
     "lockgraph": _cmd_lockgraph,
+    "hostmem": _cmd_hostmem,
     "plan": _cmd_plan,
     "sanitize": _cmd_sanitize,
     "typecheck": _cmd_typecheck,

@@ -1,0 +1,751 @@
+"""Static host-memory bound auditing (``graftcheck hostmem``).
+
+ROADMAP item 1's asterisk: bounded-memory streaming is a single-path
+feature, not a proven global invariant. This module is the proof half —
+an AST dataflow pass over the host-staging layers (``sources/``,
+``pipeline/``, ``ops/``) that classifies every ingest/consume path as
+**bounded-window** or **O(file)**, the way ``graftcheck ir`` proves the
+ring-traffic formula against the traced kernels:
+
+- a per-function *taint* analysis marks values derived from file handles
+  (``open``/``gzip.open``/``_open_text`` results), whole-file reads, and
+  streaming block producers;
+- five rules (GH001-GH005, ``check/rules.py``) flag the O(file) staging
+  shapes: whole-file ``.read()``, unbounded accumulation of stream items
+  inside the read loop, ``list()`` materialization of a block stream,
+  one-shot ``*.decompress``, and whole-buffer numpy staging.
+
+Paths that are *honestly* O(file) today (the packed whole-file VCF parse,
+checkpoint resume) are **declared**, not silently passed::
+
+    raw = f.read()  # graftcheck: hostmem(unbounded) -- packed whole-file parse needs the contiguous buffer
+
+A declared site passes the audit but lands in the report's
+``declared_unbounded`` inventory — the machine-readable worklist of the
+streaming-everywhere refactor (DESIGN.md §8.6). A hatch without a
+justification does not count.
+
+The formula half lives in ``parallel/mesh.py:host_peak_bytes`` (the
+sibling of ``ring_traffic_bytes``); :func:`conf_host_peak_bytes` resolves
+one parsed configuration into that closed form — shared by ``graftcheck
+plan --host-mem-budget`` and the driver's ``host_static_bound_bytes``
+gauge, so the budget the validator enforces and the bound the manifest
+records can never drift. The loop closes at runtime: the manifest's
+``host_memory`` block carries measured peak RSS next to this bound, and
+CI asserts measured <= static on every build.
+
+Exit contract (``check/cli.py``): 0 = clean (declared sites allowed),
+1 = undeclared O(file) findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from spark_examples_tpu.check.linter import (
+    _collect_aliases,
+    _dotted,
+    _iter_py_files,
+)
+from spark_examples_tpu.check.rules import HOSTMEM_RULES, Finding
+
+#: Callables whose result is a file handle (taint root; `with ... as f`
+#: or assignment binds the handle name).
+_FILE_OPENERS = frozenset(
+    {
+        "open",
+        "io.open",
+        "gzip.open",
+        "bz2.open",
+        "lzma.open",
+        "_open_text",
+        "spark_examples_tpu.sources.files._open_text",
+    }
+)
+
+#: One-shot whole-buffer decompressors (GH004).
+_DECOMPRESSORS = frozenset(
+    {
+        "gzip.decompress",
+        "zlib.decompress",
+        "bz2.decompress",
+        "lzma.decompress",
+    }
+)
+
+#: Streaming block producers: iterating one is the bounded-window idiom;
+#: accumulating its items (GH002) or materializing it whole (GH003) is
+#: exactly the O(file) regression the audit exists to catch. Matched on
+#: the final attribute/name segment so `self.iter_chunk_arrays()` and
+#: `source.stream_genotype_blocks(...)` both resolve.
+_STREAM_PRODUCERS = frozenset(
+    {
+        "_iter_vcf_chunks",
+        "iter_chunk_arrays",
+        "stream_blocks",
+        "stream_genotype_blocks",
+        "genotype_blocks",
+        "iter_shards",
+        "iter_part",
+    }
+)
+
+#: numpy staging calls GH005 audits when fed a whole-file buffer.
+_NP_STAGING = frozenset(
+    {
+        "numpy.frombuffer",
+        "numpy.packbits",
+        "numpy.concatenate",
+        "numpy.stack",
+        "numpy.vstack",
+        "numpy.hstack",
+    }
+)
+
+#: Scalar extractors whose result does not carry the input's memory
+#: footprint — they break taint propagation (``n += len(chunk)`` is
+#: accounting, not accumulation).
+_SCALAR_EXTRACTORS = frozenset(
+    {"len", "int", "float", "bool", "min", "max", "sum", "ord", "hash"}
+)
+
+_HATCH_RE = re.compile(
+    r"#\s*graftcheck:\s*hostmem\(unbounded\)\s*(?:--\s*(?P<why>.*\S))?\s*$"
+)
+
+
+def parse_hostmem_hatches(source: str) -> Dict[int, str]:
+    """``{line: justification}`` for every JUSTIFIED hostmem(unbounded)
+    hatch; a hatch with no ``-- why`` text is ignored (declaring a site
+    without saying why it is allowed to be O(file) declares nothing).
+
+    A trailing hatch declares its own line; a comment-ONLY hatch line
+    declares the next line (justifications routinely outgrow the code
+    line — the same layout the ``# lock order:`` idiom uses)."""
+    hatches: Dict[int, str] = {}
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = _HATCH_RE.search(line)
+        if m is None or not m.group("why"):
+            continue
+        why = m.group("why").strip()
+        if line[: m.start()].strip() == "":
+            hatches[lineno + 1] = why
+        else:
+            hatches[lineno] = why
+    return hatches
+
+
+@dataclass
+class DeclaredSite:
+    """One justified ``hostmem(unbounded)`` site: an O(file) path the tree
+    acknowledges, inventoried for the streaming refactor."""
+
+    rule_id: str
+    path: str
+    line: int
+    detail: str
+    justification: str
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "rule": self.rule_id,
+            "path": self.path,
+            "line": self.line,
+            "detail": self.detail,
+            "justification": self.justification,
+        }
+
+
+@dataclass
+class HostmemReport:
+    """Audit result: undeclared findings fail; declared sites are listed."""
+
+    findings: List[Finding] = field(default_factory=list)
+    declared: List[DeclaredSite] = field(default_factory=list)
+    checked_files: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "tool": "graftcheck-hostmem",
+                "ok": self.ok,
+                "checked_files": self.checked_files,
+                "finding_count": len(self.findings),
+                "findings": [f.to_json() for f in self.findings],
+                "declared_unbounded": [d.to_json() for d in self.declared],
+            },
+            indent=2,
+        )
+
+    def format(self) -> str:
+        lines = [f.format() for f in self.findings]
+        if self.declared:
+            lines.append(
+                f"declared hostmem(unbounded) sites "
+                f"({len(self.declared)} — the streaming-refactor worklist):"
+            )
+            for d in self.declared:
+                lines.append(
+                    f"  {d.path}:{d.line}: {d.rule_id} -- {d.justification}"
+                )
+        verdict = (
+            "clean" if self.ok else f"{len(self.findings)} undeclared finding(s)"
+        )
+        lines.append(
+            f"graftcheck hostmem: {self.checked_files} file(s), {verdict}"
+        )
+        return "\n".join(lines)
+
+
+def _call_name(node: ast.expr, alias: Dict[str, str]) -> Optional[str]:
+    """Canonical dotted name of a call's callee, else None."""
+    if isinstance(node, ast.Call):
+        return _dotted(node.func, alias)
+    return None
+
+
+def _last_segment(name: Optional[str]) -> Optional[str]:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _attr_tail(node: ast.expr, alias: Dict[str, str]) -> Optional[str]:
+    """Final attribute/name segment of a call's callee (``self.x.stream_blocks``
+    → ``stream_blocks``) — _dotted rejects chains rooted at calls/subscripts,
+    so producers reached through them still resolve."""
+    if not isinstance(node, ast.Call):
+        return None
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return alias.get(func.id, func.id).rsplit(".", 1)[-1]
+    return None
+
+
+class _FunctionScope:
+    """Per-function taint state (the analysis never crosses function
+    boundaries: taint enters where a file is opened/read, and a function
+    receiving a whole buffer as a parameter audits at its caller)."""
+
+    def __init__(self) -> None:
+        self.handles: Set[str] = set()
+        #: names carrying ANY file/stream-derived data (a bounded window
+        #: counts: accumulating windows is how O(file) creeps back in).
+        self.tainted: Set[str] = set()
+        #: names carrying WHOLE-INPUT buffers (no-size reads, decompress
+        #: results) — the only tier GH005's numpy-staging rule fires on;
+        #: staging one bounded chunk is the windowed idiom, not a finding.
+        self.whole: Set[str] = set()
+        #: list names that accumulated stream items (GH005's second source).
+        self.accumulated: Set[str] = set()
+
+
+class _HostmemVisitor(ast.NodeVisitor):
+    def __init__(self, relpath: str, alias: Dict[str, str]):
+        self.relpath = relpath
+        self.alias = alias
+        self.findings: List[Finding] = []
+        self._scopes: List[_FunctionScope] = [_FunctionScope()]
+        self._loop_depth = 0
+
+    # ------------------------------------------------------------- plumbing
+
+    @property
+    def scope(self) -> _FunctionScope:
+        return self._scopes[-1]
+
+    def emit(self, rule_id: str, node: ast.AST, detail: str) -> None:
+        rule = HOSTMEM_RULES[rule_id]
+        if not rule.applies_to(self.relpath):
+            return
+        self.findings.append(
+            Finding(
+                rule_id,
+                self.relpath,
+                getattr(node, "lineno", 0),
+                getattr(node, "col_offset", 0) + 1,
+                detail,
+            )
+        )
+
+    def _is_tainted(self, node: ast.expr) -> bool:
+        """Whether an expression carries file/stream-derived data. Scalar
+        extractor calls launder taint (their results are O(1))."""
+        name = _call_name(node, self.alias)
+        if name in _SCALAR_EXTRACTORS:
+            return False
+        if self._is_taint_source(node):
+            return True
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and (
+                sub.id in self.scope.tainted
+                or sub.id in self.scope.handles
+                or sub.id in self.scope.accumulated
+            ):
+                return True
+            if isinstance(sub, ast.Call) and self._is_taint_source(sub):
+                return True
+        return False
+
+    def _is_taint_source(self, node: ast.expr) -> bool:
+        """Calls whose RESULT is file/stream data regardless of arguments:
+        handle reads and whole-buffer decompressors."""
+        if not isinstance(node, ast.Call):
+            return False
+        name = _call_name(node, self.alias)
+        if name in _DECOMPRESSORS:
+            return True
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in ("read", "read1", "readline", "readlines")
+            and isinstance(func.value, ast.Name)
+            and func.value.id in self.scope.handles
+        ):
+            return True
+        return False
+
+    def _is_whole_source(self, node: ast.expr) -> bool:
+        """Calls whose result is a WHOLE-input buffer: no-size reads,
+        readlines, and one-shot decompressors."""
+        if not isinstance(node, ast.Call):
+            return False
+        if _call_name(node, self.alias) in _DECOMPRESSORS:
+            return True
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id in self.scope.handles
+        ):
+            if func.attr == "read" and not node.args and not node.keywords:
+                return True
+            if func.attr == "readlines":
+                return True
+        return False
+
+    def _is_whole(self, node: ast.expr) -> bool:
+        """Whether an expression carries a whole-input buffer (GH005's
+        trigger tier)."""
+        if _call_name(node, self.alias) in _SCALAR_EXTRACTORS:
+            return False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and (
+                sub.id in self.scope.whole
+                or sub.id in self.scope.accumulated
+            ):
+                return True
+            if isinstance(sub, ast.Call) and self._is_whole_source(sub):
+                return True
+        return False
+
+    def _is_stream_iterable(self, node: ast.expr) -> bool:
+        """Whether a for-loop iterable is a file handle or a streaming
+        block producer (its items are then tainted window data)."""
+        if isinstance(node, ast.Name) and node.id in self.scope.handles:
+            return True
+        tail = _attr_tail(node, self.alias)
+        if tail in _STREAM_PRODUCERS:
+            return True
+        # Transparent iterator wrappers: ``enumerate(f)``, ``zip(a, f)``.
+        if isinstance(node, ast.Call) and _call_name(node, self.alias) in (
+            "enumerate",
+            "zip",
+            "iter",
+            "reversed",
+        ):
+            return any(self._is_stream_iterable(arg) for arg in node.args)
+        # Generator-expression shells over a stream, e.g.
+        # ``(v for _, v in dataset.iter_shards())``.
+        if isinstance(node, ast.GeneratorExp):
+            return any(
+                self._is_stream_iterable(gen.iter) for gen in node.generators
+            )
+        return False
+
+    def _taint_target(self, target: ast.expr, whole: bool = False) -> None:
+        if isinstance(target, ast.Name):
+            self.scope.tainted.add(target.id)
+            if whole:
+                self.scope.whole.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for element in target.elts:
+                self._taint_target(element, whole=whole)
+
+    # ------------------------------------------------------------ functions
+
+    def _visit_function(self, node: Any) -> None:
+        self._scopes.append(_FunctionScope())
+        outer_depth, self._loop_depth = self._loop_depth, 0
+        self.generic_visit(node)
+        self._loop_depth = outer_depth
+        self._scopes.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+    visit_Lambda = _visit_function
+
+    # ---------------------------------------------------------------- binds
+
+    def visit_With(self, node: ast.With) -> None:
+        for item in node.items:
+            opener = _call_name(item.context_expr, self.alias)
+            if opener in _FILE_OPENERS and isinstance(
+                item.optional_vars, ast.Name
+            ):
+                self.scope.handles.add(item.optional_vars.id)
+        self.generic_visit(node)
+
+    visit_AsyncWith = visit_With  # type: ignore[assignment]
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        opener = _call_name(node.value, self.alias)
+        if opener in _FILE_OPENERS:
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    self.scope.handles.add(target.id)
+        elif self._is_tainted(node.value):
+            whole = self._is_whole(node.value)
+            for target in node.targets:
+                self._taint_target(target, whole=whole)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        # ``buf += chunk`` inside the read loop is GH002's byte-buffer
+        # spelling of unbounded accumulation.
+        if (
+            self._loop_depth > 0
+            and isinstance(node.op, ast.Add)
+            and isinstance(node.target, ast.Name)
+            and self._is_tainted(node.value)
+        ):
+            self.emit(
+                "GH002",
+                node,
+                f"`{node.target.id} += ...` accumulates stream-derived data "
+                "inside the read loop — peak host memory grows with the "
+                "input, not the window",
+            )
+            self.scope.tainted.add(node.target.id)
+            self.scope.accumulated.add(node.target.id)
+        self.generic_visit(node)
+
+    # ---------------------------------------------------------------- loops
+
+    def _visit_loop(self, node: Any) -> None:
+        if isinstance(node, (ast.For, ast.AsyncFor)) and self._is_stream_iterable(
+            node.iter
+        ):
+            self._taint_target(node.target)
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+    visit_While = _visit_loop
+
+    # ---------------------------------------------------------------- calls
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _call_name(node, self.alias)
+        func = node.func
+
+        # GH001: whole-file read on a known handle.
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id in self.scope.handles
+        ):
+            if func.attr == "read" and not node.args and not node.keywords:
+                self.emit(
+                    "GH001",
+                    node,
+                    f"`{func.value.id}.read()` with no size stages the whole "
+                    "file in host RAM; read a bounded window in a loop",
+                )
+            elif func.attr == "readlines":
+                self.emit(
+                    "GH001",
+                    node,
+                    f"`{func.value.id}.readlines()` materializes every line "
+                    "at once; iterate the handle instead",
+                )
+
+        # GH002: accumulation of stream-derived items inside a loop.
+        if (
+            self._loop_depth > 0
+            and isinstance(func, ast.Attribute)
+            and func.attr in ("append", "extend", "appendleft")
+            and node.args
+            and self._is_tainted(node.args[0])
+        ):
+            self.emit(
+                "GH002",
+                node,
+                f".{func.attr}() of file/stream-derived data inside the "
+                "read loop accumulates the whole input on host; consume "
+                "per window instead",
+            )
+            if isinstance(func.value, ast.Name):
+                self.scope.accumulated.add(func.value.id)
+                self.scope.tainted.add(func.value.id)
+
+        # GH003: whole-stream materialization.
+        if (
+            name in ("list", "tuple")
+            and len(node.args) == 1
+            and not node.keywords
+            and self._is_stream_iterable(node.args[0])
+        ):
+            what = (
+                "a file handle"
+                if isinstance(node.args[0], ast.Name)
+                else f"streaming producer "
+                f"{_attr_tail(node.args[0], self.alias)!r}"
+            )
+            self.emit(
+                "GH003",
+                node,
+                f"{name}() over {what} materializes the whole stream the "
+                "producer keeps windowed",
+            )
+
+        # GH004: one-shot whole-buffer decompress.
+        if name in _DECOMPRESSORS:
+            self.emit(
+                "GH004",
+                node,
+                f"{name}() holds compressed and decompressed copies of the "
+                "payload simultaneously; stream through the module's file "
+                "interface with windowed reads",
+            )
+
+        # GH005: numpy staging over a whole-file buffer (bounded-window
+        # chunks are the staging idiom and stay clean — only the whole
+        # tier and stream-accumulated lists fire).
+        if name in _NP_STAGING and node.args and self._is_whole(node.args[0]):
+            self.emit(
+                "GH005",
+                node,
+                f"{name.replace('numpy', 'np')}() over a whole-file buffer "
+                "(or stream-accumulated list) stages an O(input) host "
+                "array; stage per chunk/block",
+            )
+
+        self.generic_visit(node)
+
+
+def audit_source(
+    source: str, relpath: str
+) -> Tuple[List[Finding], List[DeclaredSite]]:
+    """Audit one file's text. Returns ``(undeclared findings, declared
+    sites)``; a finding on a line carrying a justified
+    ``hostmem(unbounded)`` hatch moves to the declared inventory."""
+    tree = ast.parse(source, filename=relpath)
+    alias = _collect_aliases(tree)
+    visitor = _HostmemVisitor(relpath, alias)
+    visitor.visit(tree)
+    hatches = parse_hostmem_hatches(source)
+    findings: List[Finding] = []
+    declared: List[DeclaredSite] = []
+    for f in sorted(visitor.findings, key=lambda f: (f.line, f.rule_id, f.col)):
+        why = hatches.get(f.line)
+        if why is not None:
+            declared.append(
+                DeclaredSite(f.rule_id, f.path, f.line, f.detail, why)
+            )
+        else:
+            findings.append(f)
+    return findings, declared
+
+
+def default_hostmem_paths() -> List[str]:
+    """The audited host-staging layers of the installed package."""
+    import spark_examples_tpu
+
+    package_dir = os.path.dirname(os.path.abspath(spark_examples_tpu.__file__))
+    return [
+        os.path.join(package_dir, sub) for sub in ("sources", "pipeline", "ops")
+    ]
+
+
+def audit_paths(paths: Sequence[str]) -> HostmemReport:
+    """Audit files/trees (``graftcheck hostmem`` engine)."""
+    report = HostmemReport()
+    seen: Set[str] = set()
+    for root in paths:
+        for full, relpath in _iter_files_scoped(root):
+            if full in seen:
+                continue
+            seen.add(full)
+            with open(full, "r", encoding="utf-8") as f:
+                source = f.read()
+            try:
+                findings, declared = audit_source(source, relpath)
+            except SyntaxError as e:
+                report.findings.append(
+                    Finding(
+                        "GH001",
+                        relpath,
+                        e.lineno or 0,
+                        e.offset or 0,
+                        f"file does not parse; the audit cannot vouch for "
+                        f"it: {e.msg}",
+                    )
+                )
+                report.checked_files += 1
+                continue
+            report.findings.extend(findings)
+            report.declared.extend(declared)
+            report.checked_files += 1
+    report.findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
+    report.declared.sort(key=lambda d: (d.path, d.line, d.rule_id))
+    return report
+
+
+def _iter_files_scoped(root: str) -> Iterable[Tuple[str, str]]:
+    """(abs_path, package-relative path) pairs, through the linter's shared
+    package-root resolution so scope globs match regardless of the path the
+    CLI was handed (a subdirectory, a single file, or the package root)."""
+    from spark_examples_tpu.check.linter import _package_relpath
+
+    if os.path.isfile(root):
+        yield root, _package_relpath(root)
+        return
+    for full, _rel in _iter_py_files(root):
+        yield full, _package_relpath(full)
+
+
+# --------------------------------------------------------------------------
+# The configuration-level budget resolver (formula in parallel/mesh.py).
+# --------------------------------------------------------------------------
+
+
+def conf_mesh_axes(conf: Any, device_count: Optional[int]) -> Tuple[int, int]:
+    """(data, samples) a run of ``conf`` would build — the same resolution
+    ``check/plan.py`` and ``pca_driver._make_mesh`` apply, shared here so
+    the budget formula's geometry inputs cannot drift from either."""
+    from spark_examples_tpu.parallel.mesh import parse_mesh_shape
+
+    mesh_shape = getattr(conf, "mesh_shape", None)
+    if mesh_shape:
+        shape = parse_mesh_shape(mesh_shape)
+        return shape["data"], shape["samples"]
+    devices = device_count if device_count is not None else 1
+    data = max(1, min(devices, int(conf.num_reduce_partitions)))
+    return data, 1
+
+
+def _streamable_vcf_input(conf: Any) -> bool:
+    """Whether the configured file ingest is the ONE shape that actually
+    streams (``FileGenomicsSource.wants_streaming``'s static mirror): a
+    single variant set whose selected input is a ``.vcf[.gz]`` file.
+    JSONL/SAM inputs and checkpoint directories never stream — their
+    whole-file tables are declared ``hostmem(unbounded)`` sites — and
+    multi-set configs take the wire join."""
+    input_files = list(getattr(conf, "input_files", None) or [])
+    set_ids = list(getattr(conf, "variant_set_id", None) or [])
+    if not input_files or len(set_ids) != 1:
+        return False
+    from spark_examples_tpu.sources.files import file_set_ids
+
+    by_id = dict(zip(file_set_ids(input_files), input_files))
+    path = by_id.get(set_ids[0])
+    if path is None or os.path.isdir(path):
+        return False
+    lowered = path[:-3] if path.endswith(".gz") else path
+    return lowered.endswith(".vcf")
+
+
+def conf_host_peak_bytes(
+    conf: Any,
+    device_count: Optional[int] = None,
+    num_samples: Optional[int] = None,
+) -> Optional[int]:
+    """``host_peak_bytes`` for one parsed configuration, or ``None`` when
+    the configured ingest path is O(file) — no static bound exists for it.
+
+    ``num_samples`` overrides the flag value with the DISCOVERED cohort
+    width (file sources carry their cohort in the data; the driver passes
+    its resolved matrix size, the static plan validator the declared flag).
+
+    Bounded paths (the formula's domain):
+
+    - synthetic source, every ingest mode (the data plane is generated per
+      window; nothing whole-file ever stages on host);
+    - a SINGLE ``.vcf[.gz]`` file set on the packed/auto ingest with
+      EXPLICIT streaming (``--stream-chunk-bytes N > 0``): one pass,
+      O(workers x chunk) parse staging. Only VCFs stream
+      (``FileGenomicsSource.wants_streaming``); JSONL/SAM/checkpoint
+      inputs always stage whole-file tables, and multi-set file configs
+      take the wire join — claiming a bound there would be a false proof.
+
+    Everything else is data-dependent host memory today — auto streaming
+    (the decision needs the file size), the in-memory packed parse, wire
+    file/REST ingest, and checkpoint resume (``--input-path``) — and
+    returns ``None``: the declared ``hostmem(unbounded)`` inventory, not
+    the formula, owns those paths until the streaming refactor lands.
+    """
+    from spark_examples_tpu.parallel.mesh import host_peak_bytes
+    from spark_examples_tpu.sources.files import _resolve_ingest_workers
+
+    if getattr(conf, "input_path", None):
+        return None
+    source = getattr(conf, "source", "synthetic")
+    stream_chunk = getattr(conf, "stream_chunk_bytes", None)
+    ingest = getattr(conf, "ingest", "auto")
+    chunk_bytes = 0
+    if source == "file":
+        if ingest == "wire":
+            return None
+        if not stream_chunk or stream_chunk <= 0:
+            return None
+        if not _streamable_vcf_input(conf):
+            return None
+        chunk_bytes = int(stream_chunk)
+    elif source != "synthetic":
+        return None  # REST wire ingest materializes per-shard record pages
+    workers = _resolve_ingest_workers(getattr(conf, "ingest_workers", None))
+    data, _samples = conf_mesh_axes(conf, device_count)
+    # Mirrors pipeline/pca_driver._similarity_stage: a depth-2
+    # PrefetchIterator and the double-buffered device feed exist whenever
+    # parse workers do (any packed-path source). The pure device-generation
+    # path has neither, so for it these terms only make the bound more
+    # conservative — never smaller than reality.
+    prefetch_depth = 2 if workers > 0 else 0
+    pipeline_depth = 2 if workers > 0 else 0
+    host_backend = getattr(conf, "pca_backend", "tpu") == "host"
+    if num_samples is None:
+        num_samples = int(conf.num_samples)
+    return host_peak_bytes(
+        num_samples=int(num_samples),
+        block_size=int(conf.block_size),
+        data_axis=data,
+        ingest_workers=workers,
+        chunk_bytes=chunk_bytes,
+        prefetch_depth=prefetch_depth,
+        pipeline_depth=pipeline_depth,
+        host_accumulator=host_backend,
+    )
+
+
+__all__ = [
+    "DeclaredSite",
+    "HostmemReport",
+    "audit_paths",
+    "audit_source",
+    "conf_host_peak_bytes",
+    "conf_mesh_axes",
+    "default_hostmem_paths",
+    "parse_hostmem_hatches",
+]

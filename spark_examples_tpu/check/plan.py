@@ -103,9 +103,10 @@ class _RaisingParser(argparse.ArgumentParser):
 
 def parse_plan_args(argv: Sequence[str]):
     """Parse ``graftcheck plan`` argv: the full PCA flag surface plus the
-    plan-only ``--plan-devices``. Returns ``(conf, plan_devices, json_out)``.
-    Flag errors raise ``ValueError`` (argparse's SystemExit is converted so
-    the caller reports them as plan rejections, not a CLI crash)."""
+    plan-only ``--plan-devices`` and ``--host-mem-budget``. Returns
+    ``(conf, plan_devices, json_out, host_mem_budget)``. Flag errors raise
+    ``ValueError`` (argparse's SystemExit is converted so the caller
+    reports them as plan rejections, not a CLI crash)."""
     parser = build_pca_parser(
         _RaisingParser(prog="graftcheck plan", add_help=True)
     )
@@ -120,11 +121,23 @@ def parse_plan_args(argv: Sequence[str]):
         ),
     )
     parser.add_argument(
+        "--host-mem-budget",
+        type=int,
+        default=None,
+        metavar="BYTES",
+        help=(
+            "Host-RAM budget in bytes to enforce against the static bound "
+            "parallel/mesh.py:host_peak_bytes (bounded ingest paths only — "
+            "a configuration whose ingest is O(file) cannot be proven and "
+            "is rejected under a budget). Over-budget configs exit 2."
+        ),
+    )
+    parser.add_argument(
         "--json", action="store_true", help="Emit the machine-readable report."
     )
     ns = parser.parse_args(list(argv))
     conf = PcaConf._from_namespace(ns)
-    return conf, ns.plan_devices, ns.json
+    return conf, ns.plan_devices, ns.json, ns.host_mem_budget
 
 
 def _resolve_mesh_axes(
@@ -398,12 +411,70 @@ def _eval_sharded_update(
         )
 
 
+def _check_host_memory(
+    conf: PcaConf,
+    plan_devices: Optional[int],
+    host_mem_budget: Optional[int],
+    report: PlanReport,
+) -> None:
+    """Host-memory facts + budget enforcement: the static bound from the
+    ONE formula (``parallel/mesh.py:host_peak_bytes``, resolved through
+    ``check/hostmem.py:conf_host_peak_bytes`` — the same resolver the
+    driver's ``host_static_bound_bytes`` gauge uses). Bounded ingest paths
+    get the bound as a geometry fact and, under ``--host-mem-budget``, an
+    over-budget error; an O(file) path under a budget is rejected too —
+    the flag asks for a proof the configuration cannot give."""
+    from spark_examples_tpu.check.hostmem import conf_host_peak_bytes
+
+    bound = conf_host_peak_bytes(conf, device_count=plan_devices)
+    if bound is not None:
+        report.geometry["host_peak_bytes"] = bound
+        if host_mem_budget is not None and bound > host_mem_budget:
+            report.error(
+                "host-mem-over-budget",
+                f"static host-memory bound ~{bound / (1 << 30):.2f} GiB "
+                f"(parallel/mesh.py:host_peak_bytes) exceeds "
+                f"--host-mem-budget {host_mem_budget} "
+                f"({host_mem_budget / (1 << 30):.2f} GiB); shrink the "
+                "ingest window (--stream-chunk-bytes, --ingest-workers, "
+                "--block-size) or raise the budget",
+            )
+        return
+    report.geometry["host_peak_bytes"] = None
+    if host_mem_budget is not None:
+        report.error(
+            "host-mem-unprovable",
+            "this configuration's ingest path is O(file) in host RAM "
+            "(in-memory/auto file parse, wire ingest, or checkpoint "
+            "resume), so no static bound exists to enforce "
+            "--host-mem-budget against; use explicit streaming "
+            "(--stream-chunk-bytes N) or a bounded source",
+        )
+    elif getattr(conf, "source", "synthetic") == "file":
+        report.warn(
+            "host-mem-unbounded-path",
+            "peak host memory is O(file) for this ingest path (no "
+            "explicit --stream-chunk-bytes); the declared "
+            "hostmem(unbounded) inventory (graftcheck hostmem) owns it "
+            "until the streaming refactor lands",
+        )
+
+
 def validate_plan(
-    conf: PcaConf, plan_devices: Optional[int] = None
+    conf: PcaConf,
+    plan_devices: Optional[int] = None,
+    host_mem_budget: Optional[int] = None,
 ) -> PlanReport:
     """Statically validate one pipeline configuration. Pure flag/geometry
     arithmetic plus abstract kernel traces — no device is queried."""
     report = PlanReport()
+    if host_mem_budget is not None and host_mem_budget <= 0:
+        report.error(
+            "host-mem-budget",
+            f"--host-mem-budget must be a positive byte count, got "
+            f"{host_mem_budget}",
+        )
+        host_mem_budget = None
 
     # ---------------------------------------------------------- flag sanity
     if conf.num_reduce_partitions < 1:
@@ -555,6 +626,7 @@ def validate_plan(
     report.geometry["dense_accumulator_bytes_per_device"] = N * N * accum_bytes
     staging = data * conf.block_size * N
     report.geometry["host_staging_bytes"] = staging
+    _check_host_memory(conf, plan_devices, host_mem_budget, report)
     if not sharded and conf.similarity_strategy == "dense":
         # Explicit dense: validate against the default HBM budget (the
         # validator must not query real devices; the run's auto rule reads

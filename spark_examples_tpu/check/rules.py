@@ -223,6 +223,74 @@ IR_RULES: Dict[str, Rule] = {
 }
 
 
+#: ``graftcheck hostmem`` scope: the host-staging layers whose ingest and
+#: consume paths must be provably bounded-window (or carry a justified
+#: ``hostmem(unbounded)`` declaration) — the host-RAM analog of the
+#: HBM/ring-traffic bounds the plan validator already proves.
+HOSTMEM_GLOBS = ("sources/*", "pipeline/*", "ops/*")
+
+#: ``graftcheck hostmem`` rule catalogue (``check/hostmem.py``): an AST
+#: dataflow audit classifying every host ingest/consume path as
+#: bounded-window or O(file). Unlike the ``disable=`` hatch, the hostmem
+#: escape hatch DECLARES a site rather than silencing it::
+#:
+#:     raw = f.read()  # graftcheck: hostmem(unbounded) -- why this path is honestly O(file)
+#:
+#: Declared sites pass the audit but are inventoried in the report (and in
+#: DESIGN.md §8.6) so the streaming refactor has a machine-readable
+#: worklist; a hatch with no justification does not count.
+HOSTMEM_RULES: Dict[str, Rule] = {
+    rule.id: rule
+    for rule in [
+        Rule(
+            "GH001",
+            "whole-file-read",
+            "A no-size .read()/.readlines() on a file handle stages the "
+            "entire file in host RAM at once; read a bounded window in a "
+            "loop, or declare the site hostmem(unbounded) with its "
+            "justification.",
+            scope=HOSTMEM_GLOBS,
+        ),
+        Rule(
+            "GH002",
+            "unbounded-stream-accumulation",
+            "A list/buffer accumulates file- or stream-derived items "
+            "inside the read loop, so peak host memory grows with the "
+            "input instead of the window; consume per window, or declare "
+            "the site hostmem(unbounded).",
+            scope=HOSTMEM_GLOBS,
+        ),
+        Rule(
+            "GH003",
+            "stream-materialization",
+            "list()/tuple() over a file handle or a streaming block "
+            "producer materializes the whole stream the producer exists "
+            "to keep windowed; iterate it, or declare the site "
+            "hostmem(unbounded).",
+            scope=HOSTMEM_GLOBS,
+        ),
+        Rule(
+            "GH004",
+            "whole-buffer-decompress",
+            "A one-shot decompress (gzip/zlib/bz2/lzma .decompress) holds "
+            "compressed AND decompressed copies of the payload at once; "
+            "stream through the module's file interface (e.g. gzip.open "
+            "windowed reads), or declare the site hostmem(unbounded).",
+            scope=HOSTMEM_GLOBS,
+        ),
+        Rule(
+            "GH005",
+            "whole-buffer-numpy-staging",
+            "np.frombuffer/np.packbits/np.concatenate/np.stack over a "
+            "whole-file buffer (or a stream-accumulated list) stages an "
+            "O(file) array on host; stage per chunk/block, or declare the "
+            "site hostmem(unbounded).",
+            scope=HOSTMEM_GLOBS,
+        ),
+    ]
+}
+
+
 #: ``graftcheck lockgraph`` rule catalogue (``check/lockgraph.py``): static
 #: lock-acquisition-order analysis of the threaded ingest/telemetry layer.
 #: GL findings anchor to real source lines, so the standard
@@ -266,7 +334,12 @@ LOCK_RULES: Dict[str, Rule] = {
 
 
 #: Every rule id any graftcheck layer can emit, for Finding.rule lookup.
-ALL_RULES: Dict[str, Rule] = {**RULES, **IR_RULES, **LOCK_RULES}
+ALL_RULES: Dict[str, Rule] = {
+    **RULES,
+    **IR_RULES,
+    **LOCK_RULES,
+    **HOSTMEM_RULES,
+}
 
 
 @dataclass
@@ -359,8 +432,10 @@ __all__ = [
     "RULES",
     "IR_RULES",
     "LOCK_RULES",
+    "HOSTMEM_RULES",
     "ALL_RULES",
     "HOT_PATH_GLOBS",
+    "HOSTMEM_GLOBS",
     "INGEST_GLOBS",
     "TELEMETRY_GLOBS",
     "parse_disables",

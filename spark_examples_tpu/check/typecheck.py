@@ -36,11 +36,16 @@ BASELINE_PATH = os.path.join(_CHECK_DIR, "mypy_baseline.txt")
 #: numerics modules earn coverage as annotations land.
 TARGETS = (
     os.path.join(_PACKAGE_DIR, "config.py"),
+    os.path.join(_PACKAGE_DIR, "parallel", "mesh.py"),
 )
 
 #: The ``--strict`` tier: the checker itself (it gates everyone else's
-#: code, so it holds itself to the highest standard) and the telemetry
-#: subsystem (its registry/manifest types ARE its wire contract).
+#: code, so it holds itself to the highest standard — ``check/hostmem.py``
+#: rides in with the directory) and the telemetry subsystem (its
+#: registry/manifest types ARE its wire contract). ``parallel/mesh.py``
+#: joins the permissive tier below for its two audited formulas
+#: (``ring_traffic_bytes``, ``host_peak_bytes``) whose argument types are
+#: plan-validator contract.
 STRICT_TARGETS = (
     _CHECK_DIR,
     os.path.join(_PACKAGE_DIR, "obs"),

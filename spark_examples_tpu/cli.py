@@ -21,10 +21,14 @@ Static analysis (``check/``; README "graftcheck"): ``graftcheck lint``
 Gramian kernels: ring overlap, donation contract, packed-wire dtype flow,
 traffic/liveness facts), ``graftcheck lockgraph`` (static
 lock-acquisition-order graph of the threaded ingest layer, DOT artifact),
-``graftcheck plan`` (device-free flag/geometry/kernel-shape validation),
+``graftcheck hostmem`` (host-memory bound audit of the staging layers:
+O(file) paths must carry justified ``hostmem(unbounded)`` declarations),
+``graftcheck plan`` (device-free flag/geometry/kernel-shape validation;
+``--host-mem-budget`` enforces the static host-RAM bound),
 ``graftcheck sanitize`` / ``graftcheck typecheck``:
 
     python -m spark_examples_tpu graftcheck ir --json
+    python -m spark_examples_tpu graftcheck hostmem --json
     python -m spark_examples_tpu graftcheck lockgraph --dot lockorder.dot
 
 Observability (``obs/``; README "Observability"): ``--heartbeat-seconds N``

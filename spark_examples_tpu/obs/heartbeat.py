@@ -28,6 +28,10 @@ Well-known metric names sampled (producers register them; see DESIGN.md §9):
 - ``gramian_inflight_dispatches`` (gauge)
 - ``gramian_ring_bytes`` (counter, sharded paths) — cumulative ICI ring
   traffic, the number ``--ring-pack-bits`` cuts 8×
+- ``host_peak_rss_bytes`` (function-backed gauge — each tick samples the
+  OS high-water mark) vs ``host_static_bound_bytes`` (the
+  ``host_peak_bytes`` formula), the host-memory pair ``graftcheck
+  hostmem`` cross-validates
 - device memory from ``jax.local_devices()[0].memory_stats()`` when the
   backend reports it (TPU does; CPU test devices do not).
 
@@ -46,6 +50,8 @@ from typing import Callable, Optional
 from spark_examples_tpu.obs.metrics import (
     GRAMIAN_INFLIGHT_DISPATCHES,
     GRAMIAN_RING_BYTES,
+    HOST_PEAK_RSS_BYTES,
+    HOST_STATIC_BOUND_BYTES,
     INGEST_PARTITIONS_DONE,
     INGEST_PARTITIONS_PLANNED,
     INGEST_SITES_SCANNED,
@@ -213,6 +219,18 @@ class Heartbeat:
         ring_bytes = self.registry.value(GRAMIAN_RING_BYTES)
         if ring_bytes:
             parts.append(f"ring traffic {_bytes_text(ring_bytes)}")
+
+        # Host-memory cross-validation pair: each tick SAMPLES the
+        # function-backed peak-RSS gauge (graftcheck hostmem's runtime
+        # half), shown against the static bound when the driver proved one
+        # — an operator watches the headroom shrink long before an OOM.
+        peak_rss = self.registry.value(HOST_PEAK_RSS_BYTES)
+        if peak_rss is not None and peak_rss == peak_rss and peak_rss > 0:
+            segment = f"host rss peak {_bytes_text(peak_rss)}"
+            bound = self.registry.value(HOST_STATIC_BOUND_BYTES)
+            if bound:
+                segment += f"/{_bytes_text(bound)} bound"
+            parts.append(segment)
 
         memory = _device_memory_line()
         if memory is not None:

@@ -8,10 +8,16 @@ echo, the hierarchical span tree, every registry metric, the I/O stats
 block (numerically identical to the printed report — both read the same
 registry), the ingest-overlap accounting, and compile-cache state.
 
-Schema: ``{"id": "spark-examples-tpu/run-manifest", "version": 1}``.
+Schema: ``{"id": "spark-examples-tpu/run-manifest", "version": 2}``.
 :func:`validate_manifest` is the hand-rolled structural validator (no
 jsonschema dependency in the image) used by tests and the ``ci.sh`` smoke
 stage; bump ``MANIFEST_VERSION`` and extend the validator together.
+
+Version history: v2 added the required ``host_memory`` block —
+``peak_rss_bytes`` (measured OS high-water mark) next to
+``static_bound_bytes`` (``parallel/mesh.py:host_peak_bytes``, null when
+the configured ingest path is O(file)), the pair ``graftcheck hostmem``
+cross-validates and ``bench.py`` reports as host-memory headroom.
 
 Multi-host: under ``jax.distributed`` each process carries per-process
 I/O counters. :func:`build_run_manifest` aggregates them across processes
@@ -30,7 +36,7 @@ import time
 from typing import Dict, List, Mapping, Optional
 
 MANIFEST_ID = "spark-examples-tpu/run-manifest"
-MANIFEST_VERSION = 1
+MANIFEST_VERSION = 2
 
 #: The I/O stats fields, in report order (``pipeline/stats.py.__str__``).
 IO_STAT_FIELDS = (
@@ -70,6 +76,29 @@ def _compile_cache_block() -> Optional[Dict]:
         return None
 
 
+def _host_memory_block(registry=None) -> Dict:
+    """The v2 ``host_memory`` block: measured peak RSS (read directly from
+    the OS so every manifest carries it, registry or not) next to the
+    static bound the driver's gauge holds when the configured ingest path
+    is bounded (``check/hostmem.py:conf_host_peak_bytes``; null when no
+    static bound exists — the declared-unbounded paths)."""
+    from spark_examples_tpu.obs.metrics import (
+        HOST_STATIC_BOUND_BYTES,
+        read_host_peak_rss_bytes,
+    )
+
+    bound = None
+    if registry is not None:
+        value = registry.value(HOST_STATIC_BOUND_BYTES)
+        if value is not None and value == value and value > 0:
+            bound = int(value)
+    peak = read_host_peak_rss_bytes()
+    return {
+        "peak_rss_bytes": int(peak) if peak is not None else None,
+        "static_bound_bytes": bound,
+    }
+
+
 def _process_block() -> Dict:
     try:
         import jax
@@ -86,9 +115,12 @@ def build_manifest(
     io_stats: Optional[Dict] = None,
     overlap: Optional[Dict] = None,
     multihost: Optional[Dict] = None,
+    host_memory: Optional[Dict] = None,
 ) -> Dict:
     """Assemble a manifest from already-snapshotted parts (the low-level
-    form; :func:`build_run_manifest` snapshots a live driver)."""
+    form; :func:`build_run_manifest` snapshots a live driver). The
+    ``host_memory`` block defaults to a fresh OS sample with no static
+    bound, so hand-assembled manifests stay schema-valid."""
     return {
         "schema": {"id": MANIFEST_ID, "version": MANIFEST_VERSION},
         "created_unix": time.time(),
@@ -97,6 +129,9 @@ def build_manifest(
         "metrics": metrics or {},
         "io_stats": io_stats,
         "overlap": overlap,
+        "host_memory": (
+            host_memory if host_memory is not None else _host_memory_block()
+        ),
         "compile_cache": _compile_cache_block(),
         "process": _process_block(),
         "multihost": multihost,
@@ -135,6 +170,7 @@ def build_run_manifest(conf=None, spans=None, registry=None, io_stats=None,
         io_stats=stats_block,
         overlap=overlap,
         multihost=multihost_block,
+        host_memory=_host_memory_block(registry),
     )
 
 
@@ -220,6 +256,22 @@ def validate_manifest(doc) -> List[str]:
     overlap = doc.get("overlap")
     if overlap is not None and not isinstance(overlap, Mapping):
         errors.append("'overlap' is neither null nor an object")
+
+    host_memory = doc.get("host_memory")
+    if not isinstance(host_memory, Mapping):
+        errors.append("missing 'host_memory' object (schema v2)")
+    else:
+        for field in ("peak_rss_bytes", "static_bound_bytes"):
+            value = host_memory.get(field, "absent")
+            if value == "absent":
+                errors.append(f"host_memory.{field} missing")
+            elif value is not None and (
+                not isinstance(value, int) or isinstance(value, bool) or value < 0
+            ):
+                errors.append(
+                    f"host_memory.{field} is neither null nor a "
+                    f"non-negative int: {value!r}"
+                )
     return errors
 
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import math
 import re
+import sys
 import threading
 from typing import Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 
@@ -62,6 +63,14 @@ GRAMIAN_RING_FLUSH_SECONDS = "gramian_ring_flush_seconds"
 #: (registered by ``pipeline/stats.py:_STAT_METRICS``, spelled once here).
 IO_PARTITIONS_TOTAL = "io_partitions_total"
 
+#: Host-memory cross-validation pair (``graftcheck hostmem``'s runtime
+#: half): the measured peak process RSS (function-backed — every read
+#: samples the OS) next to the static bound from
+#: ``parallel/mesh.py:host_peak_bytes``. The heartbeat samples the pair
+#: per tick; the run manifest records both; CI asserts measured <= bound.
+HOST_PEAK_RSS_BYTES = "host_peak_rss_bytes"
+HOST_STATIC_BOUND_BYTES = "host_static_bound_bytes"
+
 _WELL_KNOWN_GAUGE_HELP = {
     INGEST_SITES_SCANNED: (
         "Candidate sites scanned so far (heartbeat progress)."
@@ -88,6 +97,15 @@ _WELL_KNOWN_GAUGE_HELP = {
         "summed over data slices) — the denominator of the dispatch "
         "padding-waste fraction against ingest_sites_scanned."
     ),
+    HOST_PEAK_RSS_BYTES: (
+        "Peak resident set size of this process so far (OS-reported "
+        "high-water mark, sampled at read time)."
+    ),
+    HOST_STATIC_BOUND_BYTES: (
+        "Static host-memory bound of this configuration "
+        "(parallel/mesh.py:host_peak_bytes); measured peak RSS must stay "
+        "under it on bounded ingest paths."
+    ),
 }
 
 _WELL_KNOWN_COUNTER_HELP = {
@@ -111,6 +129,32 @@ def well_known_counter(registry: "MetricsRegistry", name: str):
     flush telemetry and the driver's device-ingest epilogue), the heartbeat,
     bench.py, and CI's manifest assertions."""
     return registry.counter(name, _WELL_KNOWN_COUNTER_HELP[name])
+
+
+def read_host_peak_rss_bytes() -> Optional[int]:
+    """OS-reported peak RSS of this process in BYTES, or ``None`` when the
+    platform exposes neither ``getrusage`` nor ``/proc/self/status``.
+
+    ``ru_maxrss`` is kibibytes on Linux and bytes on macOS (the one
+    platform quirk this helper owns, so no caller re-derives it);
+    ``VmHWM`` is the fallback for environments whose libc stubs rusage.
+    """
+    try:
+        import resource
+
+        rss = int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+        if rss > 0:
+            return rss if sys.platform == "darwin" else rss * 1024
+    except Exception:
+        pass
+    try:
+        with open("/proc/self/status", "r", encoding="ascii") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    return int(line.split()[1]) * 1024
+    except Exception:
+        pass
+    return None
 
 
 def _check_name(name: str) -> str:
@@ -500,6 +544,9 @@ __all__ = [
     "DEVICEGEN_DISPATCHES",
     "DEVICEGEN_SITES_CAPACITY",
     "IO_PARTITIONS_TOTAL",
+    "HOST_PEAK_RSS_BYTES",
+    "HOST_STATIC_BOUND_BYTES",
+    "read_host_peak_rss_bytes",
     "well_known_gauge",
     "well_known_counter",
 ]

@@ -103,6 +103,74 @@ def ring_traffic_bytes(
     return int(rows) * int(samples_parallel) * (int(samples_parallel) - 1) * width
 
 
+#: Fixed host-RSS overhead of the process itself — interpreter, jax/jaxlib
+#: runtime, compiled executables, parser library — the constant term of
+#: :func:`host_peak_bytes`. Deliberately generous: a CPU-backend process
+#: idles around 0.3-0.6 GiB, and the TPU runtime maps a further ~2 GiB of
+#: host memory at init (measured on the v5e-8 smoke). The formula's job
+#: is to bound the DATA-DEPENDENT staging terms; an O(file) regression on
+#: any real cohort dwarfs this constant long before the constant's slack
+#: matters. Measured against reality on every build (ci.sh: manifest
+#: ``host_memory.peak_rss_bytes`` <= the static bound).
+HOST_RUNTIME_BASELINE_BYTES = 4 << 30
+
+
+def host_peak_bytes(
+    num_samples: int,
+    block_size: int,
+    data_axis: int = 1,
+    ingest_workers: int = 0,
+    chunk_bytes: int = 0,
+    prefetch_depth: int = 0,
+    pipeline_depth: int = 0,
+    host_accumulator: bool = False,
+    baseline_bytes: int = HOST_RUNTIME_BASELINE_BYTES,
+) -> int:
+    """Closed-form peak host-memory bound of one bounded-ingest run — the
+    host-RAM sibling of :func:`ring_traffic_bytes`, and the ONE formula
+    behind ``graftcheck plan --host-mem-budget``, the driver's
+    ``host_static_bound_bytes`` gauge, and the manifest's ``host_memory``
+    block (``check/hostmem.py:conf_host_peak_bytes`` resolves a parsed
+    configuration into these arguments, so no caller re-derives them).
+
+    Term by term (derivation in DESIGN.md §8.6):
+
+    - **parse window** — ``(ingest_workers + 2) * 2 * chunk_bytes``: the
+      order-preserving pool (``sources/files.py:_ordered_pool_map``) holds
+      at most ``workers + 2`` chunks in flight, each present as raw text
+      AND as its parsed arrays (has-variation bytes <= text bytes: one
+      int8 per genotype vs >= 2 text chars per GT column, plus
+      positions/ends/AF at ~20 bytes/row against ~60+ text bytes/row).
+    - **prefetch queue** — ``prefetch_depth`` parsed blocks of
+      ``block_size * num_samples`` uint8 waiting for the device feeder
+      (``pipeline/datasets.py:PrefetchIterator``).
+    - **accumulator staging** — the ``(data_axis * block_size,
+      num_samples)`` uint8 staging buffer plus one flush copy (packed
+      ``ceil(N/8)`` or the full-width counts copy — bound with the full
+      width so count-valued joins stay inside the bound).
+    - **flush in-flight** — ``pipeline_depth`` flush copies pinned on host
+      while their transfers overlap compute (``ops/gramian.py``).
+    - **host accumulator** — the ``--pca-backend host`` oracle's int64
+      N x N matrix (+ its f64 centering copy), zero on the device path.
+    - **baseline** — :data:`HOST_RUNTIME_BASELINE_BYTES`.
+    """
+    n = int(num_samples)
+    block_bytes = int(block_size) * n
+    staging = int(data_axis) * block_bytes
+    parse_window = (int(ingest_workers) + 2) * 2 * int(chunk_bytes)
+    prefetch = int(prefetch_depth) * block_bytes
+    flush_copies = (1 + int(pipeline_depth)) * staging
+    host_matrix = 2 * n * n * 8 if host_accumulator else 0
+    return int(
+        baseline_bytes
+        + parse_window
+        + prefetch
+        + staging
+        + flush_copies
+        + host_matrix
+    )
+
+
 def apply_platform_override() -> Optional[str]:
     """Honor ``SPARK_EXAMPLES_TPU_PLATFORM`` (e.g. ``cpu``) before any
     backend client exists.
@@ -330,8 +398,10 @@ __all__ = [
     "SAMPLES_AXIS",
     "PLATFORM_ENV",
     "RING_PACK_MULTIPLE",
+    "HOST_RUNTIME_BASELINE_BYTES",
     "padded_cohort",
     "ring_traffic_bytes",
+    "host_peak_bytes",
     "apply_platform_override",
     "distributed_init",
     "host_value",

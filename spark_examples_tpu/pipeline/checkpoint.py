@@ -7,6 +7,17 @@ disabled (``:332-335``) — but no writer for that format exists in the repo.
 Here both sides exist: :func:`save_variants` writes sharded gzip JSON-lines
 part files with a manifest, :func:`load_variants` streams them back as a
 dataset with the same iteration surface as ``VariantsDataset``.
+
+Both sides move data through FIXED-SIZE buffers (``graftcheck hostmem``
+audits this file): the writer coalesces encoded lines into a bounded text
+buffer between ``write()`` calls (artifact bytes are identical to the
+per-record writes — gzip's compressor state only flushes at close), and
+the reader (:meth:`CheckpointDataset.iter_part` / ``__iter__``) walks each
+part in ``_READ_CHUNK_BYTES`` decompressed windows with a partial-line
+carry, so resuming never stages a whole part — let alone the whole
+checkpoint — as one buffer. Only :meth:`CheckpointDataset.compute` still
+materializes (one shard's record list, the ``VariantsDataset`` API
+surface), and that site is a declared ``hostmem(unbounded)``.
 """
 
 from __future__ import annotations
@@ -19,6 +30,37 @@ from typing import Iterable, Iterator, List, Tuple
 from spark_examples_tpu.models.variant import Variant, VariantKey, VariantsBuilder
 
 _MANIFEST = "_manifest.json"
+
+#: Writer-side coalescing buffer: encoded lines accumulate to ~this many
+#: characters between ``write()`` calls (bounded by one record past it).
+_WRITE_BUFFER_BYTES = 1 << 20
+
+#: Reader-side window: decompressed bytes per chunk of a part-file walk.
+_READ_CHUNK_BYTES = 4 << 20
+
+
+def _iter_jsonl_lines(path: str, chunk_bytes: int = _READ_CHUNK_BYTES):
+    """Decoded JSON objects of one gzip JSON-lines file, streamed through a
+    fixed-size read window with a partial-line carry (the checkpoint-side
+    sibling of ``sources/files.py:_iter_vcf_chunks``): peak memory is
+    O(window), never O(part)."""
+    carry = b""
+    with gzip.open(path, "rb") as f:
+        while True:
+            data = f.read(max(64, int(chunk_bytes)))
+            if not data:
+                break
+            data = carry + data
+            cut = data.rfind(b"\n")
+            if cut < 0:
+                carry = data
+                continue
+            carry = data[cut + 1 :]
+            for line in data[: cut + 1].splitlines():
+                if line.strip():
+                    yield json.loads(line)
+    if carry.strip():
+        yield json.loads(carry)
 
 
 class CheckpointWriter:
@@ -41,13 +83,30 @@ class CheckpointWriter:
     def write_shard(self, records: List[Tuple[VariantKey, Variant]]) -> None:
         part_path = os.path.join(self.path, f"part-{self.parts:05d}.jsonl.gz")
         with gzip.open(part_path, "wt") as f:
+            # Fixed-size coalescing buffer: one write() per ~_WRITE_BUFFER_
+            # BYTES of encoded text instead of one per record. The artifact
+            # is byte-identical to per-record writes (gzip's compressor
+            # only emits at its own block boundaries and at close; the
+            # round-trip regression test asserts this), but the host never
+            # holds more than one buffer of encoded lines beyond the
+            # records the caller already owns.
+            buffer: List[str] = []
+            buffered = 0
             for key, variant in records:
                 entry = {
                     "key": {"contig": key.contig, "position": key.position},
                     "variant": variant.to_json(),
                 }
-                f.write(json.dumps(entry) + "\n")
+                line = json.dumps(entry) + "\n"
+                buffer.append(line)
+                buffered += len(line)
                 self.total += 1
+                if buffered >= _WRITE_BUFFER_BYTES:
+                    f.write("".join(buffer))
+                    buffer.clear()
+                    buffered = 0
+            if buffer:
+                f.write("".join(buffer))
         self.parts += 1
 
     def close(self) -> None:
@@ -93,23 +152,28 @@ class CheckpointDataset:
             if name.startswith("part-")
         ]
 
+    def iter_part(self, part_path: str) -> Iterator[Tuple[VariantKey, Variant]]:
+        """Stream one part's ``(key, variant)`` pairs through the bounded
+        read window — the resume path that never stages a whole part."""
+        for entry in _iter_jsonl_lines(part_path):
+            built = VariantsBuilder.build(entry["variant"])
+            if built is None:
+                continue
+            key = VariantKey(
+                entry["key"]["contig"], int(entry["key"]["position"])
+            )
+            yield key, built[1]
+
     def compute(self, part_path: str) -> List[Tuple[VariantKey, Variant]]:
-        records = []
-        with gzip.open(part_path, "rt") as f:
-            for line in f:
-                entry = json.loads(line)
-                built = VariantsBuilder.build(entry["variant"])
-                if built is None:
-                    continue
-                key = VariantKey(
-                    entry["key"]["contig"], int(entry["key"]["position"])
-                )
-                records.append((key, built[1]))
+        records: List[Tuple[VariantKey, Variant]] = []
+        for pair in self.iter_part(part_path):
+            # graftcheck: hostmem(unbounded) -- the VariantsDataset API surface returns ONE shard's record list (O(part), bounded by the writer's shard size); whole-checkpoint iteration streams via iter_part
+            records.append(pair)
         return records
 
     def __iter__(self) -> Iterator[Tuple[VariantKey, Variant]]:
         for part in self.partitions():
-            yield from self.compute(part)
+            yield from self.iter_part(part)
 
     def variants(self) -> Iterator[Variant]:
         for _, variant in self:

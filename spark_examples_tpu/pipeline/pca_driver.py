@@ -170,6 +170,53 @@ class VariantsPcaDriver:
         }
         self.names: Dict[str, str] = {cs["id"]: cs["name"] for cs in callsets}
         print(f"Matrix size: {len(self.indexes)}.")
+        # After callset discovery: the static bound needs the REAL cohort
+        # width (file sources carry theirs in the data, not the flag).
+        self._register_host_memory_gauges()
+
+    def _register_host_memory_gauges(self) -> None:
+        """The host-memory cross-validation pair (``graftcheck hostmem``'s
+        runtime half): a function-backed peak-RSS gauge — every read
+        (heartbeat tick, manifest snapshot) samples the OS high-water mark
+        — and, when the configured ingest path is bounded, the static
+        bound from the ONE formula ``parallel/mesh.py:host_peak_bytes``
+        (resolved by ``check/hostmem.py:conf_host_peak_bytes``, the same
+        resolver ``graftcheck plan --host-mem-budget`` enforces, so the
+        bound the manifest records and the budget the validator proves
+        cannot drift). Best-effort: telemetry must never take down a run."""
+        from spark_examples_tpu.obs.metrics import (
+            HOST_PEAK_RSS_BYTES,
+            HOST_STATIC_BOUND_BYTES,
+            read_host_peak_rss_bytes,
+            well_known_gauge,
+        )
+
+        if read_host_peak_rss_bytes() is not None:
+            well_known_gauge(self.registry, HOST_PEAK_RSS_BYTES).set_function(
+                lambda: float(read_host_peak_rss_bytes() or 0)
+            )
+        try:
+            from spark_examples_tpu.check.hostmem import conf_host_peak_bytes
+
+            # Resolved against the declared flag surface; the device count
+            # only caps the default mesh's data axis, so jax stays
+            # uninitialized here unless a mesh decision truly needs it.
+            device_count = None
+            if not getattr(self.conf, "mesh_shape", None):
+                import jax
+
+                device_count = jax.device_count()
+            bound = conf_host_peak_bytes(
+                self.conf,
+                device_count=device_count,
+                num_samples=len(self.indexes) or None,
+            )
+        except Exception:
+            bound = None
+        if bound is not None:
+            well_known_gauge(self.registry, HOST_STATIC_BOUND_BYTES).set(
+                float(bound)
+            )
 
     # ------------------------------------------------------------------ data
 
@@ -1121,6 +1168,7 @@ def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
             return similarity
 
         def shard_blocks(part):
+            # graftcheck: hostmem(unbounded) -- per-WINDOW materialization of the in-memory packed path (stats need the block list); streaming-scale inputs take stream_genotype_blocks above, which never lands here
             blocks = list(
                 source.genotype_blocks(
                     part.variant_set_id,
