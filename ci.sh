@@ -12,7 +12,13 @@
 #      (static lock-acquisition-order graph of the ingest/obs layer must
 #      be acyclic and free of sync/queue-under-lock); the DOT graph
 #      artifact is left under the stage's run dir (path echoed).
-#   2c. hostmem — graftcheck hostmem (AST host-memory audit: the tree must
+#   2c. ranges — graftcheck ranges (abstract-interpretation overflow &
+#      exactness prover over the real kernel jaxprs: bf16/f32 per-dispatch
+#      partials < 2^24, int32 accumulation < 2^31, lossy casts, contract
+#      coverage, conversion-trigger conservativeness) across the full
+#      mesh/dtype audit matrix — the Gramian dtype ladder is PROVEN on
+#      every build, not asserted.
+#   2d. hostmem — graftcheck hostmem (AST host-memory audit: the tree must
 #      be clean, every O(file) site a justified hostmem(unbounded)
 #      declaration) + the --host-mem-budget smoke on the 4-virtual-device
 #      synthetic config: a generous budget must plan OK, a 1 MiB budget
@@ -22,7 +28,11 @@
 #      1 s heartbeat; the produced run manifest must validate against the
 #      schema (obs/manifest.py:validate_manifest), carry I/O stats, and
 #      prove measured peak RSS <= the static host-memory bound (the
-#      runtime half of the hostmem contract).
+#      runtime half of the hostmem contract). A second tiny run with
+#      --ingest packed --check-ranges asserts the manifest's
+#      gramian_exactness pair: measured max |accumulator entry| <= the
+#      statically-projected bound (the runtime half of the ranges
+#      contract).
 #   4. sharded-ring smoke — a 4-virtual-device sharded run (tiny synthetic
 #      cohort) twice: packed ring (--ring-pack-bits on) vs the unpacked
 #      oracle (off). Result rows must be byte-identical and the manifests'
@@ -65,6 +75,10 @@ if [ -s "$IR_TMP/lockgraph.dot" ]; then
 else
   echo "lockgraph DOT artifact missing"; ir_rc=1
 fi
+
+echo "== ranges stage (graftcheck ranges) =="
+rg_rc=0
+env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck ranges || rg_rc=$?
 
 echo "== hostmem stage (graftcheck hostmem + host-memory budget) =="
 hm_rc=0
@@ -118,6 +132,39 @@ print(f"manifest OK ({len(doc['metrics'])} metrics, "
 PYEOF
 else
   echo "obs smoke run failed (rc=$obs_rc):"; tail -20 "$OBS_TMP/stderr.log"
+fi
+if [ "$obs_rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu SPARK_EXAMPLES_TPU_NO_CACHE=1 \
+    python -m spark_examples_tpu variants-pca \
+      --num-samples 8 --references 1:0:50000 \
+      --ingest packed --check-ranges \
+      --metrics-json "$OBS_TMP/ranges.json" \
+      > /dev/null 2> "$OBS_TMP/ranges.err" || obs_rc=$?
+  if [ "$obs_rc" -eq 0 ]; then
+    env JAX_PLATFORMS=cpu python - "$OBS_TMP/ranges.json" <<'PYEOF' || obs_rc=$?
+import sys
+from spark_examples_tpu.obs.manifest import read_manifest, validate_manifest
+doc = read_manifest(sys.argv[1])
+errors = validate_manifest(doc)
+if errors:
+    print("check-ranges manifest INVALID:\n  " + "\n  ".join(errors))
+    sys.exit(1)
+ge = doc.get("gramian_exactness")
+if not ge or ge.get("entry_max") is None or not ge.get("static_entry_bound"):
+    print(f"--check-ranges run carries no gramian_exactness pair: {ge}")
+    sys.exit(1)
+if ge["entry_max"] > ge["static_entry_bound"]:
+    print("measured accumulator entry EXCEEDS the static bound: "
+          f"{ge['entry_max']} > {ge['static_entry_bound']} "
+          "(the GR005-proven projection no longer describes reality)")
+    sys.exit(1)
+print(f"check-ranges smoke OK (entry max {ge['entry_max']} <= "
+      f"projected bound {ge['static_entry_bound']})")
+PYEOF
+  else
+    echo "check-ranges smoke run failed (rc=$obs_rc):"
+    tail -20 "$OBS_TMP/ranges.err"
+  fi
 fi
 rm -rf "$OBS_TMP"
 
@@ -178,6 +225,7 @@ fi
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 if [ "$lint_rc" -ne 0 ]; then exit "$lint_rc"; fi
 if [ "$ir_rc" -ne 0 ]; then exit "$ir_rc"; fi
+if [ "$rg_rc" -ne 0 ]; then exit "$rg_rc"; fi
 if [ "$hm_rc" -ne 0 ]; then exit "$hm_rc"; fi
 if [ "$obs_rc" -ne 0 ]; then exit "$obs_rc"; fi
 if [ "$ring_rc" -ne 0 ]; then exit "$ring_rc"; fi

@@ -7,6 +7,8 @@ on them:
     graftcheck lint [PATH...] [--json]        0 clean / 1 findings
     graftcheck ir [--json] [--mesh D,S ...] [--num-samples N]
                   [--block-size B]           0 clean / 1 findings
+    graftcheck ranges [--json] [--mesh D,S ...] [--num-samples N]
+                  [--block-size B]           0 proven / 1 findings
     graftcheck lockgraph [PATH...] [--json] [--dot FILE]
                                               0 acyclic+clean / 1 findings
     graftcheck hostmem [PATH...] [--json]     0 clean (declared sites
@@ -65,10 +67,12 @@ def _cmd_lint(argv: Sequence[str]) -> int:
     return 1 if findings else 0
 
 
-def _cmd_ir(argv: Sequence[str]) -> int:
-    from spark_examples_tpu.check.ir import default_specs, run_audit
-
-    parser = argparse.ArgumentParser(prog="graftcheck ir")
+def _parse_audit_args(prog: str, argv: Sequence[str]):
+    """The shared ``--json/--mesh/--num-samples/--block-size`` surface of
+    the kernel-audit subcommands (``ir`` and ``ranges``) — ONE parser and
+    ONE mesh-pair validation, so the two cannot drift. Returns
+    ``(ns, meshes)`` or ``None`` after printing the mesh grammar error."""
+    parser = argparse.ArgumentParser(prog=prog)
     parser.add_argument(
         "--json", action="store_true", help="Emit the machine-readable report."
     )
@@ -105,14 +109,41 @@ def _cmd_ir(argv: Sequence[str]) -> int:
                 raise ValueError(meshes)
         except ValueError:
             print(
-                f"graftcheck ir: --mesh expects positive 'data,samples' "
+                f"{prog}: --mesh expects positive 'data,samples' "
                 f"pairs, got {ns.mesh}",
                 file=sys.stderr,
             )
-            return 2
+            return None
+    return ns, meshes
+
+
+def _cmd_ir(argv: Sequence[str]) -> int:
+    from spark_examples_tpu.check.ir import default_specs, run_audit
+
+    parsed = _parse_audit_args("graftcheck ir", argv)
+    if parsed is None:
+        return 2
+    ns, meshes = parsed
     specs = default_specs(
         num_samples=ns.num_samples,
         ragged_samples=ns.num_samples + 36,
+        block_size=ns.block_size,
+        **({"meshes": meshes} if meshes is not None else {}),
+    )
+    report = run_audit(specs)
+    print(report.to_json() if ns.json else report.format())
+    return 0 if report.ok else 1
+
+
+def _cmd_ranges(argv: Sequence[str]) -> int:
+    from spark_examples_tpu.check.ranges import default_specs, run_audit
+
+    parsed = _parse_audit_args("graftcheck ranges", argv)
+    if parsed is None:
+        return 2
+    ns, meshes = parsed
+    specs = default_specs(
+        num_samples=ns.num_samples,
         block_size=ns.block_size,
         **({"meshes": meshes} if meshes is not None else {}),
     )
@@ -250,6 +281,7 @@ def _cmd_typecheck(argv: Sequence[str]) -> int:
 _SUBCOMMANDS = {
     "lint": _cmd_lint,
     "ir": _cmd_ir,
+    "ranges": _cmd_ranges,
     "lockgraph": _cmd_lockgraph,
     "hostmem": _cmd_hostmem,
     "plan": _cmd_plan,

@@ -659,22 +659,34 @@ def _audit_dtypes(spec: KernelSpec, jaxpr: Any, audit: KernelAudit) -> None:
         _emit(audit, "GI003", message)
 
 
-def audit_kernel(spec: KernelSpec) -> KernelAudit:
-    """Trace one kernel spec and run every IR audit over its jaxpr."""
+def trace_kernel(spec: KernelSpec) -> Any:
+    """Trace one kernel spec to its ClosedJaxpr — shared by the IR and
+    range audit layers so one geometry pays ONE trace (the plan validator
+    hands the same trace to both)."""
     import jax
 
+    with jax.enable_x64(True):
+        fn, args = spec.build()
+        return jax.make_jaxpr(fn)(*args)
+
+
+def audit_kernel(spec: KernelSpec, traced: Optional[Any] = None) -> KernelAudit:
+    """Trace one kernel spec (or reuse a caller-supplied ``traced``
+    ClosedJaxpr from :func:`trace_kernel`) and run every IR audit over its
+    jaxpr."""
     audit = KernelAudit(spec.name)
-    try:
-        with jax.enable_x64(True):
-            fn, args = spec.build()
-            closed = jax.make_jaxpr(fn)(*args)
-    except Exception as e:  # noqa: BLE001 — any trace failure is the finding
-        _emit(
-            audit,
-            "GI000",
-            f"kernel failed to trace: {type(e).__name__}: {e}",
-        )
-        return audit
+    if traced is not None:
+        closed = traced
+    else:
+        try:
+            closed = trace_kernel(spec)
+        except Exception as e:  # noqa: BLE001 — any trace failure is the finding
+            _emit(
+                audit,
+                "GI000",
+                f"kernel failed to trace: {type(e).__name__}: {e}",
+            )
+            return audit
     jaxpr = closed.jaxpr
     # Output signature facts: callers (the plan validator) derive their
     # shape checks from THIS trace instead of paying a second one.
@@ -696,7 +708,10 @@ def audit_kernel(spec: KernelSpec) -> KernelAudit:
                 break
     audit.facts["peak_live_bytes"] = peak_live_bytes(scope_jaxpr)
     audit.facts["liveness_scope"] = spec.liveness_scope
-    del closed  # free trace-time consts before the zero-arrays contract check
+    if traced is None:
+        # free trace-time consts before the zero-arrays contract check
+        # (a caller-supplied trace is the caller's to free)
+        del closed
     return audit
 
 
@@ -1034,4 +1049,5 @@ __all__ = [
     "peak_live_bytes",
     "ring_kernel_spec",
     "run_audit",
+    "trace_kernel",
 ]

@@ -57,6 +57,26 @@ _SHARD_MAP_NAMES = (
     "spark_examples_tpu.utils.compat.shard_map",
 )
 
+#: GC011: cast targets narrow enough that the Gramian dtype ladder's
+#: integer-exactness can silently break (anything with an exact-integer
+#: window below f64's). A cast to one of these in ops/ must carry a
+#: `# range:` comment (on the line, or within _RANGE_COMMENT_WINDOW lines
+#: above — the `# lock order:` layout) stating why the operand range fits,
+#: ideally naming its ops/contracts.py contract.
+_NARROW_CAST_TARGETS = frozenset(
+    {"int8", "uint8", "int16", "uint16", "int32", "uint32",
+     "float16", "bfloat16", "float32"}
+)
+
+#: How far above a narrowing cast the `# range:` justification may sit —
+#: wider than the lock-order window because the cast often sits mid-way
+#: down a multi-line chained expression whose node anchors a few lines in.
+_RANGE_COMMENT_WINDOW = 6
+
+#: Canonical dotted names of the explicit cast function (GC011's second
+#: spelling besides the .astype method).
+_CONVERT_FNS = ("jax.lax.convert_element_type", "lax.convert_element_type")
+
 #: numpy calls that are trace-time constants, not host compute: dtype
 #: constructors used as astype/array arguments. These run on Python
 #: scalars/metadata, never on traced values, and are pervasive legitimate
@@ -228,6 +248,13 @@ class _LintVisitor(ast.NodeVisitor):
         lo = max(0, lineno - 1 - _LOCK_COMMENT_WINDOW)
         window = self.lines[lo:lineno]
         return any("lock order:" in line for line in window)
+
+    def _has_range_comment(self, lineno: int) -> bool:
+        lo = max(0, lineno - 1 - _RANGE_COMMENT_WINDOW)
+        window = self.lines[lo:lineno]
+        return any(
+            "range:" in line or "ops/contracts" in line for line in window
+        )
 
     # ------------------------------------------------------------ functions
 
@@ -494,6 +521,9 @@ class _LintVisitor(ast.NodeVisitor):
                 "program; use the jnp equivalent",
             )
 
+        # GC011: narrowing cast without a range justification.
+        self._check_narrowing_cast(node, name)
+
         # GC001: implicit device→host sync in hot paths.
         self._check_host_sink(node, name)
 
@@ -513,6 +543,51 @@ class _LintVisitor(ast.NodeVisitor):
             )
 
         self.generic_visit(node)
+
+    def _check_narrowing_cast(
+        self, node: ast.Call, name: Optional[str]
+    ) -> None:
+        """GC011: ``.astype(<narrow dtype>)`` / ``lax.convert_element_type``
+        in ops/ must carry a ``# range:`` justification (or an
+        ``ops/contracts`` reference) within the comment window — the
+        operand-range claim behind a narrowing cast belongs next to the
+        cast, where ``graftcheck ranges`` (check/ranges.py) can hold the
+        prose against the proven interval. Dynamic targets (a dtype held in
+        a variable, e.g. ``operand_dtype``) are skipped: their range story
+        lives at the variable's producer."""
+        target = None
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"
+            and len(node.args) == 1
+            and not node.keywords
+        ):
+            target = node.args[0]
+        elif name in _CONVERT_FNS:
+            if len(node.args) >= 2:
+                target = node.args[1]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "new_dtype":
+                        target = kw.value
+        if target is None:
+            return
+        dotted = _dotted(target, self.alias)
+        if dotted is None:
+            return  # dtype variable / np.dtype(...) call — producer's story
+        leaf = dotted.rsplit(".", 1)[-1]
+        if leaf not in _NARROW_CAST_TARGETS:
+            return
+        if self._has_range_comment(node.lineno):
+            return
+        self.emit(
+            "GC011",
+            node,
+            f"narrowing cast to {leaf} without a range justification; add "
+            "a `# range: ...` comment (or reference the operand's "
+            "ops/contracts.py contract) stating why every value fits the "
+            "destination's exact window",
+        )
 
     def _check_host_sink(self, node: ast.Call, name: Optional[str]) -> None:
         if name not in _HOST_SINKS or len(node.args) != 1:

@@ -252,7 +252,7 @@ _SHARDED_BUFFERS = 3
 
 def _eval_sharded_update(
     report: PlanReport, data: int, samples: int, conf: PcaConf
-) -> None:
+):
     """Trace the sharded ring update through shard_map over an
     ``AbstractMesh`` — the same `_ring_tiles` body the run executes, with
     the same PartitionSpecs ``ShardedGramianAccumulator`` installs and the
@@ -350,29 +350,35 @@ def _eval_sharded_update(
     accum = jnp.int32 if conf.exact_similarity else jnp.float32
     x_width = padded // RING_PACK_MULTIPLE if pack else padded
 
-    # ONE trace serves both layers: the IR auditor (check/ir.py) runs the
-    # runtime's own build_sharded_update through make_jaxpr over an
-    # AbstractMesh, proving the overlap/donation/dtype/traffic contracts
-    # AND yielding the output signature the shape check needs — no second
-    # eval_shape. The jaxpr-derived ring traffic and static
-    # peak-live-bytes land in the plan report so a whole-genome run can be
-    # sized before a single device is touched; any IR finding is a plan
-    # rejection — the configured kernel would ship without its contracts.
-    from spark_examples_tpu.check.ir import audit_kernel, ring_kernel_spec
-
-    audit = audit_kernel(
-        ring_kernel_spec(
-            data, samples, N, B, pack, exact_int=conf.exact_similarity
-        )
+    # ONE trace serves every layer: the runtime's own build_sharded_update
+    # is traced through make_jaxpr over an AbstractMesh exactly once, and
+    # the same ClosedJaxpr feeds the IR auditor (overlap/donation/dtype/
+    # traffic contracts + the output signature the shape check needs — no
+    # second eval_shape) AND, returned from here, the range prover
+    # (_check_exactness) — no second make_jaxpr either. The jaxpr-derived
+    # ring traffic and static peak-live-bytes land in the plan report so a
+    # whole-genome run can be sized before a single device is touched; any
+    # IR finding is a plan rejection — the configured kernel would ship
+    # without its contracts.
+    from spark_examples_tpu.check.ir import (
+        audit_kernel,
+        ring_kernel_spec,
+        trace_kernel,
     )
-    trace_failures = [f for f in audit.findings if f.rule_id == "GI000"]
-    if trace_failures:
+
+    ir_spec = ring_kernel_spec(
+        data, samples, N, B, pack, exact_int=conf.exact_similarity
+    )
+    try:
+        ring_trace = trace_kernel(ir_spec)
+    except Exception as e:  # noqa: BLE001 — the trace failure is the finding
         report.error(
             "sharded-update-trace",
             f"sharded ring update fails to trace on a {data}x{samples} "
-            f"abstract mesh: {trace_failures[0].detail}",
+            f"abstract mesh: {type(e).__name__}: {e}",
         )
-        return
+        return None
+    audit = audit_kernel(ir_spec, traced=ring_trace)
     g_shape = (data, padded, padded)
     out_shape = tuple(audit.facts["out_shapes"][0])
     out_dtype = audit.facts["out_dtypes"][0]
@@ -408,6 +414,137 @@ def _eval_sharded_update(
             f"{audit.facts.get('permute_executions', 0)} independent "
             "ppermute(s), donation contract justified, jaxpr ring bytes "
             "== ring_traffic_bytes"
+        )
+    return ring_trace
+
+
+def _check_exactness(
+    report: PlanReport,
+    data: int,
+    samples: int,
+    conf: PcaConf,
+    ring_trace=None,
+) -> None:
+    """Range/exactness proof of the CONFIGURED kernels (the ``graftcheck
+    ranges`` abstract interpreter over exactly the geometry the run would
+    build) plus geometry-level exactness facts: ``gramian_entry_bound``
+    (the declared static site count × max_count² when the synthetic grid
+    makes the site count statically known) and ``exactness_headroom_sites``
+    (the largest cohort/site count provable exact on each dtype-ladder
+    rung). A geometry whose accumulation could leave the terminal int32
+    exact window — or whose per-dispatch partial leaves the f32 window
+    before the conversion point (GR002) — is rejected (exit 2): this
+    replaces the hand-reasoned per-dispatch exactness prose of DESIGN.md
+    §5 with a machine proof per configuration."""
+    import numpy as np
+
+    from spark_examples_tpu.check.ranges import (
+        audit_range_kernel,
+        counts_range_spec,
+        dense_range_spec,
+        ring_range_spec,
+    )
+    from spark_examples_tpu.ops.contracts import (
+        exact_int_window,
+        exactness_headroom_sites,
+        flush_entry_increment,
+    )
+    from spark_examples_tpu.ops.gramian import resolve_ring_pack
+
+    N, B = int(conf.num_samples), int(conf.block_size)
+    exact = bool(getattr(conf, "exact_similarity", False))
+    pack = resolve_ring_pack(getattr(conf, "ring_pack_bits", "auto"))
+    ids = list(conf.variant_set_id)
+    max_count = max((ids.count(i) for i in set(ids)), default=1)
+
+    audits = []
+    sharded = conf.similarity_strategy == "sharded"
+    if not sharded:
+        audits.append(audit_range_kernel(dense_range_spec(data, N, B)))
+        if max_count > 1:
+            # Duplicate set ids take the count-valued (same-set-join) kernel.
+            audits.append(audit_range_kernel(counts_range_spec(data, N, B)))
+    if samples >= 2:
+        # `ring_trace` is _eval_sharded_update's ClosedJaxpr of this exact
+        # geometry (same ir builder, same conf-derived args) — one trace
+        # serves the shape check, the IR audit, AND this range proof.
+        audits.append(
+            audit_range_kernel(
+                ring_range_spec(data, samples, N, B, pack, exact_int=exact),
+                traced=ring_trace,
+            )
+        )
+        if max_count > 1:
+            # Count-valued flushes (duplicate set ids) ride the UNPACKED
+            # ring kernel per flush regardless of --ring-pack-bits; prove
+            # that path under the count contract too — packed-[0,1]
+            # operands do not cover it.
+            audits.append(
+                audit_range_kernel(
+                    ring_range_spec(
+                        data, samples, N, B, False, exact_int=exact,
+                        counts=True,
+                    )
+                )
+            )
+    partial = 0.0
+    for audit in audits:
+        for finding in audit.findings:
+            report.error(f"ranges-{finding.rule_id}", finding.detail)
+        partial = max(partial, float(audit.facts.get("dot_partial_bound", 0)))
+    if all(a.ok for a in audits) and audits:
+        increments = [
+            a.facts.get("entry_increment") for a in audits
+        ]
+        report.shape_checks.append(
+            f"range audit ({len(audits)} kernel(s)): per-dispatch partial "
+            f"<= {partial:g} exact, entry increment <= "
+            f"{max(float(i) for i in increments if i is not None):g}/flush, "
+            "conversion trigger proven conservative (GR005)"
+        )
+    report.geometry["exactness_headroom_sites"] = {
+        "float32": exactness_headroom_sites(np.float32, max_count),
+        "int32": exactness_headroom_sites(np.int32, max_count),
+    }
+
+    # Static site-count bound: the synthetic grid has one candidate site
+    # per DEFAULT_VARIANT_SPACING bases, so explicit --references windows
+    # bound the total variant rows statically (variant sets share the site
+    # grid — DESIGN.md §6; file/REST cohorts carry their counts in the
+    # data, so no static bound exists for them).
+    static_rows = None
+    if (
+        getattr(conf, "source", "synthetic") == "synthetic"
+        and not conf.all_references
+        and not conf.input_path
+    ):
+        try:
+            from spark_examples_tpu.sources.synthetic import (
+                DEFAULT_VARIANT_SPACING,
+            )
+
+            static_rows = sum(
+                (contig.end - contig.start) // DEFAULT_VARIANT_SPACING + 1
+                for contigs in conf.get_references()
+                for contig in contigs
+            )
+        except (ValueError, TypeError):
+            static_rows = None
+    if static_rows is None:
+        report.geometry["gramian_entry_bound"] = None
+        return
+    entry_bound = flush_entry_increment(static_rows, max_count)
+    report.geometry["gramian_entry_bound"] = entry_bound
+    int32_window = exact_int_window(np.int32) or 0
+    if entry_bound > int32_window:
+        report.error(
+            "exactness-window",
+            f"the declared geometry bounds a Gramian entry at "
+            f"{entry_bound} ({static_rows} candidate sites x max_count "
+            f"{max_count}²), past int32's exact-integer window "
+            f"({int32_window}) — no dtype-ladder rung can hold the count "
+            "exactly; shrink --references or split the cohort "
+            "(graftcheck ranges GR001)",
         )
 
 
@@ -610,8 +747,14 @@ def validate_plan(
     if conf.pca_backend == "tpu":
         if report.ok:
             _eval_dense_update(report, data, conf)
+        ring_trace = None
         if report.ok and (sharded or samples >= 2):
-            _eval_sharded_update(report, data, samples, conf)
+            ring_trace = _eval_sharded_update(report, data, samples, conf)
+        # ------------------------------------ range/exactness proofs (GRnnn)
+        if report.ok:
+            _check_exactness(
+                report, data, samples, conf, ring_trace=ring_trace
+            )
 
     # --------------------------------------------------- memory feasibility
     from spark_examples_tpu.ops.gramian import (

@@ -143,6 +143,18 @@ RULES: Dict[str, Rule] = {
             scope=TELEMETRY_GLOBS,
         ),
         Rule(
+            "GC011",
+            "unjustified-narrowing-cast",
+            "A narrowing astype/convert_element_type (int8/uint8/int16/"
+            "uint16/int32/uint32/float16/bfloat16/float32 target) in ops/ "
+            "without a range-justifying `# range:` comment or contract "
+            "reference — the Gramian dtype ladder's exactness rests on "
+            "every narrowing cast's operand range being an explicit, "
+            "checkable claim (ops/contracts.py), not an unstated "
+            "assumption graftcheck ranges cannot see.",
+            scope=("ops/*",),
+        ),
+        Rule(
             "GC010",
             "host-numpy-under-jit",
             "A host `np.*` call inside a jit/shard_map-decorated kernel "
@@ -218,6 +230,70 @@ IR_RULES: Dict[str, Rule] = {
             "ppermutes; an extra permute (the old return-to-owner step) "
             "wastes one full tile circulation per block, a missing one "
             "drops a device's columns.",
+        ),
+    ]
+}
+
+
+#: ``graftcheck ranges`` rule catalogue (``check/ranges.py``): an abstract
+#: interpreter over the TRACED kernel jaxprs with an interval × exact-in-
+#: dtype lattice, seeded from the declared input contracts
+#: (``ops/contracts.py``) — the machine proof of the Gramian dtype ladder's
+#: exactness chain (bf16×bf16→f32 partials exact < 2^24, int8×int8→int32
+#: exact < 2^31, lossless conversion point). GR findings anchor to kernel
+#: audit names (line 0), like the GI rules.
+RANGES_RULES: Dict[str, Rule] = {
+    rule.id: rule
+    for rule in [
+        Rule(
+            "GR000",
+            "kernel-range-trace-failure",
+            "The kernel fails to trace to a jaxpr under the audit "
+            "geometry; none of its range/exactness contracts can be "
+            "vouched for.",
+        ),
+        Rule(
+            "GR001",
+            "int32-accumulator-overflow",
+            "The int32 accumulator can overflow for the declared max "
+            "geometry: declared rows x max_count² exceeds int32's 2^31-1 "
+            "window, and the ladder has no wider in-accumulator rung — "
+            "shrink the geometry contract or split the accumulation.",
+        ),
+        Rule(
+            "GR002",
+            "f32-partial-past-exact-window",
+            "A per-dispatch f32 partial (a dot_general's output interval, "
+            "derived from the declared input contracts) can exceed the "
+            "2^24 exact-integer window BEFORE the accumulator conversion "
+            "point ever sees it — the bf16/f32 path's exactness claim is "
+            "false for this geometry.",
+        ),
+        Rule(
+            "GR003",
+            "lossy-narrowing-cast",
+            "A convert_element_type whose inferred operand range is wider "
+            "than the destination dtype's exact-integer window: integer "
+            "values would round or wrap, silently corrupting the count "
+            "semantics the dtype ladder promises to preserve.",
+        ),
+        Rule(
+            "GR004",
+            "uncontracted-dot-input",
+            "A kernel input with no declared range contract "
+            "(ops/contracts.py) reaches a dot_general: the prover has no "
+            "interval to propagate, so no exactness claim about this "
+            "kernel's partials or accumulator can be made at all.",
+        ),
+        Rule(
+            "GR005",
+            "conversion-trigger-not-conservative",
+            "The runtime conversion trigger's projected per-flush "
+            "increment (ops/contracts.py:flush_entry_increment, fed to "
+            "_maybe_switch_accumulator) is SMALLER than the per-dispatch "
+            "entry increment proven from the traced jaxpr — the f32→int32 "
+            "conversion could fire after an entry already left the exact "
+            "window.",
         ),
     ]
 }
@@ -337,6 +413,7 @@ LOCK_RULES: Dict[str, Rule] = {
 ALL_RULES: Dict[str, Rule] = {
     **RULES,
     **IR_RULES,
+    **RANGES_RULES,
     **LOCK_RULES,
     **HOSTMEM_RULES,
 }
@@ -431,6 +508,7 @@ __all__ = [
     "Finding",
     "RULES",
     "IR_RULES",
+    "RANGES_RULES",
     "LOCK_RULES",
     "HOSTMEM_RULES",
     "ALL_RULES",

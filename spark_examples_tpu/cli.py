@@ -19,15 +19,20 @@ output):
 Static analysis (``check/``; README "graftcheck"): ``graftcheck lint``
 (AST JAX-pitfall linter), ``graftcheck ir`` (jaxpr-level audit of the real
 Gramian kernels: ring overlap, donation contract, packed-wire dtype flow,
-traffic/liveness facts), ``graftcheck lockgraph`` (static
-lock-acquisition-order graph of the threaded ingest layer, DOT artifact),
-``graftcheck hostmem`` (host-memory bound audit of the staging layers:
-O(file) paths must carry justified ``hostmem(unbounded)`` declarations),
-``graftcheck plan`` (device-free flag/geometry/kernel-shape validation;
-``--host-mem-budget`` enforces the static host-RAM bound),
-``graftcheck sanitize`` / ``graftcheck typecheck``:
+traffic/liveness facts), ``graftcheck ranges`` (abstract-interpretation
+overflow & exactness prover over the same traced kernels: bf16/f32
+partials < 2^24, int32 accumulation < 2^31, lossy casts, declared input
+contracts from ``ops/contracts.py``, conversion-trigger conservativeness),
+``graftcheck lockgraph`` (static lock-acquisition-order graph of the
+threaded ingest layer, DOT artifact), ``graftcheck hostmem`` (host-memory
+bound audit of the staging layers: O(file) paths must carry justified
+``hostmem(unbounded)`` declarations), ``graftcheck plan`` (device-free
+flag/geometry/kernel-shape validation; ``--host-mem-budget`` enforces the
+static host-RAM bound, and exactness-window facts/rejections come from the
+ranges prover), ``graftcheck sanitize`` / ``graftcheck typecheck``:
 
     python -m spark_examples_tpu graftcheck ir --json
+    python -m spark_examples_tpu graftcheck ranges --json
     python -m spark_examples_tpu graftcheck hostmem --json
     python -m spark_examples_tpu graftcheck lockgraph --dot lockorder.dot
 

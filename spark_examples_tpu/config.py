@@ -389,6 +389,20 @@ def build_pca_parser(
         ),
     )
     parser.add_argument(
+        "--check-ranges",
+        action="store_true",
+        help=(
+            "DEBUG: sample the max |accumulator entry| after every Gramian "
+            "flush (one device fetch per flush — slow by design) into the "
+            "gramian_entry_max gauge, next to the statically-projected "
+            "gramian_static_entry_bound; the run manifest records the pair "
+            "and CI asserts measured <= proven — the runtime half of the "
+            "`graftcheck ranges` exactness contract. Host-fed accumulators "
+            "only (packed/wire ingest); the fused device-generation path "
+            "has no host flush to instrument."
+        ),
+    )
+    parser.add_argument(
         "--exact-similarity",
         action="store_true",
         help=(
@@ -454,6 +468,7 @@ class PcaConf(GenomicsConf):
     ingest: str = "auto"
     blocks_per_dispatch: Optional[int] = None
     ring_pack_bits: str = "auto"
+    check_ranges: bool = False
     exact_similarity: bool = False
     similarity_strategy: str = "auto"
     num_workers: int = 8

@@ -17,7 +17,12 @@ Version history: v2 added the required ``host_memory`` block —
 ``peak_rss_bytes`` (measured OS high-water mark) next to
 ``static_bound_bytes`` (``parallel/mesh.py:host_peak_bytes``, null when
 the configured ingest path is O(file)), the pair ``graftcheck hostmem``
-cross-validates and ``bench.py`` reports as host-memory headroom.
+cross-validates and ``bench.py`` reports as host-memory headroom. Still
+v2 (additive): the optional ``gramian_exactness`` block — ``entry_max``
+(measured max |accumulator entry|, ``--check-ranges`` debug sampling)
+next to ``static_entry_bound`` (the conversion trigger's own projection,
+proven conservative by ``graftcheck ranges`` GR005); null on runs without
+the sampling, so existing consumers are untouched.
 
 Multi-host: under ``jax.distributed`` each process carries per-process
 I/O counters. :func:`build_run_manifest` aggregates them across processes
@@ -99,6 +104,30 @@ def _host_memory_block(registry=None) -> Dict:
     }
 
 
+def _gramian_exactness_block(registry) -> Optional[Dict]:
+    """The v2-ADDITIVE ``gramian_exactness`` block (``--check-ranges``):
+    measured max |accumulator entry| next to the statically-projected bound
+    the conversion trigger maintains — present only when the debug sampling
+    ran (the gauges exist), so manifests of normal runs are unchanged."""
+    from spark_examples_tpu.obs.metrics import (
+        GRAMIAN_ENTRY_MAX,
+        GRAMIAN_STATIC_ENTRY_BOUND,
+    )
+
+    if registry is None:
+        return None
+    entry_max = registry.value(GRAMIAN_ENTRY_MAX)
+    if entry_max is None or entry_max != entry_max:
+        return None
+    bound = registry.value(GRAMIAN_STATIC_ENTRY_BOUND)
+    return {
+        "entry_max": int(entry_max),
+        "static_entry_bound": (
+            int(bound) if bound is not None and bound == bound else None
+        ),
+    }
+
+
 def _process_block() -> Dict:
     try:
         import jax
@@ -116,11 +145,14 @@ def build_manifest(
     overlap: Optional[Dict] = None,
     multihost: Optional[Dict] = None,
     host_memory: Optional[Dict] = None,
+    gramian_exactness: Optional[Dict] = None,
 ) -> Dict:
     """Assemble a manifest from already-snapshotted parts (the low-level
     form; :func:`build_run_manifest` snapshots a live driver). The
     ``host_memory`` block defaults to a fresh OS sample with no static
-    bound, so hand-assembled manifests stay schema-valid."""
+    bound, so hand-assembled manifests stay schema-valid;
+    ``gramian_exactness`` (v2-additive) stays null unless ``--check-ranges``
+    sampling ran."""
     return {
         "schema": {"id": MANIFEST_ID, "version": MANIFEST_VERSION},
         "created_unix": time.time(),
@@ -132,6 +164,7 @@ def build_manifest(
         "host_memory": (
             host_memory if host_memory is not None else _host_memory_block()
         ),
+        "gramian_exactness": gramian_exactness,
         "compile_cache": _compile_cache_block(),
         "process": _process_block(),
         "multihost": multihost,
@@ -171,6 +204,7 @@ def build_run_manifest(conf=None, spans=None, registry=None, io_stats=None,
         overlap=overlap,
         multihost=multihost_block,
         host_memory=_host_memory_block(registry),
+        gramian_exactness=_gramian_exactness_block(registry),
     )
 
 
@@ -256,6 +290,25 @@ def validate_manifest(doc) -> List[str]:
     overlap = doc.get("overlap")
     if overlap is not None and not isinstance(overlap, Mapping):
         errors.append("'overlap' is neither null nor an object")
+
+    exactness = doc.get("gramian_exactness")
+    if exactness is not None:
+        if not isinstance(exactness, Mapping):
+            errors.append("'gramian_exactness' is neither null nor an object")
+        else:
+            for field in ("entry_max", "static_entry_bound"):
+                value = exactness.get(field, "absent")
+                if value == "absent":
+                    errors.append(f"gramian_exactness.{field} missing")
+                elif value is not None and (
+                    not isinstance(value, int)
+                    or isinstance(value, bool)
+                    or value < 0
+                ):
+                    errors.append(
+                        f"gramian_exactness.{field} is neither null nor a "
+                        f"non-negative int: {value!r}"
+                    )
 
     host_memory = doc.get("host_memory")
     if not isinstance(host_memory, Mapping):

@@ -59,6 +59,15 @@ DEVICEGEN_SITES_CAPACITY = "devicegen_sites_capacity"
 GRAMIAN_RING_BYTES = "gramian_ring_bytes"
 GRAMIAN_RING_FLUSH_SECONDS = "gramian_ring_flush_seconds"
 
+#: Gramian exactness cross-validation pair (``graftcheck ranges``'s runtime
+#: half, ``--check-ranges``): the measured max |accumulator entry| sampled
+#: per flush next to the statically-projected bound the conversion trigger
+#: maintains (``ops/contracts.py:flush_entry_increment`` accumulated over
+#: flushes). The run manifest records both; the obs smoke asserts
+#: measured <= proven — mirroring the hostmem RSS/bound pair.
+GRAMIAN_ENTRY_MAX = "gramian_entry_max"
+GRAMIAN_STATIC_ENTRY_BOUND = "gramian_static_entry_bound"
+
 #: Registry-backed stats counter the heartbeat's per-shard progress reads
 #: (registered by ``pipeline/stats.py:_STAT_METRICS``, spelled once here).
 IO_PARTITIONS_TOTAL = "io_partitions_total"
@@ -96,6 +105,17 @@ _WELL_KNOWN_GAUGE_HELP = {
         "Site-grid capacity of every dispatch issued (padding included, "
         "summed over data slices) — the denominator of the dispatch "
         "padding-waste fraction against ingest_sites_scanned."
+    ),
+    GRAMIAN_ENTRY_MAX: (
+        "Measured max |Gramian accumulator entry| across flushes "
+        "(--check-ranges debug sampling; must stay <= "
+        "gramian_static_entry_bound)."
+    ),
+    GRAMIAN_STATIC_ENTRY_BOUND: (
+        "Statically-projected per-entry accumulator bound "
+        "(ops/contracts.py:flush_entry_increment accumulated over flushes "
+        "— the conversion trigger's own projection, proven conservative "
+        "by graftcheck ranges GR005)."
     ),
     HOST_PEAK_RSS_BYTES: (
         "Peak resident set size of this process so far (OS-reported "
@@ -541,6 +561,8 @@ __all__ = [
     "GRAMIAN_INFLIGHT_DISPATCHES",
     "GRAMIAN_RING_BYTES",
     "GRAMIAN_RING_FLUSH_SECONDS",
+    "GRAMIAN_ENTRY_MAX",
+    "GRAMIAN_STATIC_ENTRY_BOUND",
     "DEVICEGEN_DISPATCHES",
     "DEVICEGEN_SITES_CAPACITY",
     "IO_PARTITIONS_TOTAL",

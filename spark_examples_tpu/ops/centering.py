@@ -80,6 +80,10 @@ def gower_center_sharded(
         out = S_local - row_mean - col_mean + total_mean
         row_mask = (row_start + jnp.arange(n_local)) < n
         col_mask = jnp.arange(S_local.shape[1]) < n
+        # range: centered values are real-valued (means subtracted) — the
+        # downstream subspace eigensolve runs in f32 by design; integer
+        # exactness intentionally ends at the centering boundary (the
+        # accumulator ladder, ops/contracts.py, stops at the raw Gramian).
         return jnp.where(
             row_mask[:, None] & col_mask[None, :], out, 0.0
         ).astype(jnp.float32)

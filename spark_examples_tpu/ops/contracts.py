@@ -1,0 +1,187 @@
+"""Numeric range & exactness contracts of the Gramian dtype ladder.
+
+The measured-perf story rests on an exactness chain that no single module
+could previously see whole: genotype operands in {0,1,2} make bf16×bf16→f32
+partials exact below 2^24 per entry, int8×int8→int32 accumulation is exact
+below 2^31, and the accumulators' lossless f32→int32 conversion
+(``ops/gramian.py:_maybe_switch_accumulator``) must fire before any entry
+could leave the f32 exact-integer window (DESIGN.md §5, §8.7). This module
+is the ONE home of the numbers that chain is built from:
+
+- **input contracts** — the declared value ranges of every operand class
+  the kernels consume (genotypes, has-variation bits, count-valued join
+  rows, allele frequencies, packed wire bytes). The static prover
+  (``check/ranges.py``) seeds its abstract interpretation from these, and
+  ``graftcheck plan`` derives its geometry-level exactness facts from the
+  same objects — declared once, consumed by both;
+- **exact-integer windows per dtype** — the largest magnitude below which
+  EVERY integer is exactly representable (2^24 for f32, 2^8 for bf16,
+  2^53 for f64; an integer dtype's window is its own max). ``EXACT_F32_LIMIT``
+  (the accumulator conversion threshold) is defined here and re-exported by
+  ``ops/gramian.py``;
+- **the flush-projection formula** — ``flush_entry_increment(rows,
+  max_count)``, the conservative per-flush per-entry increment the runtime
+  accumulators project before every dispatch. The SAME callable is what
+  GR005 (``check/ranges.py``) holds the jaxpr-proven increment against, so
+  the trigger the runtime uses and the bound the prover verifies can never
+  drift.
+
+Pure Python arithmetic over numpy dtypes — importable by the device-free
+checkers without touching jax.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class RangeContract:
+    """One declared operand range: every value of this operand class lies
+    in ``[lo, hi]`` (inclusive), and — for ``integral`` contracts — is an
+    integer. The prover treats a contracted input as this interval and an
+    uncontracted one as unbounded (which any dot consuming it turns into a
+    GR004 finding)."""
+
+    name: str
+    lo: int
+    hi: int
+    description: str
+    integral: bool = True
+
+
+#: VCF/synthetic genotype allele-dosage values (0 = ref, 1 = het, 2 = hom
+#: alt) — the widest per-site value the parse/devicegen layers stage.
+GENOTYPE = RangeContract(
+    "genotype", 0, 2, "diploid allele dosage (0/1/2) from parse/devicegen"
+)
+
+#: The Gramian's row operand on every default path: the per-(variant,
+#: sample) has-variation membership bit (``VariantsPca.scala:65-69``).
+HAS_VARIATION = RangeContract(
+    "has_variation", 0, 1, "per-sample has-variation membership bit"
+)
+
+#: Count-valued rows (same-set joins): a callset column appearing k times
+#: per variant contributes k — the reference pair-loop's multiplicity
+#: (``VariantsPca.scala:224-229``). The declared production ceiling is a
+#: set joined with itself at most this many times; the runtime projection
+#: additionally measures the true per-flush max, so this constant only
+#: bounds the STATIC geometry proofs, never correctness.
+SAME_SET_JOIN_MAX_COUNT = 4
+COUNT_ROW = RangeContract(
+    "count_row",
+    0,
+    SAME_SET_JOIN_MAX_COUNT,
+    "count-valued join row (duplicate-id multiplicity, declared ceiling)",
+)
+
+#: Allele frequencies, the one real-valued (non-integral) contract.
+ALLELE_FREQUENCY = RangeContract(
+    "allele_frequency", 0, 1, "per-site allele frequency", integral=False
+)
+
+#: A bit-packed ring/staging wire byte (8 genotype bits, np.packbits).
+PACKED_BYTE = RangeContract(
+    "packed_byte", 0, 255, "bit-packed wire byte (8 has-variation bits)"
+)
+
+CONTRACTS: Dict[str, RangeContract] = {
+    c.name: c
+    for c in (GENOTYPE, HAS_VARIATION, COUNT_ROW, ALLELE_FREQUENCY, PACKED_BYTE)
+}
+
+
+#: Mantissa-driven exact-integer windows of the float dtypes the ladder
+#: uses: every integer of magnitude <= the window is exactly representable.
+_FLOAT_WINDOWS = {
+    "float64": 1 << 53,
+    "float32": 1 << 24,
+    "bfloat16": 1 << 8,
+    "float16": 1 << 11,
+}
+
+
+def exact_int_window(dtype) -> Optional[int]:
+    """Largest magnitude M such that every integer ``|n| <= M`` is exactly
+    representable in ``dtype`` (an int dtype's own max; 2^mantissa for
+    floats; ``None`` for dtypes with no integer-exactness story).
+
+    Accepts any dtype spelling: a name string (``"bfloat16"``), a numpy
+    dtype instance, a numpy scalar type (``np.int32``), or a jax dtype.
+    """
+    if isinstance(dtype, str):
+        name = dtype
+    else:
+        try:
+            name = np.dtype(dtype).name
+        except TypeError:
+            name = getattr(dtype, "name", None) or str(dtype)
+    if name in _FLOAT_WINDOWS:
+        return _FLOAT_WINDOWS[name]
+    try:
+        np_dtype = np.dtype(name)
+    except TypeError:
+        return None
+    if np_dtype.kind in ("i", "u"):
+        return int(np.iinfo(np_dtype).max)
+    if np_dtype.kind == "b":
+        return 1
+    return None
+
+
+#: Declared maximum production geometry: total candidate variant rows of
+#: one run. The whole-genome synthetic grid carries ~39.5M candidate sites
+#: (DESIGN.md §7); 40M is the declared ceiling the GR001 overflow proof
+#: and the plan validator's ``gramian_entry_bound`` facts cover.
+DECLARED_MAX_SITES = 40_000_000
+
+#: f32 accumulation is exact for integers up to 2^24; past a projected
+#: per-entry count of this limit the accumulators losslessly convert to the
+#: int8->int32 MXU path. Defined here (the dtype-window registry) and
+#: re-exported by ``ops/gramian.py``, whose conversion trigger consumes it.
+EXACT_F32_LIMIT = exact_int_window(np.float32) or (1 << 24)
+
+
+def flush_entry_increment(rows: int, max_count: int) -> int:
+    """Conservative per-entry Gramian increment of one flush of ``rows``
+    variant rows whose entries are bounded by ``max_count``: every entry of
+    ``XᵀX`` gains at most ``rows x max_count²``.
+
+    THE runtime projection formula: both accumulators feed it to
+    ``_maybe_switch_accumulator`` before every dispatch, and GR005
+    (``check/ranges.py``) proves it conservative w.r.t. the per-dispatch
+    increment read off the traced kernel jaxpr — one callable, two
+    consumers, no drift.
+    """
+    return int(rows) * int(max_count) * int(max_count)
+
+
+def exactness_headroom_sites(dtype, max_count: int = 1) -> int:
+    """The largest variant-row count whose Gramian accumulation is provably
+    exact on ``dtype``'s ladder rung: ``window(dtype) // max_count²``
+    (0 when the dtype has no exact-integer window)."""
+    window = exact_int_window(dtype)
+    if window is None or max_count < 1:
+        return 0
+    return int(window) // (int(max_count) * int(max_count))
+
+
+__all__ = [
+    "ALLELE_FREQUENCY",
+    "CONTRACTS",
+    "COUNT_ROW",
+    "DECLARED_MAX_SITES",
+    "EXACT_F32_LIMIT",
+    "GENOTYPE",
+    "HAS_VARIATION",
+    "PACKED_BYTE",
+    "RangeContract",
+    "SAME_SET_JOIN_MAX_COUNT",
+    "exact_int_window",
+    "exactness_headroom_sites",
+    "flush_entry_increment",
+]

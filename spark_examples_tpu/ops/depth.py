@@ -48,6 +48,8 @@ def depth_counts(
         & (idx < window_size)
     )
     idx = jnp.clip(idx, 0, window_size - 1)
+    # range: valid is a bool mask — {0,1} increments, exact in int32 up to
+    # 2^31-1 overlapping reads per position.
     return (
         jnp.zeros((window_size,), jnp.int32)
         .at[idx.ravel()]
@@ -80,6 +82,8 @@ def base_counts(
     )
     idx = jnp.clip(idx, 0, window_size - 1)
     codes = jnp.clip(base_codes, 0, 3)
+    # range: codes are clipped to [0,3] and valid is a {0,1} bool mask —
+    # both exact in int32 (counts bounded by reads per position < 2^31).
     return (
         jnp.zeros((window_size, len(BASES)), jnp.int32)
         .at[idx.ravel(), codes.ravel().astype(jnp.int32)]

@@ -179,6 +179,8 @@ def _allele_pair(h2_col: jax.Array, samples_u64: jax.Array):
     multiplies per (site, sample) — the ingest hot loop (DESIGN.md
     "single-chip ingest roofline")."""
     x64 = h2_col ^ samples_u64
+    # range: deliberate 64→32 bit FOLD (high xor low) — the draw is defined
+    # on u32; truncation is the hash, not a lost value (DESIGN.md §7 step 1).
     x32 = ((x64 >> jnp.uint64(32)) ^ x64).astype(jnp.uint32)
     d1 = fmix32(x32)
     d2 = (d1 * jnp.uint32(0x9E3779B9)) ^ jnp.uint32(0x85EBCA6B)
@@ -229,6 +231,8 @@ def generate_has_variation(
             lax.slice_in_dim(pops, offsets[s], offsets[s] + sizes[s])
             for s in range(n_sets)
         ]
+    # range: Q32 thresholds are < 2^32 by construction (clipped at
+    # _POP_HI_Q32, sources/synthetic.py) — uint32 holds them exactly.
     Tq32 = thresholds.astype(jnp.uint32)
     pos_term = positions.astype(jnp.uint64) * _c64(_P2)
     parts = []
@@ -288,6 +292,7 @@ def generate_column_block(
     n_local = pops_local.shape[0]
     cols = col_start + jnp.arange(n_local, dtype=jnp.int64)
     pos_term = positions.astype(jnp.uint64) * _c64(_P2)
+    # range: Q32 thresholds < 2^32 by construction (clipped at _POP_HI_Q32).
     t_full = jnp.take(thresholds, pops_local, axis=1).astype(jnp.uint32)
     t_full = jnp.where((cols < num_samples)[None, :], t_full, jnp.uint32(0))
     if set_sizes is None:
@@ -1014,6 +1019,7 @@ def _ring_update(
                 # A row "has variation" for set s if ANY of set s's columns
                 # do, across every slice (matches the dense accumulator's
                 # per-set accounting).
+                # range: bool any() → {0,1} per row, exact in int32.
                 per_set_local = jnp.stack(
                     [
                         jnp.any(
@@ -1038,6 +1044,8 @@ def _ring_update(
                 # what the ring circulates, and packing right after
                 # generation keeps the u32 chain materialized exactly once.
                 if pack:
+                    # range: hv is {0,1} (ops/contracts.py:HAS_VARIATION)
+                    # — exact in uint8 for the bit pack.
                     x_cols = jax.lax.optimization_barrier(
                         _pack_bits_device(hv.astype(jnp.uint8))
                     )

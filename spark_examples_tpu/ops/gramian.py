@@ -57,6 +57,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_examples_tpu.utils.compat import axis_size, shard_map
 
+from spark_examples_tpu.ops.contracts import (
+    EXACT_F32_LIMIT,
+    flush_entry_increment,
+)
 from spark_examples_tpu.parallel.mesh import (
     DATA_AXIS,
     SAMPLES_AXIS,
@@ -99,12 +103,15 @@ def _operand_dtypes(exact_int: bool, mesh: Optional[Mesh] = None):
     return ml_dtypes.bfloat16, jnp.float32
 
 
-# f32 accumulation is exact for integers up to 2^24; past a projected
-# per-entry count of this limit the accumulators losslessly convert to the
-# int8->int32 MXU path (all entries are still exact integers at the moment of
-# conversion). SURVEY §7 hard-part 3: whole-genome diagonal counts (~12M)
-# approach this, and merged-cohort configs exceed it.
-EXACT_F32_LIMIT = 1 << 24
+# f32 accumulation is exact for integers up to 2^24 (EXACT_F32_LIMIT, now
+# defined with the rest of the dtype-window registry in ops/contracts.py and
+# re-exported here); past a projected per-entry count of this limit the
+# accumulators losslessly convert to the int8->int32 MXU path (all entries
+# are still exact integers at the moment of conversion). SURVEY §7 hard-part
+# 3: whole-genome diagonal counts (~12M) approach this, and merged-cohort
+# configs exceed it. The projection itself is contracts.flush_entry_increment
+# — the same callable `graftcheck ranges` GR005 proves conservative against
+# the per-dispatch increment read off the traced kernel jaxprs.
 
 # Dense vs sharded similarity strategy, decided from memory — the TPU
 # restatement of the reference's guidance, which states its bound in GB ("a
@@ -151,6 +158,9 @@ def _maybe_switch_accumulator(acc, next_bound: int, out_shardings=None) -> bool:
         return False
     if next_bound <= EXACT_F32_LIMIT:
         return False
+    # range: every entry is an exact integer <= EXACT_F32_LIMIT (2^24) at
+    # conversion time — far inside int32's 2^31 window, so the cast is
+    # lossless by the GR005-proven trigger (check/ranges.py).
     acc.G = jax.jit(
         lambda g: g.astype(jnp.int32), out_shardings=out_shardings
     )(acc.G)
@@ -244,6 +254,9 @@ class _AccumulatorTelemetry:
         self.flush_seconds_total = 0.0
         self._flushes = self._rows = self._seconds = self._inflight = None
         self._ring_bytes = self._ring_seconds = None
+        self._entry_max = self._entry_bound_gauge = None
+        self.entry_max_seen = 0.0
+        self._registry = registry
         if registry is not None and strategy == "sharded":
             from spark_examples_tpu.obs.metrics import (
                 GRAMIAN_RING_BYTES,
@@ -296,6 +309,34 @@ class _AccumulatorTelemetry:
             self._ring_bytes.inc(nbytes)
             self._ring_seconds.observe(seconds)
 
+    def record_entry_sample(self, G, entry_bound: int) -> None:
+        """``--check-ranges`` debug sampling: the measured max |entry| of
+        the live accumulator next to the statically-projected bound
+        (``contracts.flush_entry_increment`` accumulated over flushes) —
+        the runtime half of the ``graftcheck ranges`` exactness contract,
+        mirroring the hostmem measured-RSS/static-bound pair. The sampled
+        pair lands in the ``gramian_entry_max`` / ``gramian_static_entry_bound``
+        gauges and, from there, in the run manifest; the obs smoke asserts
+        measured <= proven on every build."""
+        sample = float(np.asarray(jax.device_get(jnp.max(jnp.abs(G)))))  # graftcheck: disable=GC001 -- deliberate per-flush device fetch: --check-ranges is an opt-in DEBUG mode whose whole point is sampling the live accumulator (off by default, documented in the flag help)
+        self.entry_max_seen = max(self.entry_max_seen, sample)
+        if self._registry is not None:
+            if self._entry_max is None:
+                from spark_examples_tpu.obs.metrics import (
+                    GRAMIAN_ENTRY_MAX,
+                    GRAMIAN_STATIC_ENTRY_BOUND,
+                    well_known_gauge,
+                )
+
+                self._entry_max = well_known_gauge(
+                    self._registry, GRAMIAN_ENTRY_MAX
+                )
+                self._entry_bound_gauge = well_known_gauge(
+                    self._registry, GRAMIAN_STATIC_ENTRY_BOUND
+                )
+            self._entry_max.set(self.entry_max_seen)
+            self._entry_bound_gauge.set(float(entry_bound))
+
     def finalize_span(self):
         """Context for the finalize reduce; also attaches the flush-time
         aggregate so the span tree reads ingest → dispatch → reduce-flush."""
@@ -325,6 +366,9 @@ def _pack_bits_device(bits: jax.Array) -> jax.Array:
     first ``ppermute`` so the wire format matches the host-packed path."""
     *lead, n = bits.shape
     shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    # range: inputs are {0,1} membership bits (ops/contracts.py:HAS_VARIATION)
+    # — uint8 holds them exactly, and the shifted disjoint-bit terms below
+    # sum to at most 255.
     grouped = bits.reshape(*lead, n // 8, 8).astype(jnp.uint8) << shifts
     # Exact in uint8: 8 disjoint-bit terms sum to at most 255.
     return jnp.sum(grouped, axis=-1, dtype=jnp.uint8)
@@ -348,8 +392,10 @@ class GramianAccumulator:
         pipeline_depth: Optional[int] = None,
         registry=None,
         spans=None,
+        check_ranges: bool = False,
     ):
         self.telemetry = _AccumulatorTelemetry(registry, spans, "dense")
+        self.check_ranges = bool(check_ranges)
         self.num_samples = int(num_samples)
         self.mesh = mesh
         self.block_size = int(block_size)
@@ -420,12 +466,13 @@ class GramianAccumulator:
             block = block.copy()
             block[self._fill :] = 0
         max_count = int(block.max(initial=0))
+        # The ONE projection formula (ops/contracts.py) — GR005 proves it
+        # conservative w.r.t. the jaxpr-derived per-dispatch increment.
+        increment = flush_entry_increment(self._fill, max_count)
         _maybe_switch_accumulator(
-            self,
-            self._entry_bound + self._fill * max_count * max_count,
-            out_shardings=self._g_sharding,
+            self, self._entry_bound + increment, out_shardings=self._g_sharding
         )
-        self._entry_bound += self._fill * max_count * max_count
+        self._entry_bound += increment
         shaped = block.reshape(
             self.data_parallel, self.block_size, self.num_samples
         )
@@ -468,6 +515,8 @@ class GramianAccumulator:
                 jax.block_until_ready(self._in_flight.pop(0))
         elif self._flushes % self.sync_every == 0:
             jax.block_until_ready(self.G)
+        if self.check_ranges:
+            self.telemetry.record_entry_sample(self.G, self._entry_bound)
         self.telemetry.record_flush(
             flush_rows, time.perf_counter() - flush_start, len(self._in_flight)
         )
@@ -529,6 +578,7 @@ def _ring_tiles(G_local, X_cols, samples_axis: str, operand_dtype, packed=False)
         )  # (N_local, N_local)
         # Explicit int32 indices: under enable_x64 the literal 0 would
         # otherwise promote to int64 and mismatch the axis-index dtype.
+        # range: j < D and j * n_local < padded cohort width << 2^31.
         col = (j * n_local).astype(jnp.int32)
         zero = jnp.int32(0)
         return lax.dynamic_update_slice(
@@ -615,8 +665,10 @@ class ShardedGramianAccumulator:
         registry=None,
         spans=None,
         pack_bits: str = "auto",
+        check_ranges: bool = False,
     ):
         self.telemetry = _AccumulatorTelemetry(registry, spans, "sharded")
+        self.check_ranges = bool(check_ranges)
         self.sync_every = max(1, int(sync_every))
         self._flushes = 0
         if SAMPLES_AXIS not in mesh.shape:
@@ -702,7 +754,10 @@ class ShardedGramianAccumulator:
             block = block.copy()
             block[self._fill :] = 0
         max_count = int(block.max(initial=0))
-        next_bound = self._entry_bound + self._fill * max_count * max_count
+        # Same shared projection formula as the dense path (GR005).
+        next_bound = self._entry_bound + flush_entry_increment(
+            self._fill, max_count
+        )
         if _maybe_switch_accumulator(
             self, next_bound, out_shardings=self._g_sharding
         ):
@@ -729,6 +784,8 @@ class ShardedGramianAccumulator:
         self._flushes += 1
         if self._flushes % self.sync_every == 0:
             jax.block_until_ready(self.G)
+        if self.check_ranges:
+            self.telemetry.record_entry_sample(self.G, self._entry_bound)
         flush_seconds = time.perf_counter() - flush_start
         flush_ring_bytes = ring_traffic_bytes(
             self.data_parallel * self.block_size,

@@ -35,6 +35,8 @@ def principal_components(
     the MLlib result (``VariantsPca.scala:267-270``) — and ``eigenvalues``
     holds the corresponding eigenvalues of B (descending |λ|).
     """
+    # range: centered input is real-valued; the eigensolve is defined in
+    # f32 — integer exactness ends at the centering boundary by design.
     B = centered.astype(jnp.float32)
     # Symmetrize against accumulated roundoff; B is symmetric by construction.
     B = (B + B.T) * 0.5
@@ -72,6 +74,8 @@ def principal_components_subspace(
     Deterministic: fixed PRNG key, fixed iteration count, and the same sign
     convention as :func:`principal_components`.
     """
+    # range: centered input is real-valued; the subspace iteration runs in
+    # f32 by design — integer exactness ends at the centering boundary.
     B = centered.astype(jnp.float32)
     B = (B + B.T) * 0.5
     n = B.shape[0]
@@ -134,6 +138,8 @@ def principal_components_subspace_sharded(
         V = jax.random.normal(jax.random.PRNGKey(0), (n_padded, k), jnp.float32)
 
         def gathered_bv(V):
+            # range: centered row tile is real-valued; the sharded
+            # eigensolve runs in f32 by design (see the dense variants).
             W_local = B_local.astype(jnp.float32) @ V  # (n_local, k)
             return jax.lax.all_gather(
                 W_local, SAMPLES_AXIS, axis=0, tiled=True

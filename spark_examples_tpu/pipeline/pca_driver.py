@@ -434,16 +434,19 @@ class VariantsPcaDriver:
             return self._host_similarity(calls)
         mesh = self._make_mesh()
         exact = getattr(self.conf, "exact_similarity", False)
+        check_ranges = bool(getattr(self.conf, "check_ranges", False))
         if self._resolve_sharded(sharded, mesh):
             acc: object = ShardedGramianAccumulator(
                 n, mesh, block_size=self.conf.block_size, exact_int=exact,
                 registry=self.registry, spans=self.spans,
                 pack_bits=getattr(self.conf, "ring_pack_bits", "auto"),
+                check_ranges=check_ranges,
             )
         else:
             acc = GramianAccumulator(
                 n, mesh, block_size=self.conf.block_size, exact_int=exact,
                 registry=self.registry, spans=self.spans,
+                check_ranges=check_ranges,
             )
         # Duplicate callset indices only arise when a variant set is joined
         # with itself (duplicate ids collapse the column index); only then is
@@ -490,11 +493,13 @@ class VariantsPcaDriver:
             return matrix.astype(np.float64)
         mesh = self._make_mesh()
         exact = getattr(self.conf, "exact_similarity", False)
+        check_ranges = bool(getattr(self.conf, "check_ranges", False))
         if self._resolve_sharded(sharded, mesh):
             acc: object = ShardedGramianAccumulator(
                 n, mesh, block_size=self.conf.block_size, exact_int=exact,
                 registry=self.registry, spans=self.spans,
                 pack_bits=getattr(self.conf, "ring_pack_bits", "auto"),
+                check_ranges=check_ranges,
             )
         else:
             acc = GramianAccumulator(
@@ -505,6 +510,7 @@ class VariantsPcaDriver:
                 pipeline_depth=pipeline_depth,
                 registry=self.registry,
                 spans=self.spans,
+                check_ranges=check_ranges,
             )
         for block in blocks:
             acc.add_rows(block)
@@ -1167,39 +1173,46 @@ def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
                 driver.io_stats.add_variants(counters.variants)
             return similarity
 
-        def shard_blocks(part):
-            # graftcheck: hostmem(unbounded) -- per-WINDOW materialization of the in-memory packed path (stats need the block list); streaming-scale inputs take stream_genotype_blocks above, which never lands here
-            blocks = list(
-                source.genotype_blocks(
+        def block_stream():
+            # Bounded iteration (the first nibble of ROADMAP item 1): blocks
+            # flow one at a time from the per-window producer into the
+            # prefetch queue — peak host memory O(block), not O(window).
+            # This replaced the per-window `list(genotype_blocks)` pool
+            # worker, which was the hostmem declared_unbounded inventory's
+            # pca_driver entry; stats account per block as it streams, with
+            # identical totals and identical block order (windows in
+            # partition order, blocks in producer order — byte-identical
+            # output, test-asserted).
+            done_gauge = well_known_gauge(
+                driver.registry, INGEST_PARTITIONS_DONE
+            )
+            for index, part in enumerate(partitions):
+                if driver.io_stats is not None:
+                    driver.io_stats.add_partition(part.range)
+                    # Wire-equivalent page accounting (shared helpers).
+                    driver.io_stats.add_requests(
+                        source.page_requests(
+                            part.contig, conf.bases_per_partition
+                        )
+                        if synthetic
+                        else source.page_requests(
+                            part.variant_set_id,
+                            part.contig,
+                            conf.bases_per_partition,
+                        )
+                    )
+                window_variants = 0
+                for block in source.genotype_blocks(
                     part.variant_set_id,
                     part.contig,
                     block_size=conf.block_size,
                     min_allele_frequency=conf.min_allele_frequency,
-                )
-            )
-            if driver.io_stats is not None:
-                driver.io_stats.add_partition(part.range)
-                driver.io_stats.add_variants(
-                    sum(len(b["positions"]) for b in blocks)
-                )
-                # Wire-equivalent page accounting (shared helpers).
-                driver.io_stats.add_requests(
-                    source.page_requests(part.contig, conf.bases_per_partition)
-                    if synthetic
-                    else source.page_requests(
-                        part.variant_set_id,
-                        part.contig,
-                        conf.bases_per_partition,
-                    )
-                )
-            return blocks
-
-        def block_stream():
-            for _, blocks in _parallel_shards(
-                partitions, shard_blocks, conf.num_workers
-            ):
-                for block in blocks:
+                ):
+                    window_variants += len(block["positions"])
                     yield block["has_variation"]
+                if driver.io_stats is not None:
+                    driver.io_stats.add_variants(window_variants)
+                done_gauge.set(index + 1)
 
         return feed_rows(block_stream())
     data = driver.get_data()

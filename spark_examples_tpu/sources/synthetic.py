@@ -204,6 +204,13 @@ def _genotype_draw_pair(
     return d1, d2
 
 
+#: Default candidate-site grid density: one site every N bases (~1/100
+#: approximates 1KG phase 1's ~39M sites over ~2.9 Gb). ONE constant shared
+#: by the source default below and the device-free plan validator's static
+#: site-count bound (``check/plan.py``'s exactness-window facts).
+DEFAULT_VARIANT_SPACING = 100
+
+
 class SyntheticGenomicsSource(GenomicsSource):
     """A deterministic cohort with population structure.
 
@@ -229,7 +236,7 @@ class SyntheticGenomicsSource(GenomicsSource):
         self,
         num_samples: int = 2504,
         seed: int = 42,
-        variant_spacing: int = 100,
+        variant_spacing: int = DEFAULT_VARIANT_SPACING,
         ref_block_fraction: float = 0.1,
         n_pops: int = 4,
         read_length: int = 100,
@@ -670,4 +677,8 @@ class SyntheticClient(GenomicsClient):
             self.counters.add_request()
 
 
-__all__ = ["SyntheticGenomicsSource", "SyntheticClient"]
+__all__ = [
+    "DEFAULT_VARIANT_SPACING",
+    "SyntheticGenomicsSource",
+    "SyntheticClient",
+]
