@@ -38,7 +38,15 @@
 #      oracle (off). Result rows must be byte-identical and the manifests'
 #      gramian_ring_bytes must show the >= 8x packed traffic reduction —
 #      the ring path can never regress silently on a CPU-only runner.
-#   5. sanitize (opt-in: `ci.sh --sanitize`) — ASAN/UBSAN/TSAN replay of
+#   5. serve smoke — the resident daemon (serve/) end to end on CPU: start
+#      `python -m spark_examples_tpu serve` with a synthetic source, assert
+#      a plan-invalid request returns a structured 400 carrying the plan
+#      finding, an accepted tiny job completes with a valid per-job
+#      schema-v2 manifest, the identical resubmit reports a warm
+#      compile-cache hit (hit counter >= 1 in /metrics), and SIGTERM
+#      drains gracefully: the in-flight job finishes, new jobs get 503,
+#      the daemon exits 0.
+#   6. sanitize (opt-in: `ci.sh --sanitize`) — ASAN/UBSAN/TSAN replay of
 #      the VCF fuzz corpus against the native parser; skips gracefully
 #      when no C++ compiler is available.
 # Run from the repo root. Exit code: first failing stage wins, tier-1 first.
@@ -216,6 +224,103 @@ else
 fi
 rm -rf "$RING_TMP"
 
+echo "== serve smoke (resident daemon: admit, reject, warm cache, drain) =="
+serve_rc=0
+SERVE_TMP=$(mktemp -d)
+env JAX_PLATFORMS=cpu SPARK_EXAMPLES_TPU_NO_CACHE=1 \
+  python -m spark_examples_tpu serve --port 0 \
+    --run-dir "$SERVE_TMP/run" --endpoint-file "$SERVE_TMP/endpoint" \
+    > "$SERVE_TMP/daemon.out" 2> "$SERVE_TMP/daemon.err" &
+SERVE_PID=$!
+for _ in $(seq 1 150); do [ -f "$SERVE_TMP/endpoint" ] && break; sleep 0.2; done
+if [ ! -f "$SERVE_TMP/endpoint" ]; then
+  echo "serve smoke: daemon never published its endpoint"; serve_rc=1
+  kill "$SERVE_PID" 2>/dev/null
+  wait "$SERVE_PID" 2>/dev/null
+else
+  env JAX_PLATFORMS=cpu python - "$(cat "$SERVE_TMP/endpoint")" "$SERVE_PID" <<'PYEOF' || serve_rc=$?
+import os, signal, sys, time, urllib.error
+from spark_examples_tpu.obs.manifest import read_manifest, validate_manifest
+from spark_examples_tpu.obs.metrics import COMPILE_CACHE_GEOMETRY_HITS
+from spark_examples_tpu.serve.client import ServeClient, ServeError
+
+url, daemon_pid = sys.argv[1], int(sys.argv[2])
+client = ServeClient(url)
+flags = ["--num-samples", "8", "--references", "1:0:50000"]
+
+# 1. plan-invalid request -> structured 400 carrying the plan finding.
+try:
+    client.submit(flags + ["--num-pc", "99"])
+    print("plan-invalid submit was ACCEPTED"); sys.exit(1)
+except ServeError as e:
+    codes = [i["code"] for i in e.body.get("plan", {}).get("issues", [])]
+    if e.status != 400 or e.code != "plan-rejected" \
+            or "num-pc-exceeds-cohort" not in codes:
+        print(f"rejection not structured: {e.status} {e.code} {codes}")
+        sys.exit(1)
+
+# 2. accepted synthetic job -> done, valid per-job schema-v2 manifest.
+job = client.wait(client.submit(flags)["job"]["id"], timeout=300)["job"]
+if job["status"] != "done" or job["compile_cache"] != "cold":
+    print(f"first job not a clean cold run: {job['status']} "
+          f"{job['compile_cache']} {job.get('error')}"); sys.exit(1)
+errors = validate_manifest(read_manifest(job["manifest_path"]))
+if errors:
+    print("per-job manifest INVALID:\n  " + "\n  ".join(errors)); sys.exit(1)
+
+# 3. identical resubmit -> warm compile-cache hit, visible in /metrics.
+job2 = client.wait(client.submit(flags)["job"]["id"], timeout=300)["job"]
+if job2["status"] != "done" or job2["compile_cache"] != "warm":
+    print(f"identical resubmit not warm: {job2['status']} "
+          f"{job2['compile_cache']}"); sys.exit(1)
+hits = [l for l in client.metrics().splitlines()
+        if l.startswith(COMPILE_CACHE_GEOMETRY_HITS + " ")]
+if not hits or float(hits[0].split()[1]) < 1:
+    print(f"/metrics shows no warm-geometry hit: {hits}"); sys.exit(1)
+
+# 4. SIGTERM drain: a fresh-geometry job holds the worker (cold compile),
+#    new submissions get 503, the in-flight job still finishes.
+inflight = client.submit(["--num-samples", "12",
+                          "--references", "1:0:50000"])["job"]
+os.kill(daemon_pid, signal.SIGTERM)
+drain_seen = False
+for _ in range(20):
+    try:
+        client.submit(flags)
+        time.sleep(0.05)
+    except ServeError as e:
+        if e.status == 503 and e.code == "draining":
+            drain_seen = True
+        break
+    except urllib.error.URLError:
+        break
+if not drain_seen:
+    print("drain window never returned 503 draining"); sys.exit(1)
+manifest = os.path.join(os.path.dirname(os.path.dirname(
+    job["manifest_path"])), inflight["id"], "manifest.json")
+for _ in range(300):
+    if os.path.exists(manifest):
+        break
+    time.sleep(0.2)
+else:
+    print(f"in-flight job never finished its manifest: {manifest}")
+    sys.exit(1)
+print(f"serve smoke OK: structured rejection, cold {job['seconds']:.2f}s "
+      f"-> warm {job2['seconds']:.2f}s, per-job manifests valid, "
+      "drain returned 503 and finished the in-flight job")
+PYEOF
+  kill -TERM "$SERVE_PID" 2>/dev/null
+  if wait "$SERVE_PID"; then
+    echo "serve smoke: daemon drained cleanly (exit 0)"
+  else
+    echo "serve smoke: daemon exited nonzero"; serve_rc=1
+  fi
+fi
+if [ "$serve_rc" -ne 0 ]; then
+  echo "serve smoke failed (rc=$serve_rc):"; tail -20 "$SERVE_TMP/daemon.err"
+fi
+rm -rf "$SERVE_TMP"
+
 san_rc=0
 if [ "$SANITIZE" = "1" ]; then
   echo "== sanitizer stage (graftcheck sanitize) =="
@@ -229,4 +334,5 @@ if [ "$rg_rc" -ne 0 ]; then exit "$rg_rc"; fi
 if [ "$hm_rc" -ne 0 ]; then exit "$hm_rc"; fi
 if [ "$obs_rc" -ne 0 ]; then exit "$obs_rc"; fi
 if [ "$ring_rc" -ne 0 ]; then exit "$ring_rc"; fi
+if [ "$serve_rc" -ne 0 ]; then exit "$serve_rc"; fi
 exit "$san_rc"

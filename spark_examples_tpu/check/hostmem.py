@@ -570,12 +570,15 @@ def audit_source(
 
 
 def default_hostmem_paths() -> List[str]:
-    """The audited host-staging layers of the installed package."""
+    """The audited host-staging layers of the installed package (kept in
+    lockstep with ``check/rules.py:HOSTMEM_GLOBS``): the ingest stack
+    plus the resident service's control plane (``serve/``)."""
     import spark_examples_tpu
 
     package_dir = os.path.dirname(os.path.abspath(spark_examples_tpu.__file__))
     return [
-        os.path.join(package_dir, sub) for sub in ("sources", "pipeline", "ops")
+        os.path.join(package_dir, sub)
+        for sub in ("sources", "pipeline", "ops", "serve")
     ]
 
 

@@ -36,12 +36,20 @@ HOT_PATH_GLOBS = ("ops/*", "pipeline/*")
 #: Ingest-concurrency scope: modules where threads share parse state, so
 #: bare lock creation must carry the documented lock-ordering idiom
 #: (a ``# lock order:`` comment on or just above the creation line).
-INGEST_GLOBS = ("sources/*", "pipeline/datasets.py", "utils/native.py")
+#: ``serve/*`` joined when the resident service landed: its admission
+#: queue, job table, and HTTP threads share state across the worker.
+INGEST_GLOBS = (
+    "sources/*",
+    "pipeline/datasets.py",
+    "utils/native.py",
+    "serve/*",
+)
 
 #: Telemetry scope: pipeline code whose counters must flow through the
 #: metrics registry (``obs/metrics.py``) via the owning object's methods —
-#: a bare ``stats.x += n`` bypasses both the lock and the manifest.
-TELEMETRY_GLOBS = ("ops/*", "pipeline/*", "sources/*")
+#: a bare ``stats.x += n`` bypasses both the lock and the manifest. The
+#: service's control plane (``serve/*``) carries the same obligation.
+TELEMETRY_GLOBS = ("ops/*", "pipeline/*", "sources/*", "serve/*")
 
 
 @dataclass(frozen=True)
@@ -302,8 +310,10 @@ RANGES_RULES: Dict[str, Rule] = {
 #: ``graftcheck hostmem`` scope: the host-staging layers whose ingest and
 #: consume paths must be provably bounded-window (or carry a justified
 #: ``hostmem(unbounded)`` declaration) — the host-RAM analog of the
-#: HBM/ring-traffic bounds the plan validator already proves.
-HOSTMEM_GLOBS = ("sources/*", "pipeline/*", "ops/*")
+#: HBM/ring-traffic bounds the plan validator already proves. ``serve/*``
+#: joined with the resident service: a daemon that buffers request bodies
+#: or job backlogs unboundedly would OOM exactly like an O(file) ingest.
+HOSTMEM_GLOBS = ("sources/*", "pipeline/*", "ops/*", "serve/*")
 
 #: ``graftcheck hostmem`` rule catalogue (``check/hostmem.py``): an AST
 #: dataflow audit classifying every host ingest/consume path as

@@ -36,6 +36,16 @@ ranges prover), ``graftcheck sanitize`` / ``graftcheck typecheck``:
     python -m spark_examples_tpu graftcheck hostmem --json
     python -m spark_examples_tpu graftcheck lockgraph --dot lockorder.dot
 
+Serving (``serve/``; README "Serving"): ``serve`` starts the resident
+daemon — warm mesh, compile-once, admission-controlled — and ``submit``
+sends it jobs expressed as the same PCA flag namespace (everything after
+``--`` is forwarded verbatim); plan-invalid requests come back as
+structured 4xx bodies carrying the ``graftcheck plan`` facts:
+
+    python -m spark_examples_tpu serve --port 8765 --run-dir /tmp/serve
+    python -m spark_examples_tpu submit --url http://127.0.0.1:8765 \\
+        -- --num-samples 64 --references 17:41196311:41277499
+
 Observability (``obs/``; README "Observability"): ``--heartbeat-seconds N``
 emits a stderr progress line every N seconds (sites/sec, partition ETA,
 prefetch queue, dispatch depth, device memory); ``--metrics-json PATH``
@@ -102,9 +112,27 @@ def _graftcheck(argv):
     return graftcheck_main(argv)
 
 
+def _serve(argv):
+    # The resident daemon (serve/http.py): platform/cache setup happens in
+    # main() like any real command, then the service owns the process.
+    from spark_examples_tpu.serve.http import serve_main
+
+    return serve_main(argv)
+
+
+def _submit(argv):
+    # Pure HTTP client: submitting to a remote daemon must not initialize
+    # a local jax backend — dispatched before the real-command setup.
+    from spark_examples_tpu.serve.client import submit_main
+
+    return submit_main(argv)
+
+
 COMMANDS = {
     "variants-pca": lambda argv: pca_driver.run(argv),
     "graftcheck": _graftcheck,
+    "serve": _serve,
+    "submit": _submit,
     "search-variants-klotho": _variants_cmd(variants_examples.run_klotho),
     "search-variants-brca1": _variants_cmd(variants_examples.run_brca1),
     "search-reads-example-1": _reads_cmd(reads_examples.run_example1, ["readset"]),
@@ -128,10 +156,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if command not in COMMANDS:
         print(f"unknown command: {command}", file=sys.stderr)
         return 2
-    if command == "graftcheck":
-        # Analysis-only: no platform override, no compile cache — lint and
-        # plan must run identically on devices-free CI boxes, and their
-        # exit codes gate ci.sh stages.
+    if command in ("graftcheck", "submit"):
+        # Analysis-only / client-only: no platform override, no compile
+        # cache — graftcheck must run identically on devices-free CI
+        # boxes, and `submit` talks to a (possibly remote) daemon without
+        # initializing a local backend. Exit codes propagate.
         return int(COMMANDS[command](rest))
     # After the help/unknown early-outs: only real commands pay (and benefit
     # from) the process-global platform/cache configuration.
@@ -140,6 +169,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     apply_platform_override()
     enable_persistent_compile_cache()
+    if command == "serve":
+        # The daemon's exit code IS the drain verdict (ci.sh gates on it).
+        return int(COMMANDS[command](rest))
     COMMANDS[command](rest)
     return 0
 

@@ -32,6 +32,12 @@ Well-known metric names sampled (producers register them; see DESIGN.md §9):
   OS high-water mark) vs ``host_static_bound_bytes`` (the
   ``host_peak_bytes`` formula), the host-memory pair ``graftcheck
   hostmem`` cross-validates
+- ``serve_queue_depth`` / ``serve_jobs_inflight`` / ``serve_jobs_done``
+  (gauges, resident service) — the admission-queue liveness the daemon's
+  service heartbeat shows instead of ingest progress
+- ``compile_cache_geometry_hits`` / ``..._misses`` (function-backed
+  gauges) — the warm-geometry ledger (``utils/cache.py``), the resident
+  service's compile-once promise per tick
 - device memory from ``jax.local_devices()[0].memory_stats()`` when the
   backend reports it (TPU does; CPU test devices do not).
 
@@ -48,6 +54,8 @@ import time
 from typing import Callable, Optional
 
 from spark_examples_tpu.obs.metrics import (
+    COMPILE_CACHE_GEOMETRY_HITS,
+    COMPILE_CACHE_GEOMETRY_MISSES,
     GRAMIAN_INFLIGHT_DISPATCHES,
     GRAMIAN_RING_BYTES,
     HOST_PEAK_RSS_BYTES,
@@ -59,6 +67,9 @@ from spark_examples_tpu.obs.metrics import (
     MetricsRegistry,
     PREFETCH_QUEUE_DEPTH,
     PREFETCH_QUEUE_OCCUPANCY,
+    SERVE_JOBS_DONE,
+    SERVE_JOBS_INFLIGHT,
+    SERVE_QUEUE_DEPTH,
 )
 
 
@@ -219,6 +230,35 @@ class Heartbeat:
         ring_bytes = self.registry.value(GRAMIAN_RING_BYTES)
         if ring_bytes:
             parts.append(f"ring traffic {_bytes_text(ring_bytes)}")
+
+        # Resident-service liveness (serve/): the daemon registers these
+        # in its service registry, so a service heartbeat shows admission
+        # state where a batch run's heartbeat shows ingest progress.
+        queued = self.registry.value(SERVE_QUEUE_DEPTH)
+        if queued is not None and queued == queued:
+            segment = f"serve queue {int(queued)}"
+            inflight = self.registry.value(SERVE_JOBS_INFLIGHT)
+            if inflight is not None and inflight == inflight:
+                segment += f" (in-flight {int(inflight)}"
+                done = self.registry.value(SERVE_JOBS_DONE)
+                if done is not None and done == done:
+                    segment += f", done {int(done)}"
+                segment += ")"
+            parts.append(segment)
+
+        # Warm-geometry compile-cache pair (utils/cache.py ledger): the
+        # compile-once promise of a resident process, visible per tick.
+        hits = self.registry.value(COMPILE_CACHE_GEOMETRY_HITS)
+        misses = self.registry.value(COMPILE_CACHE_GEOMETRY_MISSES)
+        if (
+            hits is not None
+            and hits == hits
+            and misses is not None
+            and misses == misses
+        ):
+            parts.append(
+                f"compile cache {int(hits)} warm/{int(misses)} cold"
+            )
 
         # Host-memory cross-validation pair: each tick SAMPLES the
         # function-backed peak-RSS gauge (graftcheck hostmem's runtime

@@ -22,7 +22,11 @@ v2 (additive): the optional ``gramian_exactness`` block — ``entry_max``
 (measured max |accumulator entry|, ``--check-ranges`` debug sampling)
 next to ``static_entry_bound`` (the conversion trigger's own projection,
 proven conservative by ``graftcheck ranges`` GR005); null on runs without
-the sampling, so existing consumers are untouched.
+the sampling, so existing consumers are untouched. Still v2 (additive):
+``compile_cache`` gained ``geometry_hits``/``geometry_misses`` — the
+process-wide warm-geometry ledger (``utils/cache.py``), so a served job's
+manifest records whether its geometry was already compiled in the
+resident daemon.
 
 Multi-host: under ``jax.distributed`` each process carries per-process
 I/O counters. :func:`build_run_manifest` aggregates them across processes
@@ -69,16 +73,28 @@ def _json_safe(value):
 
 def _compile_cache_block() -> Optional[Dict]:
     """Persistent compile-cache attribution (cold vs warm), mirroring
-    ``bench.py``'s reading of the config value ``utils/cache.py`` sets."""
+    ``bench.py``'s reading of the config value ``utils/cache.py`` sets —
+    plus the process-wide warm-geometry ledger counts (v2-additive:
+    ``geometry_hits``/``geometry_misses``), so a served run's manifest
+    records whether its geometry was already compiled in this process."""
+    from spark_examples_tpu.utils.cache import compile_cache_stats
+
+    hits, misses = compile_cache_stats()
+    directory, entries = None, 0
     try:
         import jax
 
-        directory = jax.config.jax_compilation_cache_dir
-        if not directory:
-            return {"dir": None, "entries": 0}
-        return {"dir": directory, "entries": len(os.listdir(directory))}
+        directory = jax.config.jax_compilation_cache_dir or None
+        if directory:
+            entries = len(os.listdir(directory))
     except Exception:
-        return None
+        entries = 0
+    return {
+        "dir": directory,
+        "entries": entries,
+        "geometry_hits": hits,
+        "geometry_misses": misses,
+    }
 
 
 def _host_memory_block(registry=None) -> Dict:

@@ -72,6 +72,23 @@ GRAMIAN_STATIC_ENTRY_BOUND = "gramian_static_entry_bound"
 #: (registered by ``pipeline/stats.py:_STAT_METRICS``, spelled once here).
 IO_PARTITIONS_TOTAL = "io_partitions_total"
 
+#: Warm-geometry compile-cache pair (``utils/cache.py``'s process-wide
+#: ledger): how many runs hit an already-compiled analysis geometry vs
+#: paid a cold compile. Function-backed (the ledger lives in utils.cache,
+#: not the registry), sampled by the heartbeat, recorded in the manifest's
+#: ``compile_cache`` block — the resident service's compile-once promise
+#: is observable per scrape, not inferred from latency.
+COMPILE_CACHE_GEOMETRY_HITS = "compile_cache_geometry_hits"
+COMPILE_CACHE_GEOMETRY_MISSES = "compile_cache_geometry_misses"
+
+#: Resident-service (``serve/``) liveness gauges the heartbeat samples:
+#: admitted-but-unstarted jobs across both admission classes, the 0/1
+#: in-flight flag of the single serial worker, and the lifetime count of
+#: jobs that reached a terminal state.
+SERVE_QUEUE_DEPTH = "serve_queue_depth"
+SERVE_JOBS_INFLIGHT = "serve_jobs_inflight"
+SERVE_JOBS_DONE = "serve_jobs_done"
+
 #: Host-memory cross-validation pair (``graftcheck hostmem``'s runtime
 #: half): the measured peak process RSS (function-backed — every read
 #: samples the OS) next to the static bound from
@@ -125,6 +142,26 @@ _WELL_KNOWN_GAUGE_HELP = {
         "Static host-memory bound of this configuration "
         "(parallel/mesh.py:host_peak_bytes); measured peak RSS must stay "
         "under it on bounded ingest paths."
+    ),
+    COMPILE_CACHE_GEOMETRY_HITS: (
+        "Runs in this process that hit an already-compiled analysis "
+        "geometry (utils/cache.py warm-geometry ledger)."
+    ),
+    COMPILE_CACHE_GEOMETRY_MISSES: (
+        "Runs in this process that paid a cold compile for a fresh "
+        "analysis geometry (utils/cache.py warm-geometry ledger)."
+    ),
+    SERVE_QUEUE_DEPTH: (
+        "Admitted jobs waiting in the service's two-class admission "
+        "queue (both classes)."
+    ),
+    SERVE_JOBS_INFLIGHT: (
+        "Jobs the service worker is executing right now (0 or 1: one "
+        "serial worker owns the devices)."
+    ),
+    SERVE_JOBS_DONE: (
+        "Service jobs that reached a terminal state (done, failed, or "
+        "cancelled) since the daemon started."
     ),
 }
 
@@ -566,6 +603,11 @@ __all__ = [
     "DEVICEGEN_DISPATCHES",
     "DEVICEGEN_SITES_CAPACITY",
     "IO_PARTITIONS_TOTAL",
+    "COMPILE_CACHE_GEOMETRY_HITS",
+    "COMPILE_CACHE_GEOMETRY_MISSES",
+    "SERVE_QUEUE_DEPTH",
+    "SERVE_JOBS_INFLIGHT",
+    "SERVE_JOBS_DONE",
     "HOST_PEAK_RSS_BYTES",
     "HOST_STATIC_BOUND_BYTES",
     "read_host_peak_rss_bytes",

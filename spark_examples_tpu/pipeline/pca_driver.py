@@ -863,10 +863,36 @@ class VariantsPcaDriver:
         pass  # no SparkContext to tear down; kept for API parity
 
 
+@dataclass
+class PipelineResult:
+    """One completed analysis: the emitted TSV lines (empty for
+    similarity-only runs), the similarity summary (similarity-only runs),
+    the run-manifest document when one was built, and the path it was
+    written to when the write succeeded. This is the library surface the
+    resident service (``serve/executor.py``) consumes; ``run`` keeps the
+    historical lines-only CLI contract on top of it."""
+
+    lines: List[str]
+    similarity_summary: Optional[Dict] = None
+    manifest: Optional[Dict] = None
+    manifest_path: Optional[str] = None
+
+
 def run(argv: Sequence[str]) -> List[str]:
     """``VariantsPcaDriver.main`` (``VariantsPca.scala:47-59``)."""
     conf = PcaConf.parse(argv)
     conf.init_distributed()
+    return run_pipeline(conf).lines
+
+
+def run_pipeline(conf: PcaConf, similarity_only: bool = False) -> PipelineResult:
+    """The run-an-analysis core, CLI-free: config in, result + manifest
+    out. ``run`` (batch) and the resident service's executor
+    (``serve/executor.py``) both call this, so a served job and a batch
+    invocation execute the identical pipeline and produce the identical
+    schema-v2 manifest. ``similarity_only`` stops after the
+    ingest+similarity stage and returns a host-side summary of the
+    Gramian instead of PC rows (the service's similarity request kind)."""
     synthetic_tpu = (
         conf.source == "synthetic"
         and not conf.input_path
@@ -978,6 +1004,7 @@ def run(argv: Sequence[str]) -> List[str]:
                 "(use --ingest wire for JSONL/checkpoint inputs)"
             )
     driver = VariantsPcaDriver(conf, source)
+    _export_compile_cache_gauges(driver.registry)
     from spark_examples_tpu.utils.tracing import StageTimes, device_trace
 
     # Stages record into the driver's span recorder, so the manifest's span
@@ -990,6 +1017,7 @@ def run(argv: Sequence[str]) -> List[str]:
         from spark_examples_tpu.obs.heartbeat import Heartbeat
 
         heartbeat = Heartbeat(conf.heartbeat_seconds, driver.registry).start()
+    similarity_summary: Optional[Dict] = None
     try:
         with device_trace(conf.profile_dir):
             # The device path already ends in a synchronous counter fetch
@@ -1002,24 +1030,48 @@ def run(argv: Sequence[str]) -> List[str]:
                 )
                 if not use_device:
                     _sync_scalar(similarity)
-            # compute_pca ends in the synchronous components fetch, so its
-            # stage time is honest even on asynchronous remote-attached
-            # backends.
-            with times.stage("center+pca"):
-                result = driver.compute_pca(similarity)
+            if similarity_only:
+                result = None
+                similarity_summary = _summarize_similarity(
+                    similarity, len(driver.indexes)
+                )
+            else:
+                # compute_pca ends in the synchronous components fetch, so
+                # its stage time is honest even on asynchronous
+                # remote-attached backends.
+                with times.stage("center+pca"):
+                    result = driver.compute_pca(similarity)
     finally:
         # Emits-then-stops-cleanly contract: a mid-run exception gets its
         # last heartbeat, then silence — never a progress line racing the
         # traceback (or a leaked thread outliving the run).
         if heartbeat is not None:
             heartbeat.stop()
-    lines = driver.emit_result(result)
+    # Warm the ledger only now, with every kernel this run dispatches
+    # compiled and executed — a failure above must not leave a fingerprint
+    # behind that makes a retry report "warm" for kernels never built. The
+    # kind is part of the key: a similarity-only run does not pre-warm the
+    # PCA geometry. Recorded before the manifest snapshot below so the
+    # run's own hit/miss is in its own manifest.
+    from spark_examples_tpu.utils.cache import (
+        compile_fingerprint,
+        record_geometry,
+    )
+
+    record_geometry(
+        compile_fingerprint(
+            conf, kind="similarity" if similarity_only else "pca"
+        )
+    )
+    lines = driver.emit_result(result) if result is not None else []
     driver.report_io_stats()
     if conf.profile_dir:
         print(str(times))
         print(f"Device trace written to {conf.profile_dir}.")
     import jax
 
+    manifest_doc: Optional[Dict] = None
+    manifest_path: Optional[str] = None
     if getattr(conf, "metrics_json", None) or jax.process_count() > 1:
         # Built LAST, after every report printed above, so the manifest
         # snapshots the same registry state the epilogue rendered — the
@@ -1054,9 +1106,57 @@ def run(argv: Sequence[str]) -> List[str]:
                     file=sys.stderr,
                 )
             else:
+                manifest_path = conf.metrics_json
                 print(f"Run manifest written to {conf.metrics_json}.")
     driver.stop()
-    return lines
+    return PipelineResult(
+        lines=lines,
+        similarity_summary=similarity_summary,
+        manifest=manifest_doc,
+        manifest_path=manifest_path,
+    )
+
+
+def _export_compile_cache_gauges(registry) -> None:
+    """Expose the warm-geometry ledger's counters (``utils/cache.py``) as
+    the well-known function-backed gauges, so the manifest and any
+    heartbeat sampling this registry show warm-vs-cold directly. The
+    ledger itself is fed at the END of ``run_pipeline`` — only a run that
+    actually compiled and executed its kernels warms a fingerprint.
+    Inside the resident daemon a repeated geometry is a hit (the
+    in-process jit caches are warm); each batch CLI process starts cold
+    by construction — both are honest."""
+    from spark_examples_tpu.obs.metrics import (
+        COMPILE_CACHE_GEOMETRY_HITS,
+        COMPILE_CACHE_GEOMETRY_MISSES,
+        well_known_gauge,
+    )
+    from spark_examples_tpu.utils.cache import compile_cache_stats
+
+    well_known_gauge(registry, COMPILE_CACHE_GEOMETRY_HITS).set_function(
+        lambda: float(compile_cache_stats()[0])
+    )
+    well_known_gauge(registry, COMPILE_CACHE_GEOMETRY_MISSES).set_function(
+        lambda: float(compile_cache_stats()[1])
+    )
+
+
+def _summarize_similarity(similarity, n: int) -> Dict:
+    """Host-side facts about a similarity matrix (the similarity request
+    kind's result surface): the served response must not ship an N×N
+    matrix, so the summary carries shape, dtype, the nonzero-row count the
+    PCA path would have printed, and the trace (total variation count) as
+    a cheap content fingerprint. Padded sharded results are trimmed to
+    the true cohort before summarizing."""
+    S = np.asarray(similarity)
+    S = S[:n, :n]
+    counts = S.astype(np.int64, copy=False)
+    return {
+        "shape": [int(s) for s in S.shape],
+        "dtype": str(S.dtype),
+        "nonzero_rows": int((counts.sum(axis=1) > 0).sum()),
+        "trace": float(np.trace(counts)),
+    }
 
 
 def _sync_scalar(similarity) -> None:
@@ -1220,4 +1320,12 @@ def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
     return driver.get_similarity_matrix(calls)
 
 
-__all__ = ["CallData", "VariantsPcaDriver", "extract_call_info", "make_source", "run"]
+__all__ = [
+    "CallData",
+    "PipelineResult",
+    "VariantsPcaDriver",
+    "extract_call_info",
+    "make_source",
+    "run",
+    "run_pipeline",
+]

@@ -1,0 +1,255 @@
+"""Stdlib HTTP client for the resident PCA service + the ``submit`` verb.
+
+``ServeClient`` is the scripting surface (the smoke test and
+``tests/test_serve.py`` ride it); ``submit_main`` is the CLI verb::
+
+    python -m spark_examples_tpu submit --url http://127.0.0.1:8765 \\
+        -- --num-samples 64 --references 17:41196311:41277499
+
+Everything after ``--`` is the EXISTING PCA flag namespace, forwarded
+verbatim — a batch invocation becomes a served job by replacing
+``variants-pca`` with ``submit --url ... --``. Exit codes: 0 job done,
+1 job failed/cancelled/timed out, 2 rejected at admission (the rejection
+body, including the plan facts, prints as JSON).
+
+The client never imports jax: submitting from a laptop to a TPU-backed
+daemon must not initialize a local backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, Optional, Sequence, Tuple
+
+from spark_examples_tpu.serve.protocol import (
+    TERMINAL_STATUSES,
+    request_doc,
+)
+
+#: Hard cap on response bodies (bounded read — a misbehaving server must
+#: not stage unbounded bytes in client memory).
+MAX_RESPONSE_BYTES = 64 << 20
+
+
+class ServeError(Exception):
+    """A non-2xx service response; carries the HTTP status and the parsed
+    error body (``error.code``, ``error.message``, optional ``plan``)."""
+
+    def __init__(self, status: int, body):
+        code = None
+        message = None
+        if isinstance(body, dict):
+            error = body.get("error") or {}
+            code = error.get("code")
+            message = error.get("message")
+        super().__init__(
+            f"HTTP {status}"
+            + (f" [{code}]" if code else "")
+            + (f": {message}" if message else "")
+        )
+        self.status = status
+        self.body = body
+        self.code = code
+
+
+class ServeClient:
+    def __init__(self, url: str, timeout: float = 30.0):
+        self.url = url.rstrip("/")
+        self.timeout = float(timeout)
+
+    # ------------------------------------------------------------ transport
+
+    def _request(
+        self, method: str, path: str, doc: Optional[Dict] = None
+    ) -> Tuple[int, object, str]:
+        data = None
+        headers = {"Accept": "application/json"}
+        if doc is not None:
+            data = json.dumps(doc).encode("utf-8")
+            headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(
+            self.url + path, data=data, method=method, headers=headers
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                status = resp.status
+                raw = resp.read(MAX_RESPONSE_BYTES + 1)
+                content_type = resp.headers.get("Content-Type", "")
+        except urllib.error.HTTPError as e:
+            status = e.code
+            raw = e.read(MAX_RESPONSE_BYTES + 1)
+            content_type = e.headers.get("Content-Type", "") if e.headers else ""
+        if len(raw) > MAX_RESPONSE_BYTES:
+            raise ServeError(
+                status,
+                {
+                    "error": {
+                        "code": "response-too-large",
+                        "message": f"response exceeds {MAX_RESPONSE_BYTES} bytes",
+                    }
+                },
+            )
+        text = raw.decode("utf-8", errors="replace")
+        if "application/json" in content_type:
+            try:
+                return status, json.loads(text), text
+            except json.JSONDecodeError:
+                pass
+        return status, None, text
+
+    def _json(self, method: str, path: str, doc: Optional[Dict] = None) -> Dict:
+        status, body, text = self._request(method, path, doc)
+        if status >= 400:
+            raise ServeError(status, body if body is not None else text)
+        if not isinstance(body, dict):
+            raise ServeError(
+                status,
+                {
+                    "error": {
+                        "code": "bad-response",
+                        "message": f"expected a JSON object, got: {text[:200]}",
+                    }
+                },
+            )
+        return body
+
+    # ----------------------------------------------------------------- verbs
+
+    def submit(
+        self,
+        flags: Sequence[str],
+        kind: str = "pca",
+        deadline_seconds: Optional[float] = None,
+        tag: Optional[str] = None,
+    ) -> Dict:
+        """Submit one job; returns the job envelope (``doc["job"]["id"]``
+        is the handle). Raises :class:`ServeError` on every rejection —
+        ``.body["plan"]`` carries the admission validator's facts."""
+        return self._json(
+            "POST",
+            "/v1/jobs",
+            request_doc(
+                flags, kind=kind, deadline_seconds=deadline_seconds, tag=tag
+            ),
+        )
+
+    def status(self, job_id: str) -> Dict:
+        return self._json("GET", f"/v1/jobs/{job_id}")
+
+    def cancel(self, job_id: str) -> Dict:
+        return self._json("POST", f"/v1/jobs/{job_id}/cancel")
+
+    def wait(
+        self, job_id: str, timeout: float = 600.0, poll_seconds: float = 0.2
+    ) -> Dict:
+        """Poll until the job reaches a terminal status; raises
+        :class:`TimeoutError` past ``timeout``."""
+        deadline = time.monotonic() + timeout
+        while True:
+            doc = self.status(job_id)
+            if doc["job"]["status"] in TERMINAL_STATUSES:
+                return doc
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"job {job_id} still {doc['job']['status']!r} after "
+                    f"{timeout}s"
+                )
+            time.sleep(poll_seconds)
+
+    def metrics(self) -> str:
+        status, _body, text = self._request("GET", "/metrics")
+        if status >= 400:
+            raise ServeError(status, text)
+        return text
+
+    def healthz(self) -> Dict:
+        return self._json("GET", "/healthz")
+
+
+def submit_main(argv: Optional[Sequence[str]] = None) -> int:
+    """The ``submit`` CLI verb; see the module docstring."""
+    parser = argparse.ArgumentParser(prog="spark_examples_tpu submit")
+    parser.add_argument(
+        "--url", required=True, help="Service base URL (see serve --port)."
+    )
+    parser.add_argument(
+        "--kind", choices=["pca", "similarity"], default="pca"
+    )
+    parser.add_argument("--deadline-seconds", type=float, default=None)
+    parser.add_argument("--tag", default=None)
+    parser.add_argument(
+        "--no-wait",
+        action="store_true",
+        help="Print the job id and return without polling.",
+    )
+    parser.add_argument(
+        "--timeout",
+        type=float,
+        default=600.0,
+        help="Polling timeout in seconds (with waiting enabled).",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="Print the final job/error envelope as JSON.",
+    )
+    parser.add_argument(
+        "flags",
+        nargs=argparse.REMAINDER,
+        help="PCA flag namespace after '--' (forwarded verbatim).",
+    )
+    ns = parser.parse_args(list(argv) if argv is not None else None)
+    flags = list(ns.flags)
+    if flags and flags[0] == "--":
+        flags = flags[1:]
+
+    client = ServeClient(ns.url)
+    try:
+        doc = client.submit(
+            flags,
+            kind=ns.kind,
+            deadline_seconds=ns.deadline_seconds,
+            tag=ns.tag,
+        )
+    except ServeError as e:
+        body = e.body if isinstance(e.body, dict) else {"raw": e.body}
+        print(json.dumps({"http_status": e.status, **body}, indent=2))
+        return 2
+    job_id = doc["job"]["id"]
+    if ns.no_wait:
+        print(json.dumps(doc, indent=2) if ns.json else job_id)
+        return 0
+    try:
+        doc = client.wait(job_id, timeout=ns.timeout)
+    except TimeoutError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    job = doc["job"]
+    if ns.json:
+        print(json.dumps(doc, indent=2))
+    elif job["status"] == "done":
+        result = job.get("result") or {}
+        for line in result.get("pc_lines") or []:
+            print(line)
+        if "similarity" in result:
+            print(json.dumps(result["similarity"], indent=2))
+        print(
+            f"job {job_id} done in {job['seconds']:.3f}s "
+            f"(compile cache {job['compile_cache']}; "
+            f"manifest {job['manifest_path']})",
+            file=sys.stderr,
+        )
+    else:
+        print(
+            f"job {job_id} {job['status']}: {job.get('error')}",
+            file=sys.stderr,
+        )
+    return 0 if job["status"] == "done" else 1
+
+
+__all__ = ["MAX_RESPONSE_BYTES", "ServeError", "ServeClient", "submit_main"]

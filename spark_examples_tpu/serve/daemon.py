@@ -1,0 +1,546 @@
+"""The resident PCA service: warm process, admission control, one worker.
+
+:class:`PcaService` is the daemon's brain, HTTP-free (``serve/http.py``
+is a thin dispatch onto it, so every behavior is testable in-process):
+
+- **owns the devices**: the backend is initialized ONCE at
+  :meth:`start` (the process-startup cost every batch invocation pays),
+  and a single worker thread executes admitted jobs serially against
+  them — jobs never contend for HBM or compile caches, and the
+  in-process jit caches stay warm across jobs
+  (``utils/cache.py``'s warm-geometry ledger makes that observable);
+- **admits device-free**: every request is validated by the
+  ``graftcheck plan`` validator (``check/plan.py``) BEFORE it may queue —
+  flag-grammar errors, geometry contradictions, HBM/host-memory/exactness
+  violations are structured 4xx bodies carrying the plan facts, and the
+  devices never see a doomed configuration;
+- **schedules two classes**: the bounded admission queue
+  (``serve/queue.py``) drains small-region queries between whole-genome
+  jobs, with per-job deadlines, queued-job cancellation, and 429
+  backpressure past capacity;
+- **drains gracefully**: :meth:`begin_drain` stops admission (503),
+  lets the worker finish every admitted job, then the worker exits —
+  the SIGTERM path of the ``serve`` CLI verb.
+
+Telemetry rides the existing ``obs/`` stack: one service-level
+:class:`~spark_examples_tpu.obs.metrics.MetricsRegistry` (scraped at
+``GET /metrics``), per-request spans in a
+:class:`~spark_examples_tpu.obs.spans.SpanRecorder`, and the standard
+:class:`~spark_examples_tpu.obs.heartbeat.Heartbeat` emitting service
+liveness (queue depth, in-flight, warm/cold compile counts) to stderr.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, Optional, Tuple
+
+from spark_examples_tpu.serve.executor import ExecutionOutcome, execute_job
+from spark_examples_tpu.serve.protocol import (
+    ProtocolError,
+    error_doc,
+    job_doc,
+    parse_request,
+)
+from spark_examples_tpu.serve.queue import (
+    DEFAULT_LARGE_CAPACITY,
+    DEFAULT_SMALL_CAPACITY,
+    BoundedJobQueue,
+    Job,
+    QueueClosed,
+    QueueFull,
+    classify_conf,
+)
+
+#: Plan-rejection codes that are RESOURCE bounds (the request is
+#: well-formed but too big for the declared budgets) — surfaced as HTTP
+#: 413 rather than 400, so clients can distinguish "fix the flags" from
+#: "shrink the request or find a bigger service".
+MEM_LIMIT_CODES = frozenset(
+    {
+        "host-mem-over-budget",
+        "host-mem-unprovable",
+        "dense-exceeds-hbm",
+        "sharded-exceeds-hbm",
+    }
+)
+
+#: Terminal jobs kept queryable after completion (per-job manifests stay
+#: on disk forever; only the in-memory record — result payload included —
+#: is bounded). Without a cap the job table of a long-lived daemon grows
+#: monotonically: the control plane must obey the same bounded-memory
+#: discipline ``graftcheck hostmem`` enforces on ingest.
+DEFAULT_TERMINAL_RETENTION = 256
+
+#: Flags a served job may not carry: multi-controller topology belongs to
+#: the daemon's own launch, and every daemon-host write path belongs to
+#: the service (one canonical per-job directory; see ``serve/executor.py``)
+#: — a client-chosen ``--output-path``/``--profile-dir``/``--save-variants``
+#: would be an arbitrary-path write primitive on the service host.
+_RESERVED_FLAG_FIELDS = (
+    ("coordinator_address", "--coordinator-address"),
+    ("num_processes", "--num-processes"),
+    ("process_id", "--process-id"),
+    ("metrics_json", "--metrics-json"),
+    ("output_path", "--output-path"),
+    ("profile_dir", "--profile-dir"),
+    ("save_variants", "--save-variants"),
+)
+
+
+def _parse_job_flags(flags):
+    """Parse a request's flag list through the REAL PCA parser (never a
+    drifted copy); argparse errors raise ``ValueError``."""
+    from spark_examples_tpu.check.plan import _RaisingParser
+    from spark_examples_tpu.config import PcaConf, build_pca_parser
+
+    parser = build_pca_parser(
+        _RaisingParser(prog="serve-job", add_help=False)
+    )
+    ns = parser.parse_args(list(flags))
+    return PcaConf._from_namespace(ns)
+
+
+class PcaService:
+    """The resident service; see the module docstring for the contract."""
+
+    def __init__(
+        self,
+        run_dir: Optional[str] = None,
+        small_capacity: int = DEFAULT_SMALL_CAPACITY,
+        large_capacity: int = DEFAULT_LARGE_CAPACITY,
+        host_mem_budget: Optional[int] = None,
+        heartbeat_seconds: float = 0.0,
+        executor: Optional[Callable[[Job, str], ExecutionOutcome]] = None,
+        terminal_retention: int = DEFAULT_TERMINAL_RETENTION,
+    ):
+        if terminal_retention < 1:
+            raise ValueError(
+                f"terminal_retention must be >= 1, got {terminal_retention}"
+            )
+        self.run_dir = run_dir or tempfile.mkdtemp(prefix="spark-serve-")
+        self.host_mem_budget = host_mem_budget
+        self.heartbeat_seconds = float(heartbeat_seconds)
+        self.terminal_retention = int(terminal_retention)
+        self._executor = executor if executor is not None else execute_job
+        self._queue = BoundedJobQueue(small_capacity, large_capacity)
+        # lock order: service table lock before nothing — it is a leaf
+        # (job-state flips and table reads only; the queue's own leaf lock
+        # is never taken while holding it: admission puts happen outside).
+        self._lock = threading.Lock()
+        self._table: Dict[str, Job] = {}
+        self._terminal_order: Deque[str] = deque()
+        self._seq = 0
+        self._inflight = 0
+        self._terminal = 0
+        self._draining = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+        self._heartbeat = None
+        self._started_unix: Optional[float] = None
+        self.device_count: Optional[int] = None
+        self.platform: Optional[str] = None
+
+        from spark_examples_tpu.obs import MetricsRegistry, SpanRecorder
+
+        self.registry = MetricsRegistry()
+        self.spans = SpanRecorder()
+        self._register_metrics()
+
+    # ------------------------------------------------------------ telemetry
+
+    def _register_metrics(self) -> None:
+        from spark_examples_tpu.obs.metrics import (
+            COMPILE_CACHE_GEOMETRY_HITS,
+            COMPILE_CACHE_GEOMETRY_MISSES,
+            HOST_PEAK_RSS_BYTES,
+            SERVE_JOBS_DONE,
+            SERVE_JOBS_INFLIGHT,
+            SERVE_QUEUE_DEPTH,
+            read_host_peak_rss_bytes,
+            well_known_gauge,
+        )
+        from spark_examples_tpu.utils.cache import compile_cache_stats
+
+        well_known_gauge(self.registry, SERVE_QUEUE_DEPTH).set_function(
+            lambda: float(self._queue.total_depth())
+        )
+        well_known_gauge(self.registry, SERVE_JOBS_INFLIGHT).set_function(
+            lambda: float(self._inflight)
+        )
+        well_known_gauge(self.registry, SERVE_JOBS_DONE).set_function(
+            lambda: float(self._terminal)
+        )
+        well_known_gauge(
+            self.registry, COMPILE_CACHE_GEOMETRY_HITS
+        ).set_function(lambda: float(compile_cache_stats()[0]))
+        well_known_gauge(
+            self.registry, COMPILE_CACHE_GEOMETRY_MISSES
+        ).set_function(lambda: float(compile_cache_stats()[1]))
+        if read_host_peak_rss_bytes() is not None:
+            well_known_gauge(self.registry, HOST_PEAK_RSS_BYTES).set_function(
+                lambda: float(read_host_peak_rss_bytes() or 0)
+            )
+        self._submitted = self.registry.counter(
+            "serve_jobs_submitted_total",
+            "Jobs admitted to the queue, by admission class.",
+            labelnames=("job_class",),
+        )
+        self._rejected = self.registry.counter(
+            "serve_jobs_rejected_total",
+            "Requests rejected at admission, by rejection code.",
+            labelnames=("code",),
+        )
+        self._completed = self.registry.counter(
+            "serve_jobs_completed_total",
+            "Jobs that reached a terminal state, by status.",
+            labelnames=("status",),
+        )
+        self._job_seconds = self.registry.histogram(
+            "serve_job_seconds",
+            "Wall-clock of completed jobs, by admission class.",
+            labelnames=("job_class",),
+        )
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "PcaService":
+        """Initialize the backend (the once-per-process cost), start the
+        worker and the optional service heartbeat."""
+        if self._worker is not None:
+            return self
+        import jax
+
+        # The warm-mesh moment: devices enumerate here, once; every
+        # admitted job reuses this initialized backend (and, for repeated
+        # geometries, its live jit caches).
+        self.device_count = jax.device_count()
+        self.platform = jax.devices()[0].platform
+        os.makedirs(self.run_dir, exist_ok=True)
+        self._started_unix = time.time()
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="serve-worker", daemon=True
+        )
+        self._worker.start()
+        if self.heartbeat_seconds > 0:
+            from spark_examples_tpu.obs.heartbeat import Heartbeat
+
+            self._heartbeat = Heartbeat(
+                self.heartbeat_seconds, self.registry
+            ).start()
+        return self
+
+    def begin_drain(self) -> None:
+        """Stop admission (new submissions get 503); already-admitted jobs
+        still run to completion."""
+        self._draining.set()
+        self._queue.close()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def wait_drained(self, timeout: Optional[float] = None) -> bool:
+        """Block until the worker finished every admitted job and exited
+        (call :meth:`begin_drain` first). Returns ``False`` on timeout."""
+        worker = self._worker
+        if worker is None:
+            return True
+        worker.join(timeout=timeout)
+        alive = worker.is_alive()
+        if not alive and self._heartbeat is not None:
+            self._heartbeat.stop()
+            self._heartbeat = None
+        return not alive
+
+    def stop(self, timeout: float = 30.0) -> bool:
+        """Drain and join (tests and the CLI's shutdown path)."""
+        self.begin_drain()
+        return self.wait_drained(timeout=timeout)
+
+    # ------------------------------------------------------------ admission
+
+    def submit(self, doc) -> Tuple[int, Dict]:
+        """One ``POST /v1/jobs`` body → ``(http_status, response_doc)``."""
+        if self.draining:
+            self._rejected.labels(code="draining").inc()
+            return 503, error_doc(
+                "draining",
+                "service is draining; submit to another replica",
+                retry_after_seconds=30.0,
+            )
+        try:
+            request = parse_request(doc)
+        except ProtocolError as e:
+            self._rejected.labels(code=e.code).inc()
+            return 400, error_doc(e.code, e.message)
+        try:
+            conf = _parse_job_flags(request.flags)
+        except ValueError as e:
+            self._rejected.labels(code="flag-grammar").inc()
+            return 400, error_doc("flag-grammar", str(e))
+        for field, flag in _RESERVED_FLAG_FIELDS:
+            # `is not None`, not truthiness: --process-id 0 is the
+            # canonical coordinator id and must be rejected like any other.
+            if getattr(conf, field, None) is not None:
+                self._rejected.labels(code="reserved-flag").inc()
+                return 400, error_doc(
+                    "reserved-flag",
+                    f"{flag} is owned by the service and may not ride a "
+                    "served job (manifests land at the per-job path; "
+                    "multi-controller topology belongs to the daemon "
+                    "launch)",
+                )
+
+        # Device-free admission validation: the graftcheck plan validator
+        # over the daemon's REAL device count and host-memory budget. An
+        # exit-2 plan becomes a structured 4xx carrying the plan facts.
+        from spark_examples_tpu.check.plan import validate_plan
+
+        report = validate_plan(
+            conf,
+            plan_devices=self.device_count,
+            host_mem_budget=self.host_mem_budget,
+        )
+        plan_block = {
+            "ok": report.ok,
+            "issues": [
+                {"code": i.code, "severity": i.severity, "message": i.message}
+                for i in report.issues
+            ],
+            "geometry": report.geometry,
+            "shape_checks": report.shape_checks,
+        }
+        if not report.ok:
+            error_codes = [
+                i.code for i in report.issues if i.severity == "error"
+            ]
+            status = (
+                413 if any(c in MEM_LIMIT_CODES for c in error_codes) else 400
+            )
+            self._rejected.labels(code="plan-rejected").inc()
+            return status, error_doc(
+                "plan-rejected",
+                "admission plan validation rejected this configuration: "
+                + "; ".join(error_codes),
+                plan=plan_block,
+            )
+
+        now = time.time()
+        with self._lock:
+            self._seq += 1
+            job_id = f"job-{self._seq:06d}"
+        job = Job(
+            id=job_id,
+            request=request,
+            conf=conf,
+            job_class=classify_conf(conf),
+            submitted_unix=now,
+            deadline_unix=(
+                now + request.deadline_seconds
+                if request.deadline_seconds is not None
+                else None
+            ),
+            plan_geometry=dict(report.geometry),
+        )
+        with self._lock:
+            self._table[job.id] = job
+        try:
+            self._queue.put(job)
+        except QueueFull as e:
+            with self._lock:
+                del self._table[job.id]
+            self._rejected.labels(code="queue-full").inc()
+            return 429, error_doc(
+                "queue-full", str(e), retry_after_seconds=5.0
+            )
+        except QueueClosed as e:
+            with self._lock:
+                del self._table[job.id]
+            self._rejected.labels(code="draining").inc()
+            return 503, error_doc(
+                "draining", str(e), retry_after_seconds=30.0
+            )
+        self._submitted.labels(job_class=job.job_class).inc()
+        return 202, self._job_doc(job)
+
+    # --------------------------------------------------------------- lookup
+
+    def job_status(self, job_id: str) -> Tuple[int, Dict]:
+        with self._lock:
+            job = self._table.get(job_id)
+            if job is None:
+                return 404, error_doc(
+                    "unknown-job", f"no job {job_id!r} on this service"
+                )
+            return 200, self._job_doc_locked(job)
+
+    def cancel(self, job_id: str) -> Tuple[int, Dict]:
+        """Cancel one still-queued job; running and finished jobs conflict
+        (the serial worker cannot abandon a dispatched pipeline without
+        poisoning the device state every other job shares)."""
+        with self._lock:
+            job = self._table.get(job_id)
+        if job is None:
+            return 404, error_doc(
+                "unknown-job", f"no job {job_id!r} on this service"
+            )
+        removed = self._queue.remove(job_id)
+        with self._lock:
+            if removed is not None and job.status == "queued":
+                job.status = "cancelled"
+                job.finished_unix = time.time()
+                self._mark_terminal_locked(job)
+                doc = self._job_doc_locked(job)
+            elif job.status in ("running", "queued"):
+                # status 'queued' with removed=None is the pop window:
+                # the worker claimed the job but has not flipped it to
+                # running yet — it IS about to run, report it as such.
+                return 409, error_doc(
+                    "job-running",
+                    f"job {job_id} is already on the devices; a running "
+                    "job cannot be cancelled",
+                )
+            else:
+                return 409, error_doc(
+                    "job-finished",
+                    f"job {job_id} already reached status {job.status!r}",
+                )
+        self._completed.labels(status="cancelled").inc()
+        return 200, doc
+
+    # ---------------------------------------------------------------- state
+
+    def healthz(self) -> Dict:
+        """Mesh/queue liveness (``GET /healthz``)."""
+        worker = self._worker
+        uptime = (
+            time.time() - self._started_unix
+            if self._started_unix is not None
+            else None
+        )
+        with self._lock:
+            inflight = self._inflight
+            terminal = self._terminal
+            total = len(self._table)
+        return {
+            "status": "draining" if self.draining else "ok",
+            "mesh": {
+                "devices": self.device_count,
+                "platform": self.platform,
+            },
+            "queue": {
+                "depth": self._queue.depth(),
+                "capacity": {
+                    "small": self._queue.small_capacity,
+                    "large": self._queue.large_capacity,
+                },
+                "worker_alive": worker is not None and worker.is_alive(),
+            },
+            "jobs": {
+                "tracked": total,
+                "inflight": inflight,
+                "terminal": terminal,
+            },
+            "uptime_seconds": uptime,
+            "run_dir": self.run_dir,
+        }
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition (``GET /metrics``) — the registry's
+        existing export, unchanged."""
+        return self.registry.prometheus_text()
+
+    def _mark_terminal_locked(self, job: Job) -> None:
+        """Lifetime counter + bounded retention: the oldest terminal
+        records past ``terminal_retention`` leave the table (their
+        manifests stay on disk; a later status query is 404 by design —
+        the in-memory control plane must stay O(retention), not O(jobs
+        ever served)."""
+        self._terminal += 1
+        self._terminal_order.append(job.id)
+        while len(self._terminal_order) > self.terminal_retention:
+            evicted = self._terminal_order.popleft()
+            self._table.pop(evicted, None)
+
+    def _job_doc(self, job: Job) -> Dict:
+        with self._lock:
+            return self._job_doc_locked(job)
+
+    def _job_doc_locked(self, job: Job) -> Dict:
+        return job_doc(
+            job_id=job.id,
+            kind=job.request.kind,
+            job_class=job.job_class,
+            status=job.status,
+            tag=job.request.tag,
+            submitted_unix=job.submitted_unix,
+            started_unix=job.started_unix,
+            finished_unix=job.finished_unix,
+            seconds=job.seconds,
+            error=job.error,
+            result=job.result,
+            manifest_path=job.manifest_path,
+            compile_cache=job.compile_cache,
+            plan_geometry=job.plan_geometry,
+        )
+
+    # --------------------------------------------------------------- worker
+
+    def _worker_loop(self) -> None:
+        while True:
+            job = self._queue.pop(timeout=0.2)
+            if job is None:
+                if self._queue.drained:
+                    return
+                continue
+            self._run_job(job)
+
+    def _run_job(self, job: Job) -> None:
+        now = time.time()
+        if job.deadline_unix is not None and now > job.deadline_unix:
+            with self._lock:
+                job.status = "failed"
+                job.error = (
+                    f"deadline-exceeded: queued "
+                    f"{now - job.submitted_unix:.1f}s, deadline was "
+                    f"{job.deadline_unix - job.submitted_unix:.1f}s"
+                )
+                job.finished_unix = now
+                self._mark_terminal_locked(job)
+            self._completed.labels(status="failed").inc()
+            return
+        with self._lock:
+            job.status = "running"
+            job.started_unix = now
+            self._inflight = 1
+        started = time.perf_counter()
+        outcome: Optional[ExecutionOutcome] = None
+        error: Optional[str] = None
+        try:
+            with self.spans.span(f"job {job.id} [{job.request.kind}]"):
+                outcome = self._executor(job, self.run_dir)
+        except Exception as e:  # noqa: BLE001 — the job FAILS, the service lives
+            error = f"{type(e).__name__}: {e}"
+        seconds = time.perf_counter() - started
+        with self._lock:
+            job.finished_unix = time.time()
+            job.seconds = seconds
+            self._inflight = 0
+            self._mark_terminal_locked(job)
+            if error is not None:
+                job.status = "failed"
+                job.error = error
+            else:
+                job.status = "done"
+                job.result = outcome.result
+                job.manifest_path = outcome.manifest_path
+                job.compile_cache = outcome.compile_cache
+        self._completed.labels(status=job.status).inc()
+        self._job_seconds.labels(job_class=job.job_class).observe(seconds)
+
+
+__all__ = ["MEM_LIMIT_CODES", "PcaService"]

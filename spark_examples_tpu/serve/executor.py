@@ -1,0 +1,132 @@
+"""Per-job execution: one admitted job through the reusable pipeline core.
+
+``pipeline.pca_driver.run_pipeline`` is the library entry point the
+batch CLI and this executor share — a served job executes the IDENTICAL
+pipeline a batch invocation would, and produces the identical schema-v2
+run manifest. The executor's additions are service concerns only:
+
+- **per-job manifest placement**: every job's manifest is written to
+  ``<run_dir>/jobs/<job_id>/manifest.json`` (atomic rename, validated
+  after the run), so batch and served runs produce the same artifact and
+  a scheduler can collect per-request provenance;
+- **warm-vs-cold attribution**: the job's geometry fingerprint is checked
+  against the process-wide warm-geometry ledger (``utils/cache.py``)
+  BEFORE the run, so the job record says whether it rode the resident
+  daemon's warm compile caches — the compile-once promise, observable
+  per job;
+- **stdout capture**: the pipeline prints its result rows and epilogue;
+  a resident daemon must not interleave job output on its own stdout, so
+  each job's prints land in ``jobs/<job_id>/stdout.log``. The capture is
+  THREAD-ROUTED (:class:`_ThreadStdoutRouter`), not a process-global
+  ``redirect_stdout``: only the worker thread's writes divert to the job
+  log, so HTTP threads (and an embedding test harness) keep their own
+  stdout while a job is mid-flight.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from spark_examples_tpu.serve.queue import Job
+
+
+class _ThreadStdoutRouter(io.TextIOBase):
+    """``sys.stdout`` stand-in for the job window: writes from the worker
+    thread land in the job's log, every other thread passes through to
+    the previous stdout untouched."""
+
+    def __init__(self, fallback, thread_id: int, sink):
+        self._fallback = fallback
+        self._thread_id = thread_id
+        self._sink = sink
+
+    def _target(self):
+        return (
+            self._sink
+            if threading.get_ident() == self._thread_id
+            else self._fallback
+        )
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, text: str) -> int:
+        return self._target().write(text)
+
+    def flush(self) -> None:
+        self._target().flush()
+
+
+@dataclass
+class ExecutionOutcome:
+    """What one completed job hands back to the daemon's job table."""
+
+    result: Dict
+    manifest_path: Optional[str]
+    compile_cache: str  # "warm" | "cold"
+
+
+def job_directory(run_dir: str, job_id: str) -> str:
+    return os.path.join(run_dir, "jobs", job_id)
+
+
+def execute_job(job: Job, run_dir: str) -> ExecutionOutcome:
+    """Run one admitted job to completion (the daemon's single worker
+    thread calls this serially — jobs never share the devices)."""
+    from spark_examples_tpu.obs.manifest import validate_manifest
+    from spark_examples_tpu.pipeline.pca_driver import run_pipeline
+    from spark_examples_tpu.utils.cache import (
+        compile_fingerprint,
+        geometry_seen,
+    )
+
+    job_dir = job_directory(run_dir, job.id)
+    os.makedirs(job_dir, exist_ok=True)
+    conf = job.conf
+    # The service owns manifest placement (admission rejects an explicit
+    # --metrics-json): one canonical per-job path, same schema as batch.
+    conf.metrics_json = os.path.join(job_dir, "manifest.json")
+    warm = geometry_seen(compile_fingerprint(conf, kind=job.request.kind))
+
+    similarity_only = job.request.kind == "similarity"
+    with open(
+        os.path.join(job_dir, "stdout.log"), "w", encoding="utf-8"
+    ) as captured:
+        previous = sys.stdout
+        sys.stdout = _ThreadStdoutRouter(
+            previous, threading.get_ident(), captured
+        )
+        try:
+            pipeline = run_pipeline(conf, similarity_only=similarity_only)
+        finally:
+            sys.stdout = previous
+
+    if pipeline.manifest_path is None:
+        raise RuntimeError(
+            f"job {job.id} completed but its manifest was not written "
+            f"(expected {conf.metrics_json})"
+        )
+    errors = validate_manifest(pipeline.manifest)
+    if errors:
+        raise RuntimeError(
+            f"job {job.id} produced an invalid run manifest: "
+            + "; ".join(errors)
+        )
+
+    if similarity_only:
+        result: Dict = {"similarity": pipeline.similarity_summary}
+    else:
+        result = {"pc_lines": pipeline.lines}
+    return ExecutionOutcome(
+        result=result,
+        manifest_path=pipeline.manifest_path,
+        compile_cache="warm" if warm else "cold",
+    )
+
+
+__all__ = ["ExecutionOutcome", "execute_job", "job_directory"]

@@ -1,0 +1,348 @@
+"""Thin stdlib HTTP front-end of the resident PCA service.
+
+No new dependencies: ``http.server.ThreadingHTTPServer`` carries the
+JSON protocol (``serve/protocol.py``) onto :class:`PcaService`
+(``serve/daemon.py``). Routes:
+
+- ``POST /v1/jobs``            — submit (202 admitted; 400/413 plan
+  rejection with the plan facts in the body; 429 backpressure; 503
+  draining)
+- ``GET  /v1/jobs/<id>``       — job status/result
+- ``POST /v1/jobs/<id>/cancel``— cancel a queued job (409 once running)
+- ``GET  /metrics``            — Prometheus text export of the service
+  registry (``obs/metrics.py``)
+- ``GET  /healthz``            — mesh/queue liveness JSON
+
+``serve_main`` is the ``python -m spark_examples_tpu serve`` entry
+point: it initializes the backend once, binds the server (``--port 0``
+picks an ephemeral port; ``--endpoint-file`` publishes the bound URL for
+scripts), and installs the graceful-drain signal handlers — SIGTERM (or
+SIGINT) stops admission with 503, lets the worker finish every admitted
+job, then exits 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+from spark_examples_tpu.serve.daemon import (
+    DEFAULT_TERMINAL_RETENTION,
+    PcaService,
+)
+from spark_examples_tpu.serve.protocol import error_doc
+from spark_examples_tpu.serve.queue import (
+    DEFAULT_LARGE_CAPACITY,
+    DEFAULT_SMALL_CAPACITY,
+)
+
+#: Largest accepted request body: a flag list is hundreds of bytes; one
+#: MiB of headroom keeps admission O(1) in host memory no matter what a
+#: client posts (oversized bodies are 413 without being read further).
+MAX_BODY_BYTES = 1 << 20
+
+
+class ServeHandler(BaseHTTPRequestHandler):
+    """One request; ``self.server.service`` is the :class:`PcaService`."""
+
+    server_version = "spark-examples-tpu-serve/1"
+    protocol_version = "HTTP/1.1"
+
+    # ------------------------------------------------------------- plumbing
+
+    def log_message(self, format: str, *args) -> None:  # noqa: A002
+        if getattr(self.server, "verbose", False):
+            sys.stderr.write(
+                f"serve[{self.address_string()}]: {format % args}\n"
+            )
+
+    def _send_json(self, status: int, doc) -> None:
+        body = (json.dumps(doc, indent=2) + "\n").encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, status: int, text: str, content_type: str) -> None:
+        body = text.encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json_body(self):
+        """The request body as parsed JSON, or ``None`` after an error
+        response was already sent."""
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+        except ValueError:
+            length = -1
+        if length < 0 or length > MAX_BODY_BYTES:
+            # The oversized body stays unread; the connection cannot be
+            # reused (leftover bytes would parse as the next request).
+            self.close_connection = True
+            self._send_json(
+                413,
+                error_doc(
+                    "body-too-large",
+                    f"request body must be <= {MAX_BODY_BYTES} bytes",
+                ),
+            )
+            return None
+        raw = self.rfile.read(length)
+        try:
+            return json.loads(raw.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            self._send_json(
+                400, error_doc("bad-json", f"request body is not JSON: {e}")
+            )
+            return None
+
+    # --------------------------------------------------------------- routes
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server's spelling
+        service: PcaService = self.server.service
+        if self.path == "/healthz":
+            self._send_json(200, service.healthz())
+            return
+        if self.path == "/metrics":
+            self._send_text(
+                200,
+                service.metrics_text(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+            return
+        if self.path.startswith("/v1/jobs/"):
+            job_id = self.path[len("/v1/jobs/"):]
+            if job_id and "/" not in job_id:
+                status, doc = service.job_status(job_id)
+                self._send_json(status, doc)
+                return
+        self._send_json(
+            404, error_doc("not-found", f"no route GET {self.path}")
+        )
+
+    def _drain_body(self) -> None:
+        """Consume a request body this route ignores: on a keep-alive
+        connection unread bytes would parse as the NEXT request line.
+        Oversized bodies close the connection instead of being read."""
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+        except ValueError:
+            length = -1
+        if length < 0 or length > MAX_BODY_BYTES:
+            self.close_connection = True
+            return
+        if length:
+            self.rfile.read(length)
+
+    def do_POST(self) -> None:  # noqa: N802
+        service: PcaService = self.server.service
+        if self.path == "/v1/jobs":
+            doc = self._read_json_body()
+            if doc is None:
+                return
+            status, body = service.submit(doc)
+            self._send_json(status, body)
+            return
+        self._drain_body()
+        if self.path.startswith("/v1/jobs/") and self.path.endswith("/cancel"):
+            job_id = self.path[len("/v1/jobs/"):-len("/cancel")]
+            if job_id and "/" not in job_id:
+                status, body = service.cancel(job_id)
+                self._send_json(status, body)
+                return
+        self._send_json(
+            404, error_doc("not-found", f"no route POST {self.path}")
+        )
+
+
+class ServeServer(ThreadingHTTPServer):
+    """Bound server carrying the service; request threads are daemons so
+    a drain never waits on an idle keep-alive connection."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, address, service: PcaService, verbose: bool = False):
+        super().__init__(address, ServeHandler)
+        self.service = service
+        self.verbose = verbose
+
+    @property
+    def url(self) -> str:
+        host, port = self.server_address[:2]
+        return f"http://{host}:{port}"
+
+
+def start_server(
+    service: PcaService,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    verbose: bool = False,
+) -> ServeServer:
+    """Bind (port 0 = ephemeral) and serve in a background thread; the
+    in-process form tests and embedders use. The caller owns shutdown:
+    ``server.shutdown()`` then ``service.stop()``."""
+    server = ServeServer((host, port), service, verbose=verbose)
+    thread = threading.Thread(
+        target=server.serve_forever, name="serve-http", daemon=True
+    )
+    thread.start()
+    return server
+
+
+def _write_endpoint_file(path: str, url: str) -> None:
+    """Atomic publish of the bound URL (scripts poll for this file)."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(url + "\n")
+    os.replace(tmp, path)
+
+
+def serve_main(argv: Optional[Sequence[str]] = None) -> int:
+    """The ``serve`` CLI verb (``python -m spark_examples_tpu serve``)."""
+    parser = argparse.ArgumentParser(prog="spark_examples_tpu serve")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument(
+        "--port",
+        type=int,
+        default=8765,
+        help="Listen port (0 = ephemeral; see --endpoint-file).",
+    )
+    parser.add_argument(
+        "--run-dir",
+        default=None,
+        help=(
+            "Service run directory: per-job manifests and captured stdout "
+            "land under <run-dir>/jobs/<job-id>/. Default: a fresh "
+            "temporary directory (path printed at startup)."
+        ),
+    )
+    parser.add_argument(
+        "--queue-small",
+        type=int,
+        default=DEFAULT_SMALL_CAPACITY,
+        help="Small-class admission queue capacity (default %(default)s).",
+    )
+    parser.add_argument(
+        "--queue-large",
+        type=int,
+        default=DEFAULT_LARGE_CAPACITY,
+        help="Large-class admission queue capacity (default %(default)s).",
+    )
+    parser.add_argument(
+        "--host-mem-budget",
+        type=int,
+        default=None,
+        metavar="BYTES",
+        help=(
+            "Admission host-RAM budget: jobs whose static bound "
+            "(parallel/mesh.py:host_peak_bytes) exceeds it — or whose "
+            "ingest path is O(file) and therefore unprovable — are "
+            "rejected 413 at admission."
+        ),
+    )
+    parser.add_argument(
+        "--heartbeat-seconds",
+        type=float,
+        default=0.0,
+        help="Service heartbeat interval on stderr (0 = off).",
+    )
+    parser.add_argument(
+        "--terminal-retention",
+        type=int,
+        default=DEFAULT_TERMINAL_RETENTION,
+        metavar="N",
+        help=(
+            "Completed jobs kept queryable in memory (default "
+            "%(default)s); older terminal records are evicted — their "
+            "per-job manifests stay on disk under --run-dir."
+        ),
+    )
+    parser.add_argument(
+        "--endpoint-file",
+        default=None,
+        metavar="PATH",
+        help="Write the bound URL here once listening (atomic).",
+    )
+    parser.add_argument(
+        "--verbose", action="store_true", help="Log every HTTP request."
+    )
+    ns = parser.parse_args(list(argv) if argv is not None else None)
+
+    service = PcaService(
+        run_dir=ns.run_dir,
+        small_capacity=ns.queue_small,
+        large_capacity=ns.queue_large,
+        terminal_retention=ns.terminal_retention,
+        host_mem_budget=ns.host_mem_budget,
+        heartbeat_seconds=ns.heartbeat_seconds,
+    )
+    service.start()
+    server = ServeServer((ns.host, ns.port), service, verbose=ns.verbose)
+    if ns.endpoint_file:
+        _write_endpoint_file(ns.endpoint_file, server.url)
+
+    def _drain_then_shutdown() -> None:
+        service.wait_drained()
+        server.shutdown()
+
+    def _on_signal(signum, _frame) -> None:
+        print(
+            f"serve: received signal {signum}; draining "
+            "(new jobs get 503, admitted jobs finish)",
+            file=sys.stderr,
+            flush=True,
+        )
+        service.begin_drain()
+        threading.Thread(
+            target=_drain_then_shutdown, name="serve-drain", daemon=True
+        ).start()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    print(
+        f"serve: listening on {server.url} "
+        f"(devices={service.device_count} platform={service.platform} "
+        f"run_dir={service.run_dir})",
+        file=sys.stderr,
+        flush=True,
+    )
+    try:
+        server.serve_forever()
+    finally:
+        server.server_close()
+    drained = service.wait_drained(timeout=60.0)
+    # The drain verdict is decided; a late duplicate SIGTERM (an impatient
+    # supervisor re-signaling) must not flip the exit code to 143 during
+    # interpreter teardown — the OS-level disposition outlives Python's
+    # handler machinery.
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    signal.signal(signal.SIGINT, signal.SIG_IGN)
+    print(
+        "serve: drained cleanly"
+        if drained
+        else "serve: worker did not drain within 60s",
+        file=sys.stderr,
+        flush=True,
+    )
+    return 0 if drained else 1
+
+
+__all__ = [
+    "MAX_BODY_BYTES",
+    "ServeHandler",
+    "ServeServer",
+    "start_server",
+    "serve_main",
+]

@@ -1,0 +1,233 @@
+"""Bounded two-class admission queue of the resident PCA service.
+
+One serial worker owns the devices, so scheduling is a pure ordering
+decision — and the ordering contract is: **small-region queries are never
+starved by whole-genome jobs**. Jobs are classified at admission
+(:func:`classify_conf`) into ``small`` (statically-bounded synthetic site
+count at or under :data:`SMALL_JOB_MAX_SITES` — the 0.229 s BRCA1 shape)
+and ``large`` (everything else: whole-genome ``--all-references``, file
+and checkpoint cohorts whose size only the data knows). The worker drains
+every queued small job before starting the next large one, so a queued
+whole-genome run delays cheap queries by at most the job currently on
+the devices — never by other queued long jobs.
+
+Both classes are bounded; an admission past capacity raises
+:class:`QueueFull`, which the HTTP layer surfaces as 429 backpressure
+(the client retries with backoff; the service never buffers unboundedly
+— the host-memory discipline of ``graftcheck hostmem`` applied to the
+control plane). Queued jobs can be cancelled and carry optional
+deadlines: a job still unstarted past its deadline fails at dequeue time
+without touching the devices.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, Optional
+
+from spark_examples_tpu.serve.protocol import JobRequest
+
+SMALL_CLASS = "small"
+LARGE_CLASS = "large"
+
+#: Largest statically-bounded candidate-site count still admitted as a
+#: small-region query. The synthetic grid has one candidate site per
+#: ``sources/synthetic.py:DEFAULT_VARIANT_SPACING`` (100) bases, so this
+#: is ~25 Mb of reference — two orders of magnitude above the BRCA1
+#: window (~812 sites) and two below a whole genome (~28.9 M sites).
+SMALL_JOB_MAX_SITES = 250_000
+
+#: Default class capacities: small queries are cheap to hold (they drain
+#: between large jobs), large jobs each pin minutes-to-hours of device
+#: time so a short queue IS the honest backpressure.
+DEFAULT_SMALL_CAPACITY = 16
+DEFAULT_LARGE_CAPACITY = 4
+
+
+class QueueFull(Exception):
+    """Admission past a class's capacity (HTTP 429)."""
+
+    def __init__(self, job_class: str, capacity: int):
+        super().__init__(
+            f"{job_class} admission queue is full ({capacity} queued)"
+        )
+        self.job_class = job_class
+        self.capacity = capacity
+
+
+class QueueClosed(Exception):
+    """Admission after drain began (HTTP 503)."""
+
+
+@dataclass
+class Job:
+    """One admitted job. Mutable state (status, timestamps, result) is
+    guarded by the owning service's table lock (``serve/daemon.py``) —
+    the queue only ever holds jobs whose status is ``queued``."""
+
+    id: str
+    request: JobRequest
+    conf: object
+    job_class: str
+    submitted_unix: float
+    deadline_unix: Optional[float] = None
+    plan_geometry: Dict = field(default_factory=dict)
+    status: str = "queued"
+    started_unix: Optional[float] = None
+    finished_unix: Optional[float] = None
+    seconds: Optional[float] = None
+    error: Optional[str] = None
+    result: Optional[Dict] = None
+    manifest_path: Optional[str] = None
+    compile_cache: Optional[str] = None
+
+
+def classify_conf(conf) -> str:
+    """``small`` iff the configuration's candidate-site count is
+    statically bounded (synthetic source, explicit ``--references``, no
+    checkpoint resume) at or under :data:`SMALL_JOB_MAX_SITES`; every
+    cohort whose size only the data knows is ``large`` — the conservative
+    direction: misclassifying a big job as small starves real small jobs,
+    misclassifying a small job as large only queues it fairly."""
+    if (
+        getattr(conf, "source", "synthetic") != "synthetic"
+        or getattr(conf, "all_references", False)
+        or getattr(conf, "input_path", None)
+    ):
+        return LARGE_CLASS
+    try:
+        from spark_examples_tpu.sources.synthetic import DEFAULT_VARIANT_SPACING
+
+        sites = sum(
+            (contig.end - contig.start) // DEFAULT_VARIANT_SPACING + 1
+            for contigs in conf.get_references()
+            for contig in contigs
+        )
+    except (ValueError, TypeError, AttributeError):
+        return LARGE_CLASS
+    return SMALL_CLASS if sites <= SMALL_JOB_MAX_SITES else LARGE_CLASS
+
+
+class BoundedJobQueue:
+    """Two bounded FIFO lanes + one condition variable. ``pop`` always
+    serves the small lane first (the batching contract); within a lane,
+    admission order is preserved."""
+
+    def __init__(
+        self,
+        small_capacity: int = DEFAULT_SMALL_CAPACITY,
+        large_capacity: int = DEFAULT_LARGE_CAPACITY,
+    ):
+        if small_capacity < 1 or large_capacity < 1:
+            raise ValueError(
+                f"queue capacities must be >= 1, got small={small_capacity} "
+                f"large={large_capacity}"
+            )
+        self.small_capacity = int(small_capacity)
+        self.large_capacity = int(large_capacity)
+        # lock order: queue lock is a leaf — nothing else is acquired
+        # while holding it (machine-checked by `graftcheck lockgraph`).
+        self._lock = threading.Lock()
+        # lock order: the condition shares the queue leaf lock above.
+        self._nonempty = threading.Condition(self._lock)
+        self._small: Deque[Job] = deque()
+        self._large: Deque[Job] = deque()
+        self._closed = False
+
+    # ------------------------------------------------------------ admission
+
+    def put(self, job: Job) -> None:
+        """Admit one queued job; raises :class:`QueueClosed` after drain
+        began and :class:`QueueFull` past the class capacity. Never
+        blocks — backpressure is the caller's 429, not a stalled socket."""
+        with self._nonempty:
+            if self._closed:
+                raise QueueClosed("service is draining; no new jobs")
+            lane, capacity = (
+                (self._small, self.small_capacity)
+                if job.job_class == SMALL_CLASS
+                else (self._large, self.large_capacity)
+            )
+            if len(lane) >= capacity:
+                raise QueueFull(job.job_class, capacity)
+            lane.append(job)
+            self._nonempty.notify()
+
+    # -------------------------------------------------------------- worker
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[Job]:
+        """Next job for the worker — every queued small job ahead of any
+        large one. Returns ``None`` on timeout or when the queue is
+        closed and empty (check :meth:`drained` to distinguish)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._nonempty:
+            while not self._small and not self._large:
+                if self._closed:
+                    return None
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._nonempty.wait(remaining)
+            lane = self._small if self._small else self._large
+            return lane.popleft()
+
+    # ---------------------------------------------------------- management
+
+    def remove(self, job_id: str) -> Optional[Job]:
+        """Pull one still-queued job out (cancellation); ``None`` when the
+        worker already claimed it."""
+        with self._lock:
+            for lane in (self._small, self._large):
+                for job in lane:
+                    if job.id == job_id:
+                        lane.remove(job)
+                        return job
+        return None
+
+    def close(self) -> None:
+        """Stop admission (drain): pending jobs still pop; new puts raise
+        :class:`QueueClosed`; blocked pops wake."""
+        with self._nonempty:
+            self._closed = True
+            self._nonempty.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    @property
+    def drained(self) -> bool:
+        """Closed AND empty — the worker's exit condition."""
+        with self._lock:
+            return self._closed and not self._small and not self._large
+
+    def depth(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                SMALL_CLASS: len(self._small),
+                LARGE_CLASS: len(self._large),
+            }
+
+    def total_depth(self) -> int:
+        with self._lock:
+            return len(self._small) + len(self._large)
+
+
+__all__ = [
+    "SMALL_CLASS",
+    "LARGE_CLASS",
+    "SMALL_JOB_MAX_SITES",
+    "DEFAULT_SMALL_CAPACITY",
+    "DEFAULT_LARGE_CAPACITY",
+    "QueueFull",
+    "QueueClosed",
+    "Job",
+    "classify_conf",
+    "BoundedJobQueue",
+]

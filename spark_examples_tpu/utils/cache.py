@@ -1,13 +1,28 @@
-"""Shared persistent XLA compile cache configuration.
+"""Shared persistent XLA compile cache configuration + warm-geometry ledger.
 
 First TPU compile of a shape costs tens of seconds; the CLI and the
 benchmark reuse one cache location (outside the repo, so compile artifacts
 never enter git — a 152 MB lesson from round 1).
+
+The warm-geometry ledger is the resident service's half of the story
+(``serve/``): a process-wide record of every analysis geometry this
+process has already run. The fingerprint covers exactly the flags that
+shape compiled programs (cohort width, block size, mesh, strategy, dtype
+ladder, ingest path), so a repeated geometry inside one process — the
+compile-once promise of the daemon — is a *hit* and a fresh geometry is a
+*miss*. The counters are exported as well-known gauges
+(``obs/metrics.py``), sampled by the heartbeat, and recorded in the run
+manifest's ``compile_cache`` block: warm-vs-cold is observable, not
+inferred from wall-clock.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+import threading
+from typing import Optional, Set, Tuple
 
 
 def enable_persistent_compile_cache() -> None:
@@ -34,4 +49,102 @@ def enable_persistent_compile_cache() -> None:
         )
 
 
-__all__ = ["enable_persistent_compile_cache"]
+# ---------------------------------------------------------------------------
+# Warm-geometry ledger (process-wide; the serve/ executor's cache key).
+# ---------------------------------------------------------------------------
+
+#: Conf fields that do NOT shape compiled programs: output/telemetry
+#: placement and credentials. Everything else (cohort, block size, mesh,
+#: strategy, dtype flags, ingest path, references, input files) is part of
+#: the geometry — conservative on purpose: a fingerprint hit promises the
+#: in-process jit caches are warm for every kernel this run dispatches.
+_NON_GEOMETRY_FIELDS = frozenset(
+    {
+        "output_path",
+        "metrics_json",
+        "heartbeat_seconds",
+        "profile_dir",
+        "client_secrets",
+        "spark_master",
+    }
+)
+
+# lock order: geometry-ledger lock is a leaf — nothing else is acquired
+# while holding it (machine-checked by `graftcheck lockgraph`).
+_geometry_lock = threading.Lock()
+_seen_geometries: Set[str] = set()
+_geometry_hits = 0
+_geometry_misses = 0
+
+
+def compile_fingerprint(conf, kind: str = "pca") -> str:
+    """Stable digest of one analysis geometry: every conf field except the
+    output/telemetry placement flags, canonically serialized. ``kind``
+    ("pca" | "similarity") is part of the geometry — a similarity-only run
+    never compiles the center/eigh kernels, so it must not pre-warm the
+    PCA fingerprint. Two equal fingerprints compile (and dispatch)
+    identical programs."""
+    fields = getattr(conf, "__dataclass_fields__", None)
+    if fields is not None:
+        doc = {
+            name: getattr(conf, name)
+            for name in sorted(fields)
+            if name not in _NON_GEOMETRY_FIELDS
+        }
+    else:  # mapping-shaped confs (tests)
+        doc = {
+            k: v
+            for k, v in sorted(dict(conf).items())
+            if k not in _NON_GEOMETRY_FIELDS
+        }
+    doc["__kind__"] = kind
+    blob = json.dumps(doc, sort_keys=True, default=repr)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+
+def geometry_seen(key: str) -> bool:
+    """Has this process already run (and therefore compiled) ``key``?
+    Read-only: no counter moves, no ledger mutation."""
+    with _geometry_lock:
+        return key in _seen_geometries
+
+
+def record_geometry(key: str) -> bool:
+    """Record one run of geometry ``key``; returns ``True`` when the
+    geometry was already warm (hit) and ``False`` on first sight (miss).
+    The hit/miss counters move exactly once per call."""
+    global _geometry_hits, _geometry_misses
+    with _geometry_lock:
+        if key in _seen_geometries:
+            _geometry_hits += 1
+            return True
+        _seen_geometries.add(key)
+        _geometry_misses += 1
+        return False
+
+
+def compile_cache_stats() -> Tuple[int, int]:
+    """Process-wide ``(hits, misses)`` of the warm-geometry ledger."""
+    with _geometry_lock:
+        return _geometry_hits, _geometry_misses
+
+
+def reset_compile_cache_stats() -> None:
+    """Clear the ledger and counters (tests and bench isolation only —
+    the daemon never resets: its counters are the service's lifetime
+    warm-vs-cold record)."""
+    global _geometry_hits, _geometry_misses
+    with _geometry_lock:
+        _seen_geometries.clear()
+        _geometry_hits = 0
+        _geometry_misses = 0
+
+
+__all__ = [
+    "enable_persistent_compile_cache",
+    "compile_fingerprint",
+    "geometry_seen",
+    "record_geometry",
+    "compile_cache_stats",
+    "reset_compile_cache_stats",
+]

@@ -1,0 +1,705 @@
+"""Resident PCA service (serve/): protocol round-trip and version
+rejection, the admission 400/413/429 matrix mirroring the plan
+accept/reject matrix, small-job batching ahead of a queued long job,
+cancellation, graceful-drain 503, /metrics well-known names, and the
+warm-cache e2e (identical resubmit reports a compile-cache hit and lower
+latency)."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from spark_examples_tpu.serve.client import ServeClient, ServeError
+from spark_examples_tpu.serve.daemon import MEM_LIMIT_CODES, PcaService
+from spark_examples_tpu.serve.executor import ExecutionOutcome
+from spark_examples_tpu.serve.http import start_server
+from spark_examples_tpu.serve.protocol import (
+    PROTOCOL_ID,
+    PROTOCOL_VERSION,
+    ProtocolError,
+    error_doc,
+    parse_request,
+    request_doc,
+)
+from spark_examples_tpu.serve.queue import (
+    LARGE_CLASS,
+    SMALL_CLASS,
+    BoundedJobQueue,
+    Job,
+    QueueClosed,
+    QueueFull,
+    classify_conf,
+)
+
+TINY_FLAGS = ["--num-samples", "8", "--references", "1:0:50000"]
+#: 300k candidate sites on the synthetic grid — past SMALL_JOB_MAX_SITES.
+LARGE_FLAGS = ["--num-samples", "8", "--references", "1:0:30000000"]
+
+
+# ---------------------------------------------------------------- protocol
+
+
+def test_protocol_round_trip():
+    doc = request_doc(
+        TINY_FLAGS, kind="similarity", deadline_seconds=5.0, tag="t1"
+    )
+    req = parse_request(json.loads(json.dumps(doc)))
+    assert req.kind == "similarity"
+    assert list(req.flags) == TINY_FLAGS
+    assert req.deadline_seconds == 5.0
+    assert req.tag == "t1"
+
+
+def test_protocol_version_rejected():
+    doc = request_doc(TINY_FLAGS)
+    doc["protocol"]["version"] = PROTOCOL_VERSION + 1
+    with pytest.raises(ProtocolError) as e:
+        parse_request(doc)
+    assert e.value.code == "unsupported-protocol-version"
+
+
+@pytest.mark.parametrize(
+    "mutate, code",
+    [
+        (lambda d: d.pop("protocol"), "protocol-missing"),
+        (lambda d: d["protocol"].update(id="other/proto"), "protocol-id"),
+        (lambda d: d.update(kind="mystery"), "unknown-kind"),
+        (lambda d: d.update(flags="--num-samples 8"), "bad-flags"),
+        (lambda d: d.update(deadline_seconds=-1), "bad-deadline"),
+        (lambda d: d.update(surprise=True), "unknown-field"),
+    ],
+)
+def test_protocol_schema_violations(mutate, code):
+    doc = request_doc(TINY_FLAGS)
+    mutate(doc)
+    with pytest.raises(ProtocolError) as e:
+        parse_request(doc)
+    assert e.value.code == code
+
+
+def test_error_doc_carries_protocol_and_plan():
+    doc = error_doc("plan-rejected", "nope", plan={"issues": []})
+    assert doc["protocol"]["id"] == PROTOCOL_ID
+    assert doc["error"]["code"] == "plan-rejected"
+    assert doc["plan"] == {"issues": []}
+
+
+# ------------------------------------------------------------------- queue
+
+
+def _job(job_id, job_class):
+    return Job(
+        id=job_id,
+        request=parse_request(request_doc(TINY_FLAGS)),
+        conf=None,
+        job_class=job_class,
+        submitted_unix=time.time(),
+    )
+
+
+def test_queue_small_class_pops_first():
+    q = BoundedJobQueue(small_capacity=4, large_capacity=4)
+    q.put(_job("L1", LARGE_CLASS))
+    q.put(_job("S1", SMALL_CLASS))
+    q.put(_job("L2", LARGE_CLASS))
+    q.put(_job("S2", SMALL_CLASS))
+    order = [q.pop(timeout=1).id for _ in range(4)]
+    assert order == ["S1", "S2", "L1", "L2"]
+
+
+def test_queue_bounded_and_closed():
+    q = BoundedJobQueue(small_capacity=1, large_capacity=1)
+    q.put(_job("S1", SMALL_CLASS))
+    with pytest.raises(QueueFull):
+        q.put(_job("S2", SMALL_CLASS))
+    q.put(_job("L1", LARGE_CLASS))
+    assert q.depth() == {SMALL_CLASS: 1, LARGE_CLASS: 1}
+    q.close()
+    with pytest.raises(QueueClosed):
+        q.put(_job("S3", SMALL_CLASS))
+    # Pending jobs still pop after close; then drained.
+    assert q.pop(timeout=1).id == "S1"
+    assert not q.drained
+    assert q.pop(timeout=1).id == "L1"
+    assert q.pop(timeout=0.05) is None
+    assert q.drained
+
+
+def test_queue_remove_only_while_queued():
+    q = BoundedJobQueue()
+    q.put(_job("S1", SMALL_CLASS))
+    assert q.remove("S1").id == "S1"
+    assert q.remove("S1") is None
+
+
+def test_classify_conf():
+    from spark_examples_tpu.config import PcaConf
+
+    small = PcaConf()
+    small.references = "17:41196311:41277499"  # BRCA1: ~812 sites
+    assert classify_conf(small) == SMALL_CLASS
+    big = PcaConf()
+    big.references = "1:0:30000000"
+    assert classify_conf(big) == LARGE_CLASS
+    whole = PcaConf()
+    whole.all_references = True
+    assert classify_conf(whole) == LARGE_CLASS
+    filed = PcaConf()
+    filed.source = "file"
+    assert classify_conf(filed) == LARGE_CLASS
+
+
+# --------------------------------------------------------------- admission
+
+
+class GateExecutor:
+    """Stub executor: records execution order, blocks until released —
+    the scheduling/cancel/backpressure tests' controllable worker."""
+
+    def __init__(self):
+        self.order = []
+        self.release = threading.Event()
+        self.started = threading.Event()
+
+    def __call__(self, job, run_dir):
+        self.order.append(job.id)
+        self.started.set()
+        assert self.release.wait(timeout=30), "gate never released"
+        return ExecutionOutcome(
+            result={"stub": True}, manifest_path=None, compile_cache="cold"
+        )
+
+
+@pytest.fixture
+def gated_service(tmp_path):
+    """A started service with a gated stub executor (no real pipeline)."""
+    gate = GateExecutor()
+    service = PcaService(
+        run_dir=str(tmp_path / "serve"),
+        small_capacity=1,
+        large_capacity=2,
+        executor=gate,
+    ).start()
+    yield service, gate
+    gate.release.set()
+    service.stop(timeout=30)
+
+
+def test_admission_rejects_protocol_and_flag_errors(gated_service):
+    service, _gate = gated_service
+    status, body = service.submit({"protocol": "nope"})
+    assert status == 400 and body["error"]["code"] == "protocol-missing"
+    bad_version = request_doc(TINY_FLAGS)
+    bad_version["protocol"]["version"] = 99
+    status, body = service.submit(bad_version)
+    assert status == 400
+    assert body["error"]["code"] == "unsupported-protocol-version"
+    status, body = service.submit(request_doc(["--no-such-flag"]))
+    assert status == 400 and body["error"]["code"] == "flag-grammar"
+    status, body = service.submit(
+        request_doc(TINY_FLAGS + ["--metrics-json", "/tmp/x.json"])
+    )
+    assert status == 400 and body["error"]["code"] == "reserved-flag"
+    # Falsy-but-set reserved values must reject too: 0 is the canonical
+    # process id.
+    status, body = service.submit(
+        request_doc(TINY_FLAGS + ["--process-id", "0"])
+    )
+    assert status == 400 and body["error"]["code"] == "reserved-flag"
+    # Every daemon-host write path is reserved — a client-chosen output
+    # location would be an arbitrary-path write on the service host.
+    for flag in ("--output-path", "--profile-dir", "--save-variants"):
+        status, body = service.submit(
+            request_doc(TINY_FLAGS + [flag, "/tmp/evil"])
+        )
+        assert status == 400 and body["error"]["code"] == "reserved-flag", (
+            flag
+        )
+
+
+def test_admission_mirrors_plan_rejections(gated_service):
+    """Plan-invalid configurations are 400s whose body carries the SAME
+    issue codes `graftcheck plan` exits 2 with."""
+    service, _gate = gated_service
+    for flags, expected_code in [
+        (["--num-samples", "8", "--num-pc", "99"], "num-pc-exceeds-cohort"),
+        (["--block-size", "0"], "block-size"),
+        (
+            ["--mesh-shape", "16,1", "--num-reduce-partitions", "16"],
+            "mesh-exceeds-devices",  # 8 virtual devices in conftest
+        ),
+        (["--references", "bogus"], "references-grammar"),
+    ]:
+        status, body = service.submit(request_doc(flags))
+        assert status == 400, flags
+        assert body["error"]["code"] == "plan-rejected"
+        codes = [i["code"] for i in body["plan"]["issues"]]
+        assert expected_code in codes, (flags, codes)
+    # The plan facts ride the rejection body (geometry block present).
+    assert "geometry" in body["plan"]
+
+
+def test_admission_memory_rejections_are_413(tmp_path):
+    gate = GateExecutor()
+    service = PcaService(
+        run_dir=str(tmp_path / "serve"),
+        host_mem_budget=1 << 20,  # 1 MiB: nothing fits
+        executor=gate,
+    ).start()
+    try:
+        sharded = [
+            "--num-samples", "64", "--references", "1:0:400000",
+            "--mesh-shape", "1,4", "--similarity-strategy", "sharded",
+            "--block-size", "64",
+        ]
+        status, body = service.submit(request_doc(sharded))
+        assert status == 413
+        codes = [i["code"] for i in body["plan"]["issues"]]
+        assert "host-mem-over-budget" in codes
+        assert set(codes) & MEM_LIMIT_CODES
+        # An O(file)-ingest config cannot be proven under a budget at all.
+        status, body = service.submit(
+            request_doc(
+                ["--source", "file", "--input-files", "cohort.vcf"]
+                + TINY_FLAGS
+            )
+        )
+        assert status == 413
+        assert "host-mem-unprovable" in [
+            i["code"] for i in body["plan"]["issues"]
+        ]
+    finally:
+        gate.release.set()
+        service.stop(timeout=30)
+
+
+def test_admission_backpressure_429(gated_service):
+    service, gate = gated_service
+    status, first = service.submit(request_doc(TINY_FLAGS))
+    assert status == 202
+    assert gate.started.wait(timeout=10)  # worker claimed the first job
+    status, _ = service.submit(request_doc(TINY_FLAGS))
+    assert status == 202  # fills the small lane (capacity 1)
+    status, body = service.submit(request_doc(TINY_FLAGS))
+    assert status == 429
+    assert body["error"]["code"] == "queue-full"
+    assert body["error"]["retry_after_seconds"] > 0
+
+
+def test_small_jobs_batch_ahead_of_queued_large_job(gated_service):
+    service, gate = gated_service
+    # L1 occupies the worker; L2 queues; smalls submitted AFTER L2 must
+    # still run before it.
+    _, l1 = service.submit(request_doc(LARGE_FLAGS))
+    assert gate.started.wait(timeout=10)
+    _, l2 = service.submit(request_doc(LARGE_FLAGS))
+    _, s1 = service.submit(request_doc(TINY_FLAGS))
+    assert l2["job"]["class"] == LARGE_CLASS
+    assert s1["job"]["class"] == SMALL_CLASS
+    gate.release.set()
+    deadline = time.monotonic() + 30
+    while len(gate.order) < 3 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert gate.order == [
+        l1["job"]["id"],
+        s1["job"]["id"],
+        l2["job"]["id"],
+    ]
+
+
+def test_cancellation_matrix(gated_service):
+    service, gate = gated_service
+    _, running = service.submit(request_doc(TINY_FLAGS))
+    assert gate.started.wait(timeout=10)
+    _, queued = service.submit(request_doc(TINY_FLAGS))
+    # Queued: cancellable.
+    status, body = service.cancel(queued["job"]["id"])
+    assert status == 200 and body["job"]["status"] == "cancelled"
+    # Running: conflict.
+    status, body = service.cancel(running["job"]["id"])
+    assert status == 409 and body["error"]["code"] == "job-running"
+    # Unknown: 404.
+    status, body = service.cancel("job-999999")
+    assert status == 404 and body["error"]["code"] == "unknown-job"
+    # Terminal: conflict.
+    gate.release.set()
+    deadline = time.monotonic() + 30
+    while service.job_status(running["job"]["id"])[1]["job"][
+        "status"
+    ] not in ("done", "failed") and time.monotonic() < deadline:
+        time.sleep(0.02)
+    status, body = service.cancel(running["job"]["id"])
+    assert status == 409 and body["error"]["code"] == "job-finished"
+    # The cancelled job stayed cancelled (the worker never ran it).
+    assert service.job_status(queued["job"]["id"])[1]["job"][
+        "status"
+    ] == "cancelled"
+    assert queued["job"]["id"] not in gate.order
+
+
+def test_deadline_exceeded_fails_without_running(gated_service):
+    service, gate = gated_service
+    _, blocker = service.submit(request_doc(TINY_FLAGS))
+    assert gate.started.wait(timeout=10)
+    _, doomed = service.submit(
+        json.loads(
+            json.dumps(request_doc(TINY_FLAGS, deadline_seconds=0.2))
+        )
+    )
+    time.sleep(0.5)  # deadline passes while queued behind the blocker
+    gate.release.set()
+    deadline = time.monotonic() + 30
+    while service.job_status(doomed["job"]["id"])[1]["job"]["status"] not in (
+        "done",
+        "failed",
+    ) and time.monotonic() < deadline:
+        time.sleep(0.02)
+    _, body = service.job_status(doomed["job"]["id"])
+    assert body["job"]["status"] == "failed"
+    assert "deadline-exceeded" in body["job"]["error"]
+    assert doomed["job"]["id"] not in gate.order
+
+
+def test_terminal_retention_bounds_the_job_table(tmp_path):
+    """The control plane stays O(retention): old terminal records evict
+    (404 afterwards), recent ones remain queryable."""
+
+    class InstantExecutor:
+        def __call__(self, job, run_dir):
+            return ExecutionOutcome(
+                result={"ok": True}, manifest_path=None, compile_cache="cold"
+            )
+
+    service = PcaService(
+        run_dir=str(tmp_path / "serve"),
+        executor=InstantExecutor(),
+        terminal_retention=2,
+    ).start()
+    try:
+        ids = []
+        for _ in range(5):
+            status, doc = service.submit(request_doc(TINY_FLAGS))
+            assert status == 202
+            ids.append(doc["job"]["id"])
+            deadline = time.monotonic() + 10
+            while (
+                service.job_status(ids[-1])[0] == 200
+                and service.job_status(ids[-1])[1]["job"]["status"]
+                != "done"
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+        # Only the newest `terminal_retention` jobs remain queryable.
+        assert service.job_status(ids[-1])[0] == 200
+        assert service.job_status(ids[-2])[0] == 200
+        for old in ids[:-2]:
+            assert service.job_status(old)[0] == 404
+        # The lifetime gauge still counts every terminal job.
+        assert service.healthz()["jobs"]["terminal"] == 5
+        assert service.healthz()["jobs"]["tracked"] == 2
+    finally:
+        service.stop(timeout=30)
+
+
+def test_graceful_drain_503_and_worker_exit(gated_service):
+    service, gate = gated_service
+    _, inflight = service.submit(request_doc(TINY_FLAGS))
+    assert gate.started.wait(timeout=10)
+    service.begin_drain()
+    assert service.healthz()["status"] == "draining"
+    status, body = service.submit(request_doc(TINY_FLAGS))
+    assert status == 503 and body["error"]["code"] == "draining"
+    gate.release.set()
+    assert service.wait_drained(timeout=30)
+    # The in-flight job finished rather than being dropped.
+    _, body = service.job_status(inflight["job"]["id"])
+    assert body["job"]["status"] == "done"
+    assert not service.healthz()["queue"]["worker_alive"]
+
+
+# ------------------------------------------------------- HTTP layer + e2e
+
+
+@pytest.fixture
+def http_service(tmp_path):
+    """Real executor behind a real HTTP server on an ephemeral port."""
+    service = PcaService(run_dir=str(tmp_path / "serve")).start()
+    server = start_server(service)
+    yield service, ServeClient(server.url)
+    server.shutdown()
+    service.stop(timeout=60)
+
+
+def test_http_routes_and_health(http_service):
+    service, client = http_service
+    health = client.healthz()
+    assert health["status"] == "ok"
+    assert health["mesh"]["devices"] >= 1
+    assert health["queue"]["worker_alive"]
+    with pytest.raises(ServeError) as e:
+        client.status("job-404404")
+    assert e.value.status == 404
+    # Unknown route and non-JSON body are structured errors, not tracebacks.
+    with pytest.raises(ServeError) as e:
+        client._json("GET", "/v1/nothing")
+    assert e.value.status == 404
+    req = urllib.request.Request(
+        client.url + "/v1/jobs",
+        data=b"not json",
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        urllib.request.urlopen(req, timeout=10)
+        raised = None
+    except urllib.error.HTTPError as err:
+        raised = err.code
+        body = json.loads(err.read().decode())
+    assert raised == 400 and body["error"]["code"] == "bad-json"
+
+
+def test_keep_alive_connection_survives_ignored_bodies(http_service):
+    """Routes that ignore request bodies must still drain them: on a
+    persistent connection, unread bytes would parse as the next request
+    line."""
+    import http.client
+    from urllib.parse import urlparse
+
+    _service, client = http_service
+    parsed = urlparse(client.url)
+    conn = http.client.HTTPConnection(parsed.hostname, parsed.port, timeout=10)
+    try:
+        conn.request(
+            "POST",
+            "/v1/jobs/job-nope/cancel",
+            body=b'{"ignored": "body"}',
+            headers={"Content-Type": "application/json"},
+        )
+        first = conn.getresponse()
+        first.read()
+        assert first.status == 404
+        # The SAME connection must serve the next request cleanly.
+        conn.request("GET", "/healthz")
+        second = conn.getresponse()
+        assert second.status == 200
+        assert b'"status"' in second.read()
+    finally:
+        conn.close()
+
+
+def test_http_plan_rejection_body(http_service):
+    _service, client = http_service
+    with pytest.raises(ServeError) as e:
+        client.submit(["--num-samples", "8", "--num-pc", "99"])
+    assert e.value.status == 400
+    assert e.value.code == "plan-rejected"
+    assert "num-pc-exceeds-cohort" in [
+        i["code"] for i in e.value.body["plan"]["issues"]
+    ]
+
+
+@pytest.mark.slow
+def test_warm_cache_e2e_and_per_job_manifest(http_service):
+    """The compile-once promise, end to end over HTTP: job 1 is cold, the
+    identical resubmit is warm (hit counter moves, latency drops), and
+    every job writes a valid schema-v2 manifest at its per-job path."""
+    from spark_examples_tpu.obs.manifest import (
+        manifest_metric_value,
+        read_manifest,
+        validate_manifest,
+    )
+    from spark_examples_tpu.obs.metrics import COMPILE_CACHE_GEOMETRY_HITS
+    from spark_examples_tpu.utils.cache import reset_compile_cache_stats
+
+    service, client = http_service
+    reset_compile_cache_stats()
+    flags = TINY_FLAGS + ["--seed", "1234"]  # geometry unique to this test
+
+    job1 = client.wait(client.submit(flags)["job"]["id"], timeout=300)["job"]
+    assert job1["status"] == "done"
+    assert job1["compile_cache"] == "cold"
+    assert len(job1["result"]["pc_lines"]) == 8
+
+    # Per-job manifest: exists under the service run dir, schema-valid,
+    # and records the warm-geometry counters (v2-additive compile_cache).
+    path = job1["manifest_path"]
+    assert path.startswith(os.path.join(service.run_dir, "jobs"))
+    doc = read_manifest(path)
+    assert validate_manifest(doc) == []
+    assert doc["compile_cache"]["geometry_misses"] >= 1
+    assert manifest_metric_value(doc, COMPILE_CACHE_GEOMETRY_HITS) is not None
+
+    job2 = client.wait(client.submit(flags)["job"]["id"], timeout=300)["job"]
+    assert job2["status"] == "done"
+    assert job2["compile_cache"] == "warm"
+    assert job2["result"]["pc_lines"] == job1["result"]["pc_lines"]
+    # Warm latency: no XLA compile in the path — decisively faster.
+    assert job2["seconds"] < job1["seconds"]
+    # The hit is visible in the scrape, not inferred.
+    scrape = client.metrics()
+    hits = [
+        line
+        for line in scrape.splitlines()
+        if line.startswith(COMPILE_CACHE_GEOMETRY_HITS + " ")
+    ]
+    assert hits and float(hits[0].split()[1]) >= 1
+
+
+@pytest.mark.slow
+def test_similarity_kind_over_http(http_service):
+    _service, client = http_service
+    doc = client.wait(
+        client.submit(TINY_FLAGS, kind="similarity")["job"]["id"],
+        timeout=300,
+    )
+    job = doc["job"]
+    assert job["status"] == "done"
+    summary = job["result"]["similarity"]
+    assert summary["shape"] == [8, 8]
+    assert summary["nonzero_rows"] == 8
+    assert summary["trace"] > 0
+
+
+def test_metrics_scrape_well_known_names(http_service):
+    _service, client = http_service
+    scrape = client.metrics()
+    from spark_examples_tpu.obs.metrics import (
+        COMPILE_CACHE_GEOMETRY_HITS,
+        COMPILE_CACHE_GEOMETRY_MISSES,
+        SERVE_JOBS_DONE,
+        SERVE_JOBS_INFLIGHT,
+        SERVE_QUEUE_DEPTH,
+    )
+
+    for name in (
+        SERVE_QUEUE_DEPTH,
+        SERVE_JOBS_INFLIGHT,
+        SERVE_JOBS_DONE,
+        COMPILE_CACHE_GEOMETRY_HITS,
+        COMPILE_CACHE_GEOMETRY_MISSES,
+        "serve_jobs_submitted_total",
+        "serve_jobs_rejected_total",
+        "serve_jobs_completed_total",
+        "serve_job_seconds",
+    ):
+        assert f"# TYPE {name} " in scrape, name
+
+
+def test_service_heartbeat_line_shows_serve_segments(tmp_path):
+    from spark_examples_tpu.obs.heartbeat import Heartbeat
+
+    gate = GateExecutor()
+    service = PcaService(
+        run_dir=str(tmp_path / "serve"), executor=gate
+    ).start()
+    try:
+        service.submit(request_doc(TINY_FLAGS))
+        assert gate.started.wait(timeout=10)
+        line = Heartbeat(60.0, service.registry).line()
+        assert "serve queue" in line
+        assert "in-flight 1" in line
+        assert "compile cache" in line
+    finally:
+        gate.release.set()
+        service.stop(timeout=30)
+
+
+# ------------------------------------------------------------ submit verb
+
+
+def test_submit_cli_verb_no_wait(http_service, capsys):
+    from spark_examples_tpu.serve.client import submit_main
+
+    _service, client = http_service
+    rc = submit_main(["--url", client.url, "--no-wait", "--"] + TINY_FLAGS)
+    assert rc == 0
+    job_id = capsys.readouterr().out.strip()
+    assert job_id.startswith("job-")
+    # The thread-routed job capture must NOT swallow this main-thread
+    # print even while the job is mid-flight; finish it anyway so the
+    # fixture teardown has nothing left to drain.
+    client.wait(job_id, timeout=300)
+    capsys.readouterr()
+    # Rejections print the body and exit 2.
+    rc = submit_main(
+        ["--url", client.url, "--", "--num-samples", "8", "--num-pc", "99"]
+    )
+    assert rc == 2
+    body = json.loads(capsys.readouterr().out)
+    assert body["http_status"] == 400
+    assert body["error"]["code"] == "plan-rejected"
+
+
+# ------------------------------------------------------ library entry point
+
+
+def test_run_pipeline_is_cli_equivalent(tmp_path):
+    """The executor's library entry point returns exactly what the CLI
+    prints — the refactor moved `pca_driver` internals, not behavior."""
+    from spark_examples_tpu.config import PcaConf
+    from spark_examples_tpu.pipeline.pca_driver import run, run_pipeline
+
+    argv = TINY_FLAGS + ["--metrics-json", str(tmp_path / "m.json")]
+    lines = run(argv)
+    result = run_pipeline(PcaConf.parse(argv))
+    assert result.lines == lines
+    assert result.manifest is not None
+    assert result.manifest_path == str(tmp_path / "m.json")
+    sim = run_pipeline(PcaConf.parse(TINY_FLAGS), similarity_only=True)
+    assert sim.lines == []
+    assert sim.similarity_summary["shape"] == [8, 8]
+
+
+def test_compile_fingerprint_ignores_placement_flags():
+    from spark_examples_tpu.config import PcaConf
+    from spark_examples_tpu.utils.cache import compile_fingerprint
+
+    a = PcaConf.parse(TINY_FLAGS)
+    b = PcaConf.parse(TINY_FLAGS + ["--metrics-json", "/tmp/elsewhere.json"])
+    c = PcaConf.parse(["--num-samples", "16", "--references", "1:0:50000"])
+    assert compile_fingerprint(a) == compile_fingerprint(b)
+    assert compile_fingerprint(a) != compile_fingerprint(c)
+    # The job kind is geometry: similarity-only runs compile a strict
+    # subset of the PCA kernels, so they must not share a fingerprint.
+    assert compile_fingerprint(a, kind="similarity") != compile_fingerprint(
+        a, kind="pca"
+    )
+
+
+def test_geometry_ledger_warms_only_on_success(tmp_path):
+    from spark_examples_tpu.config import PcaConf
+    from spark_examples_tpu.pipeline.pca_driver import run_pipeline
+    from spark_examples_tpu.utils.cache import (
+        compile_fingerprint,
+        geometry_seen,
+        reset_compile_cache_stats,
+    )
+
+    reset_compile_cache_stats()
+    try:
+        # A run that dies before its kernels compile must not warm the
+        # fingerprint — a retry would falsely report "warm".
+        bad = PcaConf.parse(
+            [
+                "--source",
+                "file",
+                "--input-files",
+                str(tmp_path / "missing.vcf"),
+                "--references",
+                "1:0:50000",
+            ]
+        )
+        with pytest.raises(Exception):
+            run_pipeline(bad)
+        assert not geometry_seen(compile_fingerprint(bad))
+        # A completed run warms exactly its own kind.
+        good = PcaConf.parse(TINY_FLAGS)
+        run_pipeline(good, similarity_only=True)
+        assert geometry_seen(compile_fingerprint(good, kind="similarity"))
+        assert not geometry_seen(compile_fingerprint(good, kind="pca"))
+    finally:
+        reset_compile_cache_stats()
