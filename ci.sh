@@ -46,7 +46,16 @@
 #      compile-cache hit (hit counter >= 1 in /metrics), and SIGTERM
 #      drains gracefully: the in-flight job finishes, new jobs get 503,
 #      the daemon exits 0.
-#   6. sanitize (opt-in: `ci.sh --sanitize`) — ASAN/UBSAN/TSAN replay of
+#   6. faults — the robustness smoke, CPU-pinned: an oracle run, the same
+#      run SIGKILLed by a deterministic fault plan at the
+#      checkpoint.post-save kill-point (exit must be 137), then
+#      --resume-from — resumed eigenvectors must be byte-identical to the
+#      oracle and the manifest's resume block must show a real
+#      fast-forward. Then the serve watchdog end to end in-process: an
+#      injected worker crash mid-job must leave the job `failed` with a
+#      structured worker-crashed error, the daemon healthy, the next job
+#      completing, and the drain clean.
+#   7. sanitize (opt-in: `ci.sh --sanitize`) — ASAN/UBSAN/TSAN replay of
 #      the VCF fuzz corpus against the native parser; skips gracefully
 #      when no C++ compiler is available.
 # Run from the repo root. Exit code: first failing stage wins, tier-1 first.
@@ -321,6 +330,111 @@ if [ "$serve_rc" -ne 0 ]; then
 fi
 rm -rf "$SERVE_TMP"
 
+echo "== faults stage (kill/resume parity + serve watchdog) =="
+faults_rc=0
+FAULTS_TMP=$(mktemp -d)
+faults_flags="--num-samples 8 --references 1:0:150000 --ingest packed \
+  --checkpoint-every-sites 40"
+env JAX_PLATFORMS=cpu SPARK_EXAMPLES_TPU_NO_CACHE=1 \
+  python -m spark_examples_tpu variants-pca $faults_flags \
+    --gramian-checkpoint-dir "$FAULTS_TMP/ck-oracle" \
+    --output-path "$FAULTS_TMP/oracle" \
+    > /dev/null 2> "$FAULTS_TMP/oracle.err" || faults_rc=$?
+env JAX_PLATFORMS=cpu SPARK_EXAMPLES_TPU_NO_CACHE=1 \
+    SPARK_EXAMPLES_TPU_FAULTS='kill@checkpoint.post-save#2' \
+  python -m spark_examples_tpu variants-pca $faults_flags \
+    --gramian-checkpoint-dir "$FAULTS_TMP/ck" \
+    --output-path "$FAULTS_TMP/killed" \
+    > /dev/null 2> "$FAULTS_TMP/killed.err"
+kill_rc=$?
+if [ "$kill_rc" -ne 137 ]; then
+  echo "faults smoke: killed run exited $kill_rc, expected 137 (SIGKILL)"
+  faults_rc=1
+fi
+env JAX_PLATFORMS=cpu SPARK_EXAMPLES_TPU_NO_CACHE=1 \
+  python -m spark_examples_tpu variants-pca $faults_flags \
+    --gramian-checkpoint-dir "$FAULTS_TMP/ck" \
+    --resume-from "$FAULTS_TMP/ck" \
+    --output-path "$FAULTS_TMP/resumed" \
+    --metrics-json "$FAULTS_TMP/resumed.json" \
+    > /dev/null 2> "$FAULTS_TMP/resumed.err" || faults_rc=$?
+if [ "$faults_rc" -eq 0 ]; then
+  if ! cmp -s "$FAULTS_TMP/oracle-pca.tsv/part-00000" \
+              "$FAULTS_TMP/resumed-pca.tsv/part-00000"; then
+    echo "faults smoke: resumed eigenvectors DIFFER from the oracle"
+    faults_rc=1
+  fi
+fi
+if [ "$faults_rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python - "$FAULTS_TMP/resumed.json" <<'PYEOF' || faults_rc=$?
+import sys
+from spark_examples_tpu.obs.manifest import read_manifest, validate_manifest
+doc = read_manifest(sys.argv[1])
+errors = validate_manifest(doc)
+if errors:
+    print("resumed manifest INVALID:\n  " + "\n  ".join(errors))
+    sys.exit(1)
+resume = doc.get("resume")
+if not resume or resume["sites_skipped"] <= 0:
+    print(f"resumed manifest carries no resume fast-forward: {resume}")
+    sys.exit(1)
+print(f"kill/resume smoke OK: SIGKILL at checkpoint.post-save#2, resumed "
+      f"past {resume['sites_skipped']} sites, eigenvectors byte-identical")
+PYEOF
+else
+  echo "faults smoke failed (rc=$faults_rc):"
+  tail -5 "$FAULTS_TMP"/*.err 2>/dev/null
+fi
+if [ "$faults_rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu SPARK_EXAMPLES_TPU_NO_CACHE=1 \
+    python - "$FAULTS_TMP" <<'PYEOF' || faults_rc=$?
+import sys, time
+from spark_examples_tpu.serve.daemon import PcaService
+from spark_examples_tpu.serve.executor import ExecutionOutcome
+from spark_examples_tpu.serve.protocol import request_doc
+from spark_examples_tpu.utils import faults
+
+calls = []
+def executor(job, run_dir):
+    calls.append(job.id)
+    return ExecutionOutcome(result={"ok": True}, manifest_path=None,
+                            compile_cache="cold")
+
+def wait_terminal(svc, job_id, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        _s, doc = svc.job_status(job_id)
+        if doc["job"]["status"] in ("done", "failed", "cancelled"):
+            return doc["job"]
+        time.sleep(0.02)
+    raise SystemExit(f"job {job_id} never reached a terminal state")
+
+flags = ["--num-samples", "8", "--references", "1:0:50000"]
+faults.configure("crash@serve.worker.mid-job")
+svc = PcaService(run_dir=sys.argv[1] + "/serve", executor=executor).start()
+_s, doc = svc.submit(request_doc(flags))
+assert _s == 202, doc
+crashed = wait_terminal(svc, doc["job"]["id"])
+if crashed["status"] != "failed" or \
+        not (crashed["error"] or "").startswith("worker-crashed:"):
+    raise SystemExit(f"crashed job not failed structurally: {crashed}")
+health = svc.healthz()
+if health["status"] != "ok" or not health["queue"]["worker_alive"]:
+    raise SystemExit(f"daemon unhealthy after worker crash: {health}")
+_s, doc2 = svc.submit(request_doc(flags))
+assert _s == 202, doc2
+recovered = wait_terminal(svc, doc2["job"]["id"])
+if recovered["status"] != "done":
+    raise SystemExit(f"post-crash job did not complete: {recovered}")
+if not svc.stop(timeout=10.0):
+    raise SystemExit("daemon did not drain after recovery")
+print(f"serve watchdog smoke OK: crash mid-job -> failed "
+      f"({crashed['error'][:40]}...), {health['queue']['worker_restarts']} "
+      "restart, next job done, clean drain")
+PYEOF
+fi
+rm -rf "$FAULTS_TMP"
+
 san_rc=0
 if [ "$SANITIZE" = "1" ]; then
   echo "== sanitizer stage (graftcheck sanitize) =="
@@ -335,4 +449,5 @@ if [ "$hm_rc" -ne 0 ]; then exit "$hm_rc"; fi
 if [ "$obs_rc" -ne 0 ]; then exit "$obs_rc"; fi
 if [ "$ring_rc" -ne 0 ]; then exit "$ring_rc"; fi
 if [ "$serve_rc" -ne 0 ]; then exit "$serve_rc"; fi
+if [ "$faults_rc" -ne 0 ]; then exit "$faults_rc"; fi
 exit "$san_rc"

@@ -660,6 +660,49 @@ def validate_plan(
     except ValueError as e:
         report.error("ring-pack-bits", str(e))
 
+    # Robustness flags (pipeline/checkpoint.py + utils/faults.py): a
+    # checkpointed whole-genome run that only discovers its resume flags
+    # are incoherent AFTER the preemption is the worst possible time.
+    checkpointing = bool(
+        getattr(conf, "gramian_checkpoint_dir", None)
+        or getattr(conf, "resume_from", None)
+    )
+    if checkpointing and conf.pca_backend != "tpu":
+        report.error(
+            "checkpoint-backend",
+            "--gramian-checkpoint-dir/--resume-from snapshot the DEVICE "
+            "accumulator; they need --pca-backend tpu",
+        )
+    if checkpointing and conf.ingest == "device":
+        report.error(
+            "checkpoint-device-ingest",
+            "--ingest device has no host-fed row cursor to checkpoint or "
+            "resume; use --ingest packed or wire (auto falls back for "
+            "checkpointed runs)",
+        )
+    every = getattr(conf, "checkpoint_every_sites", None)
+    if every is not None and every < 1:
+        report.error(
+            "checkpoint-every-sites",
+            f"--checkpoint-every-sites must be >= 1, got {every}",
+        )
+    elif every is not None and not getattr(
+        conf, "gramian_checkpoint_dir", None
+    ):
+        report.warn(
+            "checkpoint-every-sites",
+            "--checkpoint-every-sites without --gramian-checkpoint-dir "
+            "has nothing to snapshot; the cadence is ignored",
+        )
+    fault_plan = getattr(conf, "fault_plan", None)
+    if fault_plan is not None:
+        try:
+            from spark_examples_tpu.utils.faults import parse_plan
+
+            parse_plan(fault_plan)
+        except ValueError as e:
+            report.error("fault-plan", str(e))
+
     # Observability flags: nonsense here only surfaces at the END of an
     # hours-long run (the heartbeat thread refusing to start, or the
     # manifest write failing after the epilogue) — exactly the class of

@@ -168,6 +168,66 @@ def _build_base_parser(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
             "consume this instead of scraping stdout."
         ),
     )
+    # Robustness (pipeline/checkpoint.py): crash-consistent Gramian
+    # checkpointing + resume. The Gramian is additive over variants, so a
+    # preempted/killed analysis pass resumes at O(remaining) device cost
+    # with byte-identical results (the graftcheck-ranges exactness
+    # contracts make this exact, not approximate).
+    parser.add_argument(
+        "--gramian-checkpoint-dir",
+        default=None,
+        metavar="DIR",
+        help=(
+            "Periodically persist the device accumulator state (partial "
+            "Gramian + dtype-ladder position + site cursor + conf "
+            "fingerprint) as one atomically-published artifact under DIR, "
+            "so a killed run can resume without restarting from zero "
+            "(host-fed ingest paths: packed/wire; --pca-backend tpu)."
+        ),
+    )
+    parser.add_argument(
+        "--checkpoint-every-sites",
+        type=int,
+        default=None,
+        metavar="N",
+        help=(
+            "Snapshot cadence for --gramian-checkpoint-dir: one atomic "
+            "checkpoint per N accumulated sites (each costs one "
+            "accumulator sync + one O(N^2) host fetch + write). Default: "
+            "~18 snapshots across a whole genome "
+            "(pipeline/checkpoint.py:DEFAULT_CHECKPOINT_EVERY_SITES)."
+        ),
+    )
+    parser.add_argument(
+        "--resume-from",
+        default=None,
+        metavar="DIR",
+        help=(
+            "Resume an interrupted analysis pass from the newest complete "
+            "Gramian checkpoint in DIR: the artifact's conf fingerprint "
+            "must match this run's flags (CheckpointMismatchError "
+            "otherwise), the persisted partial merges into a fresh "
+            "accumulator, and ingest fast-forwards to the saved cursor. "
+            "No complete artifact yet = start from zero. Point it at the "
+            "same directory as --gramian-checkpoint-dir to keep "
+            "checkpointing while resumed."
+        ),
+    )
+    # Robustness (utils/faults.py): a deterministic fault plan for chaos
+    # testing. Normally injected via the SPARK_EXAMPLES_TPU_FAULTS env var
+    # (subprocess harnesses); the flag form serves interactive repros.
+    parser.add_argument(
+        "--fault-plan",
+        default=None,
+        metavar="SPEC",
+        help=(
+            "Deterministic fault-injection plan (testing): comma-separated "
+            "action@site[#nth][=arg] entries fired at registered "
+            "kill-points and IO boundaries (utils/faults.py:KILL_POINTS/"
+            "IO_POINTS). Equivalent to the SPARK_EXAMPLES_TPU_FAULTS "
+            "environment variable; the flag wins when both are set."
+        ),
+    )
     # Multi-host initialization (jax.distributed) — the analog of pointing
     # the reference at a Spark cluster master (GenomicsConf.scala:50-57).
     # With these set, jax.devices() spans all hosts and the device mesh
@@ -202,6 +262,10 @@ class GenomicsConf:
     seed: int = 42
     heartbeat_seconds: float = 0.0
     metrics_json: Optional[str] = None
+    gramian_checkpoint_dir: Optional[str] = None
+    checkpoint_every_sites: Optional[int] = None
+    resume_from: Optional[str] = None
+    fault_plan: Optional[str] = None
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
@@ -255,6 +319,22 @@ class GenomicsConf:
                 f"--ingest-workers must be >= 0 (0 = serial oracle path), "
                 f"got {conf.ingest_workers}"
             )
+        if (
+            conf.checkpoint_every_sites is not None
+            and conf.checkpoint_every_sites < 1
+        ):
+            raise ValueError(
+                f"--checkpoint-every-sites must be >= 1, got "
+                f"{conf.checkpoint_every_sites} (omit the flag for the "
+                "default cadence)"
+            )
+        if conf.fault_plan is not None:
+            # Fail at parse time with the grammar error, not mid-run: a
+            # typo'd site name must not cost a whole ingest pass. Pure
+            # stdlib (utils/faults.py imports no jax).
+            from spark_examples_tpu.utils.faults import parse_plan
+
+            parse_plan(conf.fault_plan)
         # --blocks-per-dispatch is PcaConf-only; validated here so every
         # parse path shares it. An explicit value must be positive: 0 is not
         # a documented auto spelling (leave the flag unset for auto), and

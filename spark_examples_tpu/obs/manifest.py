@@ -26,7 +26,13 @@ the sampling, so existing consumers are untouched. Still v2 (additive):
 ``compile_cache`` gained ``geometry_hits``/``geometry_misses`` — the
 process-wide warm-geometry ledger (``utils/cache.py``), so a served job's
 manifest records whether its geometry was already compiled in the
-resident daemon.
+resident daemon. Still v2 (additive): the optional ``resume`` block —
+``checkpoint_sites`` (the Gramian-checkpoint cursor this run started
+from), ``sites_skipped`` (ingest rows the resume fast-forward consumed
+without device work), ``faults_injected`` (deterministic faults fired
+in-process, ``utils/faults.py``); present exactly when Gramian
+checkpointing/resume was active (``--gramian-checkpoint-dir`` /
+``--resume-from``), null otherwise.
 
 Multi-host: under ``jax.distributed`` each process carries per-process
 I/O counters. :func:`build_run_manifest` aggregates them across processes
@@ -55,7 +61,13 @@ IO_STAT_FIELDS = (
     "requests",
     "unsuccessful_responses",
     "io_exceptions",
+    "io_retries",
 )
+
+#: IO-stat fields added AFTER schema v2 shipped: every new writer emits
+#: them (``pipeline/stats.py:as_dict``), but the validator treats them as
+#: optional so archived v2 manifests stay valid — the additive contract.
+OPTIONAL_IO_STAT_FIELDS = frozenset({"io_retries"})
 
 
 def _json_safe(value):
@@ -162,13 +174,15 @@ def build_manifest(
     multihost: Optional[Dict] = None,
     host_memory: Optional[Dict] = None,
     gramian_exactness: Optional[Dict] = None,
+    resume: Optional[Dict] = None,
 ) -> Dict:
     """Assemble a manifest from already-snapshotted parts (the low-level
     form; :func:`build_run_manifest` snapshots a live driver). The
     ``host_memory`` block defaults to a fresh OS sample with no static
     bound, so hand-assembled manifests stay schema-valid;
     ``gramian_exactness`` (v2-additive) stays null unless ``--check-ranges``
-    sampling ran."""
+    sampling ran; ``resume`` (v2-additive) stays null unless Gramian
+    checkpointing/resume was active."""
     return {
         "schema": {"id": MANIFEST_ID, "version": MANIFEST_VERSION},
         "created_unix": time.time(),
@@ -181,6 +195,7 @@ def build_manifest(
             host_memory if host_memory is not None else _host_memory_block()
         ),
         "gramian_exactness": gramian_exactness,
+        "resume": resume,
         "compile_cache": _compile_cache_block(),
         "process": _process_block(),
         "multihost": multihost,
@@ -188,12 +203,14 @@ def build_manifest(
 
 
 def build_run_manifest(conf=None, spans=None, registry=None, io_stats=None,
-                       overlap=None) -> Dict:
+                       overlap=None, resume=None) -> Dict:
     """Snapshot a live run: ``conf`` (dataclass or mapping), a
     :class:`~spark_examples_tpu.obs.spans.SpanRecorder`, a
     :class:`~spark_examples_tpu.obs.metrics.MetricsRegistry`, the driver's
-    ``VariantsDatasetStats`` (or ``None`` when stats are disabled), and the
-    structured overlap dict from ``PrefetchIterator.overlap_stats()``."""
+    ``VariantsDatasetStats`` (or ``None`` when stats are disabled), the
+    structured overlap dict from ``PrefetchIterator.overlap_stats()``, and
+    the checkpoint/resume accounting dict (``None`` when Gramian
+    checkpointing was not active)."""
     config = (
         dataclasses.asdict(conf)
         if dataclasses.is_dataclass(conf)
@@ -221,6 +238,7 @@ def build_run_manifest(conf=None, spans=None, registry=None, io_stats=None,
         multihost=multihost_block,
         host_memory=_host_memory_block(registry),
         gramian_exactness=_gramian_exactness_block(registry),
+        resume=resume,
     )
 
 
@@ -300,6 +318,8 @@ def validate_manifest(doc) -> List[str]:
             errors.append("'io_stats' is neither null nor an object")
         else:
             for field in IO_STAT_FIELDS:
+                if field in OPTIONAL_IO_STAT_FIELDS and field not in io_stats:
+                    continue
                 if not isinstance(io_stats.get(field), int):
                     errors.append(f"io_stats.{field} missing or not an int")
 
@@ -324,6 +344,28 @@ def validate_manifest(doc) -> List[str]:
                     errors.append(
                         f"gramian_exactness.{field} is neither null nor a "
                         f"non-negative int: {value!r}"
+                    )
+
+    resume = doc.get("resume")
+    if resume is not None:
+        if not isinstance(resume, Mapping):
+            errors.append("'resume' is neither null nor an object")
+        else:
+            for field in (
+                "checkpoint_sites",
+                "sites_skipped",
+                "faults_injected",
+            ):
+                value = resume.get(field, "absent")
+                if (
+                    value == "absent"
+                    or not isinstance(value, int)
+                    or isinstance(value, bool)
+                    or value < 0
+                ):
+                    errors.append(
+                        f"resume.{field} missing or not a non-negative "
+                        f"int: {value!r}"
                     )
 
     host_memory = doc.get("host_memory")
@@ -389,6 +431,7 @@ __all__ = [
     "MANIFEST_ID",
     "MANIFEST_VERSION",
     "IO_STAT_FIELDS",
+    "OPTIONAL_IO_STAT_FIELDS",
     "build_manifest",
     "build_run_manifest",
     "validate_manifest",

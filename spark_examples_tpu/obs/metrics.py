@@ -72,6 +72,23 @@ GRAMIAN_STATIC_ENTRY_BOUND = "gramian_static_entry_bound"
 #: (registered by ``pipeline/stats.py:_STAT_METRICS``, spelled once here).
 IO_PARTITIONS_TOTAL = "io_partitions_total"
 
+#: Gramian crash-consistency telemetry (``pipeline/checkpoint.py:
+#: GramianFeeder``, ``--gramian-checkpoint-dir``): how many atomic
+#: accumulator snapshots this run published, and the ingest cursor (sites)
+#: of the newest one — what a preemption would resume from.
+GRAMIAN_CHECKPOINT_SAVES = "gramian_checkpoint_saves_total"
+GRAMIAN_CHECKPOINT_SITES = "gramian_checkpoint_sites"
+
+#: Transient-failure pressure: bounded-backoff retries issued by network
+#: clients (``sources/rest.py``; registered via ``pipeline/stats.py`` so
+#: the run manifest shows how hard the backend pushed back).
+IO_RETRIES_TOTAL = "io_retries_total"
+
+#: Self-healing serve loop (``serve/daemon.py``): times the watchdog
+#: replaced a dead worker thread — every increment is one crash the daemon
+#: survived instead of wedging.
+SERVE_WORKER_RESTARTS = "serve_worker_restarts_total"
+
 #: Warm-geometry compile-cache pair (``utils/cache.py``'s process-wide
 #: ledger): how many runs hit an already-compiled analysis geometry vs
 #: paid a cold compile. Function-backed (the ledger lives in utils.cache,
@@ -163,6 +180,11 @@ _WELL_KNOWN_GAUGE_HELP = {
         "Service jobs that reached a terminal state (done, failed, or "
         "cancelled) since the daemon started."
     ),
+    GRAMIAN_CHECKPOINT_SITES: (
+        "Ingest cursor (rows of the deterministic stream) covered by the "
+        "newest published Gramian checkpoint — what a preemption would "
+        "resume from."
+    ),
 }
 
 _WELL_KNOWN_COUNTER_HELP = {
@@ -170,6 +192,18 @@ _WELL_KNOWN_COUNTER_HELP = {
         "Total ICI bytes moved by ring-exchange ppermutes (sharded "
         "Gramian); the bit-packed wire format cuts this 8x vs unpacked "
         "uint8 tiles."
+    ),
+    GRAMIAN_CHECKPOINT_SAVES: (
+        "Atomic Gramian accumulator snapshots published by this run "
+        "(--gramian-checkpoint-dir)."
+    ),
+    IO_RETRIES_TOTAL: (
+        "Transient-failure retries (bounded-backoff) issued by network "
+        "clients — the manifest's transient-pressure signal."
+    ),
+    SERVE_WORKER_RESTARTS: (
+        "Dead worker threads the serve watchdog replaced; each increment "
+        "is one crash the daemon survived instead of wedging."
     ),
 }
 
@@ -600,6 +634,10 @@ __all__ = [
     "GRAMIAN_RING_FLUSH_SECONDS",
     "GRAMIAN_ENTRY_MAX",
     "GRAMIAN_STATIC_ENTRY_BOUND",
+    "GRAMIAN_CHECKPOINT_SAVES",
+    "GRAMIAN_CHECKPOINT_SITES",
+    "IO_RETRIES_TOTAL",
+    "SERVE_WORKER_RESTARTS",
     "DEVICEGEN_DISPATCHES",
     "DEVICEGEN_SITES_CAPACITY",
     "IO_PARTITIONS_TOTAL",

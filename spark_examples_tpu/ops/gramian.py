@@ -521,6 +521,66 @@ class GramianAccumulator:
             flush_rows, time.perf_counter() - flush_start, len(self._in_flight)
         )
 
+    def snapshot_state(self) -> dict:
+        """Crash-consistent checkpoint state: flush staged rows, drain the
+        dispatch pipeline, and fetch the partial Gramian with its
+        dtype-ladder position — everything :meth:`restore_state` needs to
+        rebuild this accumulator mid-stream on a fresh process. The fetch
+        is deliberate and periodic (``--gramian-checkpoint-dir``), not a
+        hot-path sync."""
+        self._flush()
+        jax.block_until_ready(self.G)  # graftcheck: disable=GC001 -- deliberate checkpoint barrier: the snapshot must capture a quiesced accumulator (no in-flight updates), at --checkpoint-every-sites cadence, not per flush
+        self._in_flight.clear()
+        G_host = np.asarray(jax.device_get(self.G))  # graftcheck: disable=GC001 -- deliberate periodic checkpoint fetch of the partial Gramian (the artifact payload); cadence is --checkpoint-every-sites, not the dispatch loop
+        return {
+            "strategy": "dense",
+            "G": G_host,
+            "accum_dtype": np.dtype(self.accum_dtype).name,
+            "exact_int": self.exact_int,
+            "entry_bound": self._entry_bound,
+            "rows_seen": self.rows_seen,
+            "flushes": self._flushes,
+            "num_samples": self.num_samples,
+            "data_parallel": self.data_parallel,
+            "padded": self.num_samples,
+        }
+
+    def restore_state(self, checkpoint: dict) -> None:
+        """Merge a persisted partial into this (fresh, empty) accumulator:
+        adopt the saved dtype-ladder position, load the saved G, and
+        restore the cursor bookkeeping. Geometry mismatches fail loudly —
+        the conf fingerprint should have caught them already; this is the
+        defense-in-depth shape check."""
+        meta, G = checkpoint["meta"], checkpoint["G"]
+        if meta["strategy"] != "dense":
+            raise ValueError(
+                f"checkpoint was written by the {meta['strategy']!r} "
+                "strategy; this run resolved dense — the similarity "
+                "strategy is part of the checkpoint geometry"
+            )
+        expect = (self.data_parallel, self.num_samples, self.num_samples)
+        if tuple(G.shape) != expect:
+            raise ValueError(
+                f"checkpoint Gramian shape {tuple(G.shape)} != this run's "
+                f"{expect} (cohort width or data-axis size changed)"
+            )
+        if meta["accum_dtype"] == "int32" and self.accum_dtype != jnp.int32:
+            # The saved run had already climbed the dtype ladder; adopt
+            # int32 before loading so the merge is exact by construction.
+            self.operand_dtype, self.accum_dtype = np.int8, jnp.int32
+        # range: checkpoint entries are exact integers within the saved
+        # accumulator dtype (the GR005-proven invariant); casting to this
+        # accumulator's (equal-or-wider) dtype is lossless.
+        G = G.astype(np.dtype(self.accum_dtype))
+        self.G = (
+            device_put_global(G, self._g_sharding)
+            if self._g_sharding is not None
+            else jnp.asarray(G)
+        )
+        self._entry_bound = int(meta["entry_bound"])
+        self.rows_seen = int(meta["rows_seen"])
+        self._flushes = int(meta["flushes"])
+
     def finalize_device(self) -> jax.Array:
         """Reduce across the data axis (the one ``psum``); result stays on
         device. Downstream stages (centering, PCA) should consume this —
@@ -796,6 +856,60 @@ class ShardedGramianAccumulator:
         self.ring_bytes_total += flush_ring_bytes
         self.telemetry.record_ring(flush_ring_bytes, flush_seconds)
         self.telemetry.record_flush(flush_rows, flush_seconds, 0)
+
+    def snapshot_state(self) -> dict:
+        """Checkpoint state of the sharded strategy: the row-tile-sharded
+        (padded) partial Gramian fetched whole, plus the dtype-ladder
+        position and cursor — see ``GramianAccumulator.snapshot_state``."""
+        self._flush()
+        jax.block_until_ready(self.G)  # graftcheck: disable=GC001 -- deliberate checkpoint barrier at --checkpoint-every-sites cadence (see the dense accumulator's snapshot_state)
+        G_host = np.asarray(jax.device_get(self.G))  # graftcheck: disable=GC001 -- deliberate periodic checkpoint fetch (the artifact payload), not a hot-path sync
+        return {
+            "strategy": "sharded",
+            "G": G_host,
+            "accum_dtype": np.dtype(self.accum_dtype).name,
+            "exact_int": self.exact_int,
+            "entry_bound": self._entry_bound,
+            "rows_seen": self.rows_seen,
+            "flushes": self._flushes,
+            "num_samples": self.num_samples,
+            "data_parallel": self.data_parallel,
+            "padded": self._padded,
+        }
+
+    def restore_state(self, checkpoint: dict) -> None:
+        """Sharded counterpart of ``GramianAccumulator.restore_state``:
+        shape/strategy checks, dtype-ladder adoption (including the
+        dtype-closed ring update rebuild), then the sharded device load."""
+        meta, G = checkpoint["meta"], checkpoint["G"]
+        if meta["strategy"] != "sharded":
+            raise ValueError(
+                f"checkpoint was written by the {meta['strategy']!r} "
+                "strategy; this run resolved sharded — the similarity "
+                "strategy is part of the checkpoint geometry"
+            )
+        expect = (self.data_parallel, self._padded, self._padded)
+        if tuple(G.shape) != expect:
+            raise ValueError(
+                f"checkpoint Gramian shape {tuple(G.shape)} != this run's "
+                f"{expect} (cohort width, padding, mesh data axis, or the "
+                "samples-axis tile count changed)"
+            )
+        if meta["accum_dtype"] == "int32" and self.accum_dtype != jnp.int32:
+            self.operand_dtype, self.accum_dtype = np.int8, jnp.int32
+            # The scanned updates close over the operand dtype — rebuild.
+            self._update = self._build_update(self.operand_dtype)
+            if self.pack:
+                self._update_packed = self._build_update(
+                    self.operand_dtype, packed=True
+                )
+        # range: checkpoint entries are exact integers within the saved
+        # dtype (GR005 invariant); the equal-or-wider target is lossless.
+        G = G.astype(np.dtype(self.accum_dtype))
+        self.G = device_put_global(G, self._g_sharding)
+        self._entry_bound = int(meta["entry_bound"])
+        self.rows_seen = int(meta["rows_seen"])
+        self._flushes = int(meta["flushes"])
 
     def finalize(self) -> np.ndarray:
         self._flush()

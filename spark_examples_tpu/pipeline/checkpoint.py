@@ -1,23 +1,47 @@
-"""Checkpoint / resume of materialized variants.
+"""Checkpoint / resume: materialized variants AND the live Gramian state.
 
-The reference can resume from pre-materialized variants:
-``--input-path`` makes ``getData`` read ``sc.objectFile[(VariantKey, Variant)]``
-instead of hitting the API (``VariantsPca.scala:112-113``), with stats
-disabled (``:332-335``) — but no writer for that format exists in the repo.
-Here both sides exist: :func:`save_variants` writes sharded gzip JSON-lines
-part files with a manifest, :func:`load_variants` streams them back as a
-dataset with the same iteration surface as ``VariantsDataset``.
+Two checkpoint families live here, both crash-consistent (every artifact
+is published by an atomic rename, so a crash at ANY instant leaves either
+the previous complete artifact or none — never a half-written one):
 
-Both sides move data through FIXED-SIZE buffers (``graftcheck hostmem``
-audits this file): the writer coalesces encoded lines into a bounded text
-buffer between ``write()`` calls (artifact bytes are identical to the
-per-record writes — gzip's compressor state only flushes at close), and
-the reader (:meth:`CheckpointDataset.iter_part` / ``__iter__``) walks each
-part in ``_READ_CHUNK_BYTES`` decompressed windows with a partial-line
-carry, so resuming never stages a whole part — let alone the whole
-checkpoint — as one buffer. Only :meth:`CheckpointDataset.compute` still
-materializes (one shard's record list, the ``VariantsDataset`` API
-surface), and that site is a declared ``hostmem(unbounded)``.
+**Variant checkpoints** (the reference's resume surface): the reference
+can resume from pre-materialized variants (``--input-path`` makes
+``getData`` read ``sc.objectFile[(VariantKey, Variant)]`` instead of
+hitting the API, ``VariantsPca.scala:112-113``, stats disabled
+``:332-335``) — but no writer for that format exists in the repo. Here
+both sides exist: :func:`save_variants` writes sharded gzip JSON-lines
+part files with a manifest, :func:`load_variants` streams them back.
+The manifest is written atomically (tmp + ``os.replace``) and the reader
+cross-checks it against the part files actually on disk — a deleted,
+extra, or truncated part fails loudly as :class:`CheckpointCorruptError`
+instead of silently resuming a polluted cohort.
+
+**Gramian checkpoints** (the analysis-pass resume surface, new): the
+Gramian is additive over variants, so an interrupted ingest+similarity
+pass need not restart from zero. :class:`GramianFeeder` wraps a live
+accumulator: it periodically persists the full device accumulator state —
+the per-partition partial Gramian with its dtype-ladder position, the
+site cursor, and a conf fingerprint — as ONE atomically-published
+``.npz`` artifact (:func:`save_gramian_checkpoint`). A restarted run
+(``--resume-from``) validates the fingerprint against its conf
+(:class:`CheckpointMismatchError` on drift), merges the persisted partial
+into a fresh accumulator, fast-forwards the deterministic contig-ordered
+ingest stream to the cursor, and finishes at O(remaining) device cost.
+Because every accumulator entry is an exact integer at every point (the
+``graftcheck ranges`` contracts, DESIGN.md §5/§8.7), the resumed Gramian
+— and therefore the eigenvectors — is **byte-identical** to an
+uninterrupted run, which the chaos matrix (``tests/test_faults.py``)
+asserts at every registered kill-point.
+
+Both families move data through FIXED-SIZE buffers (``graftcheck
+hostmem`` audits this file): the variant writer coalesces encoded lines
+into a bounded text buffer between ``write()`` calls, the variant reader
+walks each part in ``_READ_CHUNK_BYTES`` decompressed windows with a
+partial-line carry, and the Gramian artifact is O(N²) by definition (the
+accumulator state itself, not the data that produced it). Only
+:meth:`CheckpointDataset.compute` still materializes one shard's record
+list (the ``VariantsDataset`` API surface) — a declared
+``hostmem(unbounded)`` site, like the artifact read oracle.
 """
 
 from __future__ import annotations
@@ -25,9 +49,14 @@ from __future__ import annotations
 import gzip
 import json
 import os
-from typing import Iterable, Iterator, List, Tuple
+import zipfile
+import zlib
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
 
 from spark_examples_tpu.models.variant import Variant, VariantKey, VariantsBuilder
+from spark_examples_tpu.utils import faults
 
 _MANIFEST = "_manifest.json"
 
@@ -37,6 +66,21 @@ _WRITE_BUFFER_BYTES = 1 << 20
 
 #: Reader-side window: decompressed bytes per chunk of a part-file walk.
 _READ_CHUNK_BYTES = 4 << 20
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint directory that cannot be trusted: missing/truncated/
+    unparseable manifest, or part files that disagree with it. Raised
+    instead of a raw ``JSONDecodeError``/``KeyError`` so callers (and
+    operators) see "this checkpoint is corrupt — re-materialize it", not
+    a parser traceback."""
+
+
+class CheckpointMismatchError(RuntimeError):
+    """A Gramian checkpoint whose conf fingerprint does not match the
+    resuming run: merging it would silently produce a Gramian of a
+    DIFFERENT analysis (other cohort, block size, references, dtype
+    ladder...). The artifact is fine; the flags are not."""
 
 
 def _iter_jsonl_lines(path: str, chunk_bytes: int = _READ_CHUNK_BYTES):
@@ -76,6 +120,14 @@ class CheckpointWriter:
 
     def __init__(self, path: str):
         os.makedirs(path, exist_ok=True)
+        # Re-materializing into an existing checkpoint dir: retract the
+        # old manifest FIRST, so a crash mid-write leaves unreferenced
+        # part files (loud CheckpointCorruptError) rather than the prior
+        # manifest pointing at a mix of old and half-overwritten parts.
+        try:
+            os.remove(os.path.join(path, _MANIFEST))
+        except FileNotFoundError:
+            pass
         self.path = path
         self.total = 0
         self.parts = 0
@@ -110,7 +162,21 @@ class CheckpointWriter:
         self.parts += 1
 
     def close(self) -> None:
-        with open(os.path.join(self.path, _MANIFEST), "w") as f:
+        # Drop stale parts from a previous, larger materialization before
+        # publishing: the reader's parts-count cross-check would otherwise
+        # reject this completed write forever ("3 declared but 5 on
+        # disk"). A crash in here leaves extra-or-missing parts against
+        # whichever manifest exists — still a loud load failure.
+        written = {f"part-{i:05d}.jsonl.gz" for i in range(self.parts)}
+        for name in os.listdir(self.path):
+            if name.startswith("part-") and name not in written:
+                os.remove(os.path.join(self.path, name))
+        # Atomic publish (the obs/manifest.py pattern): a crash mid-write
+        # leaves only the per-pid tmp, never a truncated _manifest.json a
+        # later load would half-parse.
+        manifest_path = os.path.join(self.path, _MANIFEST)
+        tmp = f"{manifest_path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
             json.dump(
                 {
                     "parts": self.parts,
@@ -119,6 +185,7 @@ class CheckpointWriter:
                 },
                 f,
             )
+        os.replace(tmp, manifest_path)
 
 
 def save_variants(
@@ -137,32 +204,90 @@ def save_variants(
 
 
 class CheckpointDataset:
-    """Reader with the ``VariantsDataset`` iteration surface."""
+    """Reader with the ``VariantsDataset`` iteration surface.
+
+    Trust-but-verify on open AND on iteration: the manifest must parse and
+    carry its required fields, the part files on disk must match the
+    manifest's ``parts`` count, and a full iteration (:meth:`__iter__`)
+    re-counts raw records against ``records`` — a part truncated after the
+    manifest was written fails the resumed run loudly at the point the
+    truncation is provable, instead of silently analyzing fewer variants.
+    """
 
     def __init__(self, path: str):
         self.path = path
         manifest_path = os.path.join(path, _MANIFEST)
-        with open(manifest_path) as f:
-            self.manifest = json.load(f)
+        try:
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+        except FileNotFoundError:
+            raise CheckpointCorruptError(
+                f"{path}: no {_MANIFEST} — the checkpoint write never "
+                "completed (the manifest is written last, atomically); "
+                "re-materialize with --save-variants"
+            ) from None
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise CheckpointCorruptError(
+                f"{path}/{_MANIFEST} is truncated or unparseable ({e}); "
+                "the checkpoint cannot be trusted — re-materialize it"
+            ) from e
+        if (
+            not isinstance(manifest, dict)
+            or not isinstance(manifest.get("parts"), int)
+            or not isinstance(manifest.get("records"), int)
+        ):
+            raise CheckpointCorruptError(
+                f"{path}/{_MANIFEST} is missing required integer fields "
+                "parts/records; the checkpoint cannot be trusted"
+            )
+        self.manifest = manifest
+        on_disk = len(self.partitions())
+        if on_disk != manifest["parts"]:
+            raise CheckpointCorruptError(
+                f"{path}: manifest declares {manifest['parts']} part "
+                f"file(s) but {on_disk} are on disk — a deleted or foreign "
+                "part would silently resume a truncated/polluted cohort"
+            )
 
     def partitions(self) -> List[str]:
         return [
             os.path.join(self.path, name)
             for name in sorted(os.listdir(self.path))
-            if name.startswith("part-")
+            if name.startswith("part-") and not name.endswith(".tmp")
         ]
+
+    def _iter_part_entries(self, part_path: str) -> Iterator[Dict]:
+        """Raw manifest-counted entries of one part (pre-build): the unit
+        the writer's ``records`` total counts, so the full-iteration
+        cross-check compares like with like."""
+        try:
+            yield from _iter_jsonl_lines(part_path)
+        except (EOFError, OSError, json.JSONDecodeError) as e:
+            raise CheckpointCorruptError(
+                f"{part_path} is truncated or unparseable ({e}); the "
+                "checkpoint cannot be trusted — re-materialize it"
+            ) from e
+
+    @staticmethod
+    def _build_pairs(entries: Iterator[Dict]) -> Iterator[Tuple[VariantKey, Variant]]:
+        """The ONE spelling of entry → ``(key, variant)`` (build, skip
+        unbuildable, reconstruct the partition key) — shared by the
+        per-part reader and the counted whole-checkpoint iteration."""
+        for entry in entries:
+            built = VariantsBuilder.build(entry["variant"])
+            if built is None:
+                continue
+            yield (
+                VariantKey(
+                    entry["key"]["contig"], int(entry["key"]["position"])
+                ),
+                built[1],
+            )
 
     def iter_part(self, part_path: str) -> Iterator[Tuple[VariantKey, Variant]]:
         """Stream one part's ``(key, variant)`` pairs through the bounded
         read window — the resume path that never stages a whole part."""
-        for entry in _iter_jsonl_lines(part_path):
-            built = VariantsBuilder.build(entry["variant"])
-            if built is None:
-                continue
-            key = VariantKey(
-                entry["key"]["contig"], int(entry["key"]["position"])
-            )
-            yield key, built[1]
+        yield from self._build_pairs(self._iter_part_entries(part_path))
 
     def compute(self, part_path: str) -> List[Tuple[VariantKey, Variant]]:
         records: List[Tuple[VariantKey, Variant]] = []
@@ -172,8 +297,22 @@ class CheckpointDataset:
         return records
 
     def __iter__(self) -> Iterator[Tuple[VariantKey, Variant]]:
+        seen = 0
+
+        def counted(part: str) -> Iterator[Dict]:
+            nonlocal seen
+            for entry in self._iter_part_entries(part):
+                seen += 1
+                yield entry
+
         for part in self.partitions():
-            yield from self.iter_part(part)
+            yield from self._build_pairs(counted(part))
+        if seen != self.manifest["records"]:
+            raise CheckpointCorruptError(
+                f"{self.path}: manifest declares {self.manifest['records']} "
+                f"record(s) but a full iteration found {seen} — a part was "
+                "truncated or padded after the manifest was written"
+            )
 
     def variants(self) -> Iterator[Variant]:
         for _, variant in self:
@@ -184,9 +323,284 @@ def load_variants(path: str) -> CheckpointDataset:
     return CheckpointDataset(path)
 
 
+# ---------------------------------------------------------------------------
+# Gramian checkpoints: the analysis-pass resume artifact.
+# ---------------------------------------------------------------------------
+
+GRAMIAN_CKPT = "gramian.ckpt.npz"
+GRAMIAN_CKPT_VERSION = 1
+
+#: Default ``--checkpoint-every-sites`` when a checkpoint directory is
+#: given without an interval: ~18 snapshots across a whole genome
+#: (~28.9 M candidate sites), each costing one accumulator sync + one
+#: O(N²) host fetch + write — noise against the ingest it protects.
+DEFAULT_CHECKPOINT_EVERY_SITES = 1_600_000
+
+#: Meta fields every complete artifact carries (version-1 contract).
+_META_REQUIRED = (
+    "version",
+    "fingerprint",
+    "sites",
+    "strategy",
+    "accum_dtype",
+    "entry_bound",
+    "rows_seen",
+    "flushes",
+    "num_samples",
+)
+
+
+def gramian_checkpoint_fingerprint(conf) -> str:
+    """The conf digest a Gramian checkpoint is keyed by: the
+    ``utils/cache.py:compile_fingerprint`` fields (everything that shapes
+    the analysis — cohort, references, block size, mesh, strategy, dtype
+    ladder, ingest path — with output/telemetry placement excluded, and
+    the checkpoint/fault flags themselves excluded so the saving run and
+    the resuming run fingerprint identically)."""
+    from spark_examples_tpu.utils.cache import compile_fingerprint
+
+    return compile_fingerprint(conf, kind="gramian-checkpoint")
+
+
+def save_gramian_checkpoint(
+    directory: str, state: Dict, fingerprint: str, sites: int
+) -> str:
+    """Atomically publish one accumulator snapshot as
+    ``<directory>/gramian.ckpt.npz`` (single file: tmp write + rename, so
+    a crash at any instant leaves the PREVIOUS complete snapshot — or
+    none — never a torn one). ``state`` is
+    ``GramianAccumulator.snapshot_state()``'s dict; ``sites`` is the
+    ingest cursor (rows of the deterministic stream consumed so far)."""
+    os.makedirs(directory, exist_ok=True)
+    meta = {
+        "version": GRAMIAN_CKPT_VERSION,
+        "fingerprint": str(fingerprint),
+        "sites": int(sites),
+        "strategy": state["strategy"],
+        "accum_dtype": state["accum_dtype"],
+        "exact_int": bool(state["exact_int"]),
+        "entry_bound": int(state["entry_bound"]),
+        "rows_seen": int(state["rows_seen"]),
+        "flushes": int(state["flushes"]),
+        "num_samples": int(state["num_samples"]),
+        "data_parallel": int(state.get("data_parallel", 1)),
+        "padded": int(state.get("padded", state["num_samples"])),
+    }
+    final = os.path.join(directory, GRAMIAN_CKPT)
+    # Sweep orphaned tmps from prior killed writes: each tmp is a full
+    # O(N²) snapshot and every preemption/resume cycle runs under a fresh
+    # pid, so without this a repeatedly-preempted run steadily fills the
+    # directory with dead full-size files. One writer per directory by
+    # design (the driver), so nothing live can match the pattern here.
+    for name in os.listdir(directory):
+        if name.startswith(f"{GRAMIAN_CKPT}.") and name.endswith(".tmp"):
+            try:
+                os.remove(os.path.join(directory, name))
+            except OSError:
+                pass
+    tmp = f"{final}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, G=state["G"], meta=np.frombuffer(
+            json.dumps(meta).encode("utf-8"), dtype=np.uint8
+        ))
+        f.flush()
+        os.fsync(f.fileno())
+    faults.kill_point("checkpoint.mid-write")
+    os.replace(tmp, final)
+    faults.kill_point("checkpoint.post-save")
+    return final
+
+
+def load_gramian_checkpoint(
+    directory: str, fingerprint: Optional[str] = None
+) -> Optional[Dict]:
+    """Load the last COMPLETE snapshot from a checkpoint directory, or
+    ``None`` when no complete artifact exists yet (a run killed before —
+    or during — its first save resumes from zero; leftover ``.tmp`` files
+    are ignored by construction). Raises :class:`CheckpointCorruptError`
+    on an unreadable artifact and :class:`CheckpointMismatchError` when
+    ``fingerprint`` is given and disagrees.
+
+    Returns ``{"meta": dict, "G": ndarray}``.
+    """
+    path = os.path.join(directory, GRAMIAN_CKPT)
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as archive:  # graftcheck: hostmem(unbounded) -- the artifact read oracle: one O(N²) accumulator snapshot staged whole by np.load; its size is the accumulator itself, not the ingested data
+            meta = json.loads(bytes(archive["meta"]).decode("utf-8"))
+            G = np.array(archive["G"])
+    except (
+        OSError,
+        ValueError,
+        KeyError,
+        json.JSONDecodeError,
+        # A valid zip magic with a corrupt/truncated tail surfaces as
+        # BadZipFile or zlib.error, not ValueError — same diagnosis.
+        zipfile.BadZipFile,
+        zlib.error,
+    ) as e:
+        raise CheckpointCorruptError(
+            f"{path} is not a readable Gramian checkpoint ({e}); delete "
+            "the directory to restart from zero"
+        ) from e
+    missing = [k for k in _META_REQUIRED if k not in meta]
+    if missing or meta.get("version") != GRAMIAN_CKPT_VERSION:
+        raise CheckpointCorruptError(
+            f"{path}: incomplete or wrong-version checkpoint meta "
+            f"(version={meta.get('version')!r}, missing={missing})"
+        )
+    if fingerprint is not None and meta["fingerprint"] != fingerprint:
+        raise CheckpointMismatchError(
+            f"{path} was written by a run with conf fingerprint "
+            f"{meta['fingerprint']} but this run fingerprints as "
+            f"{fingerprint}: the flags that shape the analysis (cohort, "
+            "references, block size, mesh, strategy, dtype ladder, ingest "
+            "path) differ — resuming would merge two different analyses. "
+            "Re-run with the original flags, or point --resume-from at a "
+            "matching checkpoint"
+        )
+    return {"meta": meta, "G": G}
+
+
+class GramianFeeder:
+    """Row-block conduit between an ingest stream and a live accumulator,
+    adding crash-consistent periodic snapshots and resume fast-forward.
+
+    Exposes ``add_rows`` (the accumulator surface the driver and
+    ``ops/gramian.py:accumulate_index_rows`` feed), so it drops into both
+    the packed/streamed block path and the wire calls path unchanged:
+
+    - **resume**: constructed with a loaded checkpoint, it restores the
+      accumulator state once and then SKIPS the first ``meta["sites"]``
+      rows of the (deterministic, contig-ordered) stream — splitting a
+      block when the cursor lands inside one — before feeding resumes;
+    - **checkpointing**: every ``every_sites`` accumulated rows it syncs
+      the accumulator (:meth:`snapshot_state` flushes and drains the
+      dispatch pipeline), fetches the partial Gramian, and publishes the
+      atomic artifact; :meth:`finish` writes a final snapshot so a crash
+      between ingest end and finalize also resumes at O(1) re-ingest.
+
+    Different flush boundaries between the original and resumed runs are
+    harmless by the exactness contracts: every accumulator entry is an
+    exact integer at every point, so the merged Gramian is byte-identical
+    regardless of how rows were grouped into flushes.
+    """
+
+    def __init__(
+        self,
+        acc,
+        directory: Optional[str] = None,
+        every_sites: Optional[int] = None,
+        fingerprint: str = "",
+        resume: Optional[Dict] = None,
+        registry=None,
+    ):
+        self.acc = acc
+        self.directory = directory
+        self.every_sites = (
+            int(every_sites)
+            if every_sites is not None
+            else DEFAULT_CHECKPOINT_EVERY_SITES
+        )
+        if self.every_sites < 1:
+            raise ValueError(
+                f"checkpoint cadence must be >= 1 site, got "
+                f"{self.every_sites}"
+            )
+        self.fingerprint = fingerprint
+        self.checkpoint_sites = 0
+        self.sites_skipped = 0
+        self.saves = 0
+        self._skip_remaining = 0
+        self._saves_counter = self._sites_gauge = None
+        if resume is not None:
+            acc.restore_state(resume)
+            self.checkpoint_sites = int(resume["meta"]["sites"])
+            self._skip_remaining = self.checkpoint_sites
+        self.sites_done = self.checkpoint_sites
+        self._last_saved = self.checkpoint_sites
+        if registry is not None and directory is not None:
+            from spark_examples_tpu.obs.metrics import (
+                GRAMIAN_CHECKPOINT_SAVES,
+                GRAMIAN_CHECKPOINT_SITES,
+                well_known_counter,
+                well_known_gauge,
+            )
+
+            self._saves_counter = well_known_counter(
+                registry, GRAMIAN_CHECKPOINT_SAVES
+            )
+            self._sites_gauge = well_known_gauge(
+                registry, GRAMIAN_CHECKPOINT_SITES
+            )
+            self._sites_gauge.set(float(self._last_saved))
+
+    def add_rows(self, rows) -> None:
+        n = len(rows)
+        if self._skip_remaining > 0:
+            if n <= self._skip_remaining:
+                self._skip_remaining -= n
+                self.sites_skipped += n
+                return
+            rows = rows[self._skip_remaining :]
+            self.sites_skipped += self._skip_remaining
+            self._skip_remaining = 0
+            n = len(rows)
+        self.acc.add_rows(rows)
+        self.sites_done += n
+        if (
+            self.directory is not None
+            and self.sites_done - self._last_saved >= self.every_sites
+        ):
+            self.save()
+
+    def save(self) -> None:
+        """Snapshot + atomic publish at the current cursor."""
+        state = self.acc.snapshot_state()
+        faults.kill_point("driver.post-flush")
+        save_gramian_checkpoint(
+            self.directory, state, self.fingerprint, self.sites_done
+        )
+        self._last_saved = self.sites_done
+        self.saves += 1
+        if self._saves_counter is not None:
+            self._saves_counter.inc(1)
+            self._sites_gauge.set(float(self._last_saved))
+
+    def finish(self) -> None:
+        """End of ingest: write the final snapshot (when checkpointing and
+        anything accumulated since the last save), so a crash before or
+        during finalize resumes without re-ingesting anything.
+
+        Fails loudly if the fast-forward never completed: the fingerprint
+        covers conf flags and input paths, not file contents, so an input
+        that SHRANK since the checkpoint (truncated/replaced file) is only
+        detectable here — finalizing anyway would emit a structurally
+        valid but silently wrong analysis built from the stale partial."""
+        if self._skip_remaining > 0:
+            raise CheckpointMismatchError(
+                f"resume cursor lies past the end of the input stream: the "
+                f"checkpoint was written at {self.checkpoint_sites} sites "
+                f"but the stream ended after {self.sites_skipped} — the "
+                "input shrank since the checkpoint was saved. Re-run "
+                "without --resume-from (or against the original input)"
+            )
+        if self.directory is not None and self.sites_done > self._last_saved:
+            self.save()
+
+
 __all__ = [
+    "CheckpointCorruptError",
+    "CheckpointMismatchError",
     "CheckpointWriter",
     "save_variants",
     "load_variants",
     "CheckpointDataset",
+    "GRAMIAN_CKPT",
+    "DEFAULT_CHECKPOINT_EVERY_SITES",
+    "gramian_checkpoint_fingerprint",
+    "save_gramian_checkpoint",
+    "load_gramian_checkpoint",
+    "GramianFeeder",
 ]

@@ -54,6 +54,7 @@ from spark_examples_tpu.sharding.partitioners import VariantsPartitioner
 from spark_examples_tpu.sources.base import GenomicsSource
 from spark_examples_tpu.sources.files import FileGenomicsSource, af_float
 from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource
+from spark_examples_tpu.utils import faults
 
 
 @dataclass(frozen=True)
@@ -158,6 +159,34 @@ class VariantsPcaDriver:
         self.registry = MetricsRegistry()
         self.spans = SpanRecorder()
         self._overlap: Optional[Dict] = None
+        # Crash-consistent Gramian checkpointing (pipeline/checkpoint.py):
+        # the resume artifact is loaded HERE, before any ingest, so a conf
+        # fingerprint mismatch or a corrupt artifact fails the run in
+        # milliseconds instead of after a re-ingest pass. The feeder is
+        # created lazily around the run's accumulator (_wrap_accumulator).
+        self.feeder = None
+        self._gramian_resume: Optional[Dict] = None
+        self._ckpt_fingerprint = ""
+        if getattr(conf, "gramian_checkpoint_dir", None) or getattr(
+            conf, "resume_from", None
+        ):
+            from spark_examples_tpu.pipeline.checkpoint import (
+                gramian_checkpoint_fingerprint,
+                load_gramian_checkpoint,
+            )
+
+            self._ckpt_fingerprint = gramian_checkpoint_fingerprint(conf)
+            if getattr(conf, "resume_from", None):
+                self._gramian_resume = load_gramian_checkpoint(
+                    conf.resume_from, self._ckpt_fingerprint
+                )
+                if self._gramian_resume is not None:
+                    meta = self._gramian_resume["meta"]
+                    print(
+                        f"Resuming from Gramian checkpoint at "
+                        f"{conf.resume_from}: {meta['sites']} sites "
+                        f"already accumulated."
+                    )
         # Stats are disabled when resuming from materialized input
         # (``VariantsPca.scala:332-335``).
         self.io_stats: Optional[VariantsDatasetStats] = (
@@ -422,6 +451,39 @@ class VariantsPcaDriver:
             sharded = False
         return sharded
 
+    def _wrap_accumulator(self, acc):
+        """Interpose the checkpoint feeder between the ingest stream and a
+        fresh accumulator when checkpointing/resume is configured; a plain
+        pass-through otherwise (zero overhead for normal runs). The feeder
+        restores the persisted partial into ``acc`` on construction and
+        fast-forwards the first ``checkpoint_sites`` rows it is fed."""
+        conf = self.conf
+        directory = getattr(conf, "gramian_checkpoint_dir", None)
+        if directory is None and getattr(conf, "resume_from", None) is None:
+            # Neither flag: pure pass-through, zero overhead. (A resume
+            # flag with no complete artifact yet still gets a feeder — it
+            # starts from zero and the manifest records that honestly.)
+            return acc
+        from spark_examples_tpu.pipeline.checkpoint import GramianFeeder
+
+        self.feeder = GramianFeeder(
+            acc,
+            directory=directory,
+            every_sites=getattr(conf, "checkpoint_every_sites", None),
+            fingerprint=self._ckpt_fingerprint,
+            resume=self._gramian_resume,
+            registry=self.registry,
+        )
+        return self.feeder
+
+    def _finish_checkpointing(self) -> None:
+        """End of ingest: final snapshot (a crash between here and the
+        finalize reduce resumes at O(1) re-ingest), then the registered
+        pre-finalize kill-point — a no-op unless a fault plan names it."""
+        if self.feeder is not None:
+            self.feeder.finish()
+        faults.kill_point("driver.pre-finalize")
+
     def get_similarity_matrix(
         self, calls: Iterable[List[int]], sharded: Optional[bool] = None
     ) -> np.ndarray:
@@ -454,12 +516,13 @@ class VariantsPcaDriver:
         # reference's pair-loop multiplicity (``VariantsPca.scala:224-229``).
         ids = self.conf.variant_set_id
         accumulate_index_rows(
-            acc,
+            self._wrap_accumulator(acc),
             calls,
             n,
             self.conf.block_size,
             accumulate_duplicates=len(set(ids)) != len(ids),
         )
+        self._finish_checkpointing()
         # Stay on device either way: centering/PCA consume this directly;
         # fetching the N×N matrix to host is pointless and degrades
         # remote-attached backends (see ops/gramian.py). The sharded result
@@ -512,8 +575,10 @@ class VariantsPcaDriver:
                 spans=self.spans,
                 check_ranges=check_ranges,
             )
+        feed = self._wrap_accumulator(acc)
         for block in blocks:
-            acc.add_rows(block)
+            feed.add_rows(block)
+        self._finish_checkpointing()
         if isinstance(acc, GramianAccumulator):
             return acc.finalize_device()
         return acc.finalize_sharded()
@@ -893,6 +958,16 @@ def run_pipeline(conf: PcaConf, similarity_only: bool = False) -> PipelineResult
     schema-v2 manifest. ``similarity_only`` stops after the
     ingest+similarity stage and returns a host-side summary of the
     Gramian instead of PC rows (the service's similarity request kind)."""
+    if getattr(conf, "fault_plan", None) is not None:
+        # The flag wins over the SPARK_EXAMPLES_TPU_FAULTS environment
+        # variable; configuring resets hit counts, so every run starts a
+        # fresh deterministic schedule.
+        faults.configure(conf.fault_plan)
+    else:
+        # Force the lazy env-var plan to parse NOW: a typo'd site name
+        # must fail here in milliseconds, not hours later at the first
+        # checkpoint hook of a whole-genome run.
+        faults.active()
     synthetic_tpu = (
         conf.source == "synthetic"
         and not conf.input_path
@@ -974,6 +1049,36 @@ def run_pipeline(conf: PcaConf, similarity_only: bool = False) -> PipelineResult
             )
         use_device = False
         use_packed = False
+    if getattr(conf, "gramian_checkpoint_dir", None) or getattr(
+        conf, "resume_from", None
+    ):
+        # Gramian checkpointing snapshots the DEVICE accumulator against a
+        # host-fed, deterministically-ordered row cursor; the host backend
+        # has no accumulator and the fused on-device generator has no
+        # host-side cursor to fast-forward.
+        if conf.pca_backend != "tpu":
+            raise ValueError(
+                "--gramian-checkpoint-dir/--resume-from checkpoint the "
+                "device accumulator; they need --pca-backend tpu"
+            )
+        if conf.ingest == "device":
+            raise ValueError(
+                "--ingest device has no host-fed row cursor to checkpoint "
+                "or resume; use --ingest packed or wire (or leave --ingest "
+                "auto, which falls back for checkpointed runs)"
+            )
+        if use_device:
+            print(
+                "Device ingest disabled for Gramian checkpointing (the "
+                "fused generator has no host-fed cursor); using "
+                + (
+                    "packed ingest."
+                    if len(conf.variant_set_id) == 1
+                    else "wire ingest."
+                )
+            )
+            use_device = False
+            use_packed = len(conf.variant_set_id) == 1
     if use_device and not (synthetic_tpu and device_ok):
         raise ValueError(
             "--ingest device requires --source synthetic, --pca-backend tpu, "
@@ -1085,12 +1190,24 @@ def run_pipeline(conf: PcaConf, similarity_only: bool = False) -> PipelineResult
             write_manifest,
         )
 
+        resume_block = None
+        if driver.feeder is not None:
+            # The v2-additive ``resume`` block: where this run started
+            # from (0 for a fresh checkpointed run), how much ingest the
+            # cursor fast-forwarded, and whether any deterministic fault
+            # fired in-process — the chaos matrix's assertion surface.
+            resume_block = {
+                "checkpoint_sites": int(driver.feeder.checkpoint_sites),
+                "sites_skipped": int(driver.feeder.sites_skipped),
+                "faults_injected": int(faults.injected_count()),
+            }
         manifest_doc = build_run_manifest(
             conf=conf,
             spans=driver.spans,
             registry=driver.registry,
             io_stats=driver.io_stats,
             overlap=driver._overlap,
+            resume=resume_block,
         )
         if conf.metrics_json:
             try:

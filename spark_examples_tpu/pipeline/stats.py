@@ -20,7 +20,11 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
-from spark_examples_tpu.obs.metrics import IO_PARTITIONS_TOTAL, MetricsRegistry
+from spark_examples_tpu.obs.metrics import (
+    IO_PARTITIONS_TOTAL,
+    IO_RETRIES_TOTAL,
+    MetricsRegistry,
+)
 from spark_examples_tpu.sources.base import ClientCounters
 
 #: stat name → (metric name, help) — the registry series backing each field.
@@ -37,6 +41,13 @@ _STAT_METRICS = {
     ),
     "io_exceptions": ("io_io_exceptions_total", "I/O exceptions raised."),
     "variants": ("io_variants_total", "Variant records read (pre-drop)."),
+    # Not part of the reference's six-line report (__str__ keeps its
+    # line-for-line format); rides the manifest's io_stats block and the
+    # io_retries_total registry series as the transient-pressure signal.
+    "retries": (
+        IO_RETRIES_TOTAL,
+        "Transient-failure retries (bounded-backoff) issued by clients.",
+    ),
 }
 
 
@@ -72,6 +83,7 @@ class VariantsDatasetStats:
     unsuccessful_responses = _forbidden("unsuccessful_responses")
     io_exceptions = _forbidden("io_exceptions")
     variants = _forbidden("variants")
+    retries = _forbidden("retries")
 
     def add_partition(self, reference_bases: int) -> None:
         self._counters["partitions"].inc(1)
@@ -93,6 +105,7 @@ class VariantsDatasetStats:
             counters.unsuccessful_responses
         )
         self._counters["io_exceptions"].inc(counters.io_exceptions)
+        self._counters["retries"].inc(counters.retries)
 
     def as_dict(self) -> Dict[str, int]:
         """The manifest's ``io_stats`` block (``obs/manifest.py``) — the
@@ -104,6 +117,7 @@ class VariantsDatasetStats:
             "requests": self.requests,
             "unsuccessful_responses": self.unsuccessful_responses,
             "io_exceptions": self.io_exceptions,
+            "io_retries": self.retries,
         }
 
     def __str__(self) -> str:

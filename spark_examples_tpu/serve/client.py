@@ -20,15 +20,20 @@ from __future__ import annotations
 
 import argparse
 import json
+import random
 import sys
 import time
 import urllib.error
 import urllib.request
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Callable, Dict, Optional, Sequence, Tuple
 
 from spark_examples_tpu.serve.protocol import (
     TERMINAL_STATUSES,
     request_doc,
+)
+from spark_examples_tpu.utils.retry import (
+    full_jitter_delay,
+    retry_after_seconds,
 )
 
 #: Hard cap on response bodies (bounded read — a misbehaving server must
@@ -58,32 +63,78 @@ class ServeError(Exception):
 
 
 class ServeClient:
-    def __init__(self, url: str, timeout: float = 30.0):
+    def __init__(
+        self,
+        url: str,
+        timeout: float = 30.0,
+        max_retries: int = 3,
+        backoff_base: float = 0.1,
+        backoff_cap: float = 2.0,
+        sleep: Callable[[float], None] = time.sleep,
+        rng: Optional[random.Random] = None,
+    ):
         self.url = url.rstrip("/")
         self.timeout = float(timeout)
+        self.max_retries = int(max_retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self._sleep = sleep
+        self._rng = rng if rng is not None else random.Random()
 
     # ------------------------------------------------------------ transport
+
+    def _backoff(self, attempt: int, response_headers) -> None:
+        """One bounded-backoff delay (the shared ``utils/retry.py``
+        arithmetic): honor a server-sent ``Retry-After`` when present,
+        full jitter otherwise; both capped by ``backoff_cap``."""
+        delay = retry_after_seconds(response_headers, self.backoff_cap)
+        if delay is None:
+            delay = full_jitter_delay(
+                attempt, self.backoff_base, self.backoff_cap, self._rng
+            )
+        self._sleep(delay)
 
     def _request(
         self, method: str, path: str, doc: Optional[Dict] = None
     ) -> Tuple[int, object, str]:
+        """One HTTP exchange. GETs (``status``/``/metrics``/``/healthz``)
+        retry connection resets and 5xx responses with bounded backoff —
+        they are idempotent, and a daemon mid-worker-recovery must not
+        look "down" to a poller that raced one refused connect. POSTs
+        stay single-shot: a retried submit could enqueue the job twice."""
         data = None
         headers = {"Accept": "application/json"}
         if doc is not None:
             data = json.dumps(doc).encode("utf-8")
             headers["Content-Type"] = "application/json"
-        req = urllib.request.Request(
-            self.url + path, data=data, method=method, headers=headers
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                status = resp.status
-                raw = resp.read(MAX_RESPONSE_BYTES + 1)
-                content_type = resp.headers.get("Content-Type", "")
-        except urllib.error.HTTPError as e:
-            status = e.code
-            raw = e.read(MAX_RESPONSE_BYTES + 1)
-            content_type = e.headers.get("Content-Type", "") if e.headers else ""
+        attempts = max(1, self.max_retries) if method == "GET" else 1
+        for attempt in range(attempts):
+            retryable = attempt + 1 < attempts
+            req = urllib.request.Request(
+                self.url + path, data=data, method=method, headers=headers
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    status = resp.status
+                    raw = resp.read(MAX_RESPONSE_BYTES + 1)
+                    content_type = resp.headers.get("Content-Type", "")
+            except urllib.error.HTTPError as e:
+                if e.code >= 500 and retryable:
+                    self._backoff(attempt, e.headers)
+                    continue
+                status = e.code
+                raw = e.read(MAX_RESPONSE_BYTES + 1)
+                content_type = (
+                    e.headers.get("Content-Type", "") if e.headers else ""
+                )
+            except (urllib.error.URLError, OSError):
+                # Connection refused / reset (possibly mid-response): safe
+                # to resend only because GETs are idempotent.
+                if retryable:
+                    self._backoff(attempt, None)
+                    continue
+                raise
+            break
         if len(raw) > MAX_RESPONSE_BYTES:
             raise ServeError(
                 status,

@@ -55,6 +55,12 @@ from spark_examples_tpu.serve.queue import (
     QueueFull,
     classify_conf,
 )
+from spark_examples_tpu.utils import faults
+
+#: How often the watchdog checks the worker thread's pulse. A dead worker
+#: is replaced within ~this bound, so one crashed job never looks like a
+#: wedged daemon to pollers.
+WATCHDOG_INTERVAL_SECONDS = 0.05
 
 #: Plan-rejection codes that are RESOURCE bounds (the request is
 #: well-formed but too big for the declared budgets) — surfaced as HTTP
@@ -89,6 +95,13 @@ _RESERVED_FLAG_FIELDS = (
     ("output_path", "--output-path"),
     ("profile_dir", "--profile-dir"),
     ("save_variants", "--save-variants"),
+    # Daemon-host write paths AND process-wide kill switches: a served
+    # job carrying a fault plan could SIGKILL the daemon (kill@... fires
+    # os.kill on the whole process), and checkpoint/resume directories
+    # are arbitrary-path read/write primitives on the service host.
+    ("fault_plan", "--fault-plan"),
+    ("gramian_checkpoint_dir", "--gramian-checkpoint-dir"),
+    ("resume_from", "--resume-from"),
 )
 
 
@@ -139,6 +152,7 @@ class PcaService:
         self._terminal = 0
         self._draining = threading.Event()
         self._worker: Optional[threading.Thread] = None
+        self._watchdog: Optional[threading.Thread] = None
         self._heartbeat = None
         self._started_unix: Optional[float] = None
         self.device_count: Optional[int] = None
@@ -160,7 +174,9 @@ class PcaService:
             SERVE_JOBS_DONE,
             SERVE_JOBS_INFLIGHT,
             SERVE_QUEUE_DEPTH,
+            SERVE_WORKER_RESTARTS,
             read_host_peak_rss_bytes,
+            well_known_counter,
             well_known_gauge,
         )
         from spark_examples_tpu.utils.cache import compile_cache_stats
@@ -204,6 +220,9 @@ class PcaService:
             "Wall-clock of completed jobs, by admission class.",
             labelnames=("job_class",),
         )
+        self._worker_restarts = well_known_counter(
+            self.registry, SERVE_WORKER_RESTARTS
+        )
 
     # ------------------------------------------------------------ lifecycle
 
@@ -212,6 +231,12 @@ class PcaService:
         worker and the optional service heartbeat."""
         if self._worker is not None:
             return self
+        # Force the lazy env-var fault plan to parse NOW (the batch path
+        # does the same in run_pipeline): a typo'd site name must fail the
+        # daemon at startup, not surface as a crash/restart loop where
+        # every job rides its one requeue and then fails with a
+        # misleading "worker-crashed:" error.
+        faults.active()
         import jax
 
         # The warm-mesh moment: devices enumerate here, once; every
@@ -225,6 +250,12 @@ class PcaService:
             target=self._worker_loop, name="serve-worker", daemon=True
         )
         self._worker.start()
+        # The self-healing half: a watchdog that replaces a dead worker
+        # thread instead of letting one crashed job wedge the daemon.
+        self._watchdog = threading.Thread(
+            target=self._watchdog_loop, name="serve-watchdog", daemon=True
+        )
+        self._watchdog.start()
         if self.heartbeat_seconds > 0:
             from spark_examples_tpu.obs.heartbeat import Heartbeat
 
@@ -245,16 +276,57 @@ class PcaService:
 
     def wait_drained(self, timeout: Optional[float] = None) -> bool:
         """Block until the worker finished every admitted job and exited
-        (call :meth:`begin_drain` first). Returns ``False`` on timeout."""
-        worker = self._worker
-        if worker is None:
-            return True
-        worker.join(timeout=timeout)
-        alive = worker.is_alive()
-        if not alive and self._heartbeat is not None:
+        (call :meth:`begin_drain` first). Returns ``False`` on timeout.
+        Re-reads ``self._worker`` per step: the watchdog may replace a
+        crashed worker mid-drain, and the drain only completes when the
+        CURRENT worker exits with nothing left in flight."""
+        deadline = (
+            None if timeout is None else time.monotonic() + float(timeout)
+        )
+        while True:
+            worker = self._worker
+            if worker is None:
+                break
+            step = 0.1
+            if deadline is not None:
+                step = min(step, max(0.0, deadline - time.monotonic()))
+            joinable = True
+            try:
+                worker.join(timeout=step)
+            except RuntimeError:
+                # _recover_worker publishes its replacement a beat before
+                # start() (publish-first keeps the dead worker from ever
+                # reading as "current" here); an unstarted thread is not
+                # joinable yet — treat it as alive and poll again.
+                joinable = False
+                time.sleep(min(step, 0.005))
+            with self._lock:
+                inflight = self._inflight
+                # A crash mid-drain leaves the watchdog a beat of
+                # settlement work AFTER it started the replacement: the
+                # crashed job may still read ``running`` (or transiently
+                # ``queued``) while the new worker already drained the
+                # queue. The drain contract is "every admitted job reached
+                # a terminal state", so wait for the table to settle too.
+                unsettled = any(
+                    job.status in ("queued", "running")
+                    for job in self._table.values()
+                )
+            if (
+                joinable
+                and not worker.is_alive()
+                and self._worker is worker
+                and self._queue.drained
+                and inflight == 0
+                and not unsettled
+            ):
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+        if self._heartbeat is not None:
             self._heartbeat.stop()
             self._heartbeat = None
-        return not alive
+        return True
 
     def stop(self, timeout: float = 30.0) -> bool:
         """Drain and join (tests and the CLI's shutdown path)."""
@@ -439,6 +511,7 @@ class PcaService:
                     "large": self._queue.large_capacity,
                 },
                 "worker_alive": worker is not None and worker.is_alive(),
+                "worker_restarts": int(self._worker_restarts.value),
             },
             "jobs": {
                 "tracked": total,
@@ -517,6 +590,16 @@ class PcaService:
             job.status = "running"
             job.started_unix = now
             self._inflight = 1
+        # Registered kill-point: job claimed and flipped to running, BEFORE
+        # any device work — the requeue-eligible window (a crash here is
+        # side-effect-free; the watchdog re-puts the job once).
+        faults.kill_point("serve.worker.claim")
+        with self._lock:
+            job.device_began = True
+        # Registered kill-point: device work marked begun, executor about
+        # to run — a crash from here on must NOT be requeued (device state
+        # under a crashed update cannot be trusted for a silent retry).
+        faults.kill_point("serve.worker.mid-job")
         started = time.perf_counter()
         outcome: Optional[ExecutionOutcome] = None
         error: Optional[str] = None
@@ -542,5 +625,104 @@ class PcaService:
         self._completed.labels(status=job.status).inc()
         self._job_seconds.labels(job_class=job.job_class).observe(seconds)
 
+    # ------------------------------------------------------------- watchdog
 
-__all__ = ["MEM_LIMIT_CODES", "PcaService"]
+    def _watchdog_loop(self) -> None:
+        """Monitor the worker thread's pulse; replace it when it dies.
+
+        The worker loop only returns by contract when the queue is closed
+        AND drained — any other exit is a crash (an escaped
+        ``BaseException``; the deterministic stand-in is
+        ``utils/faults.InjectedWorkerCrash``, which by design escapes the
+        job-failure ``except Exception``). The watchdog applies the
+        recovery policy (:meth:`_recover_worker`) and keeps the daemon
+        serving; it exits only when a drain completed cleanly."""
+        while True:
+            worker = self._worker
+            if worker is None:
+                return
+            worker.join(timeout=WATCHDOG_INTERVAL_SECONDS)
+            if worker.is_alive():
+                continue
+            with self._lock:
+                inflight = self._inflight
+            if self._queue.drained and inflight == 0:
+                # Contract exit: drain finished every admitted job.
+                return
+            self._recover_worker()
+
+    def _recover_worker(self) -> None:
+        """One dead worker: settle its in-flight job, start a replacement.
+
+        Policy (the acceptance contract of the chaos tests):
+        - an in-flight job that had NOT begun device work is requeued
+          once — its claim was side-effect-free, so one silent retry is
+          safe and invisible to the client;
+        - an in-flight job that touched the devices (or already rode its
+          one requeue) is marked ``failed`` with a structured
+          ``worker-crashed:`` error — the daemon stays healthy, the
+          client gets a terminal status instead of a forever-running job;
+        - a fresh worker thread takes over either way.
+        """
+        crashed: Optional[Job] = None
+        with self._lock:
+            for job in self._table.values():
+                if job.status == "running":
+                    crashed = job
+                    break
+            # Reset BEFORE the replacement starts: the new worker owns
+            # this flag the moment it pops a job.
+            self._inflight = 0
+        # Replacement FIRST, job settlement second: a client that observes
+        # the crashed job's terminal status (or its requeue) must never
+        # then find healthz reporting a dead worker — the failure and the
+        # recovery must be visible in that order, not the reverse.
+        self._worker_restarts.inc(1)
+        replacement = threading.Thread(
+            target=self._worker_loop, name="serve-worker", daemon=True
+        )
+        self._worker = replacement
+        replacement.start()
+        if crashed is None:
+            return
+        with self._lock:
+            requeue = not crashed.device_began and crashed.requeues < 1
+            if requeue:
+                crashed.requeues += 1
+                crashed.status = "queued"
+                crashed.started_unix = None
+            else:
+                self._fail_crashed_locked(
+                    crashed,
+                    "worker-crashed: the worker thread died mid-job "
+                    "after device work began; not requeued (device "
+                    "state under a crashed update cannot be trusted)"
+                    if crashed.device_began
+                    else "worker-crashed: the worker thread died "
+                    "mid-claim and the job already rode its one "
+                    "requeue",
+                )
+        if requeue:
+            try:
+                # Outside the table lock (the admission path's lock order).
+                self._queue.put(crashed)
+            except (QueueFull, QueueClosed) as e:
+                with self._lock:
+                    self._fail_crashed_locked(
+                        crashed,
+                        f"worker-crashed: requeue rejected ({e}); the "
+                        "claim was side-effect-free but the queue would "
+                        "not take the job back",
+                    )
+                self._completed.labels(status="failed").inc()
+        else:
+            self._completed.labels(status="failed").inc()
+
+    def _fail_crashed_locked(self, job: Job, error: str) -> None:
+        job.status = "failed"
+        job.error = error
+        job.finished_unix = time.time()
+        self._mark_terminal_locked(job)
+
+
+__all__ = ["MEM_LIMIT_CODES", "PcaService", "WATCHDOG_INTERVAL_SECONDS"]

@@ -83,6 +83,12 @@ class Job:
     result: Optional[Dict] = None
     manifest_path: Optional[str] = None
     compile_cache: Optional[str] = None
+    #: Worker-crash recovery bookkeeping (``serve/daemon.py`` watchdog):
+    #: once ``device_began`` flips, a crashed job is failed, never
+    #: requeued — device state under a crashed update cannot be trusted;
+    #: ``requeues`` bounds the one retry a not-yet-begun job may ride.
+    device_began: bool = False
+    requeues: int = 0
 
 
 def classify_conf(conf) -> str:

@@ -51,6 +51,7 @@ class ClientCounters:
     initialized_requests: int = 0
     unsuccessful_responses: int = 0
     io_exceptions: int = 0
+    retries: int = 0
 
     def add_request(self, n: int = 1) -> None:
         self.initialized_requests += n
@@ -60,6 +61,11 @@ class ClientCounters:
 
     def add_io_exception(self, n: int = 1) -> None:
         self.io_exceptions += n
+
+    def add_retry(self, n: int = 1) -> None:
+        """One transient failure the client will retry after backoff —
+        the manifest's transient-pressure signal (``io_retries_total``)."""
+        self.retries += n
 
 
 @dataclass(frozen=True)

@@ -51,6 +51,7 @@ from spark_examples_tpu.sources.base import (
     GenomicsSource,
     ShardBoundary,
 )
+from spark_examples_tpu.utils import faults
 
 #: letter → wire operation (inverse of ``ReadBuilder.CIGAR_MATCH``,
 #: ``models/read.py``; SAM column 6).
@@ -622,11 +623,12 @@ def _read_whole_vcf_bytes(path: str) -> bytes:
     if not path.endswith(".gz"):
         with open(path, "rb") as f:
             # graftcheck: hostmem(unbounded) -- packed whole-file parse: the native chunk-parallel parser spans one contiguous buffer; files past STREAM_THRESHOLD_BYTES take the streaming path instead
-            return f.read()
+            data = f.read()
+        return faults.io_point("files.whole-read", data)
     pieces: List[bytes] = []
     with gzip.open(path, "rb") as f:
         while True:
-            piece = f.read(STREAM_CHUNK_BYTES)
+            piece = faults.io_point("files.whole-read", f.read(STREAM_CHUNK_BYTES))
             if not piece:
                 break
             # graftcheck: hostmem(unbounded) -- decompressed whole-file staging for the packed parse (windowed reads; the compressed copy is never co-resident). Streaming-scale inputs never reach here
@@ -760,7 +762,10 @@ def _iter_vcf_chunks(path: str, chunk_bytes: int) -> Iterator[bytes]:
     carry = b""
     with opener as f:
         while True:
-            data = f.read(chunk_bytes)
+            # Registered IO fault boundary (utils/faults.py): a plan entry
+            # can fail, truncate, or delay exactly one windowed read here —
+            # the reproducible stand-in for a failing disk / truncated file.
+            data = faults.io_point("files.read", f.read(chunk_bytes))
             if not data:
                 break
             if carry:

@@ -30,6 +30,11 @@ from spark_examples_tpu.sources.base import (
     OfflineAuth,
     ShardBoundary,
 )
+from spark_examples_tpu.utils import faults
+from spark_examples_tpu.utils.retry import (
+    full_jitter_delay,
+    retry_after_seconds,
+)
 
 DEFAULT_BASE_URL = "https://www.googleapis.com/genomics/v1beta2"
 
@@ -81,15 +86,24 @@ class RestClient(GenomicsClient):
 
     def _post(self, path: str, payload: Mapping) -> Dict:
         """POST with retries for transient failures only: exponential backoff
-        with full jitter (delay uniform in ``[0, min(cap, base·2^attempt)]``)
-        for 5xx/429/IO errors; non-retryable 4xx raises immediately. Every
-        attempt and failure feeds the reference's accounting counters
-        (``Client.scala:42-54``; report format ``pipeline/stats.py``)."""
+        with full jitter (the shared ``utils/retry.py`` arithmetic — delay
+        uniform in ``[0, min(cap, base·2^attempt)]``) for 5xx/429/IO errors;
+        a server-sent ``Retry-After`` on 429/503 is honored instead, capped
+        by ``backoff_cap`` so a hostile or broken header can never park the
+        pipeline. Non-retryable 4xx raises immediately. Every attempt and
+        failure feeds the reference's accounting counters
+        (``Client.scala:42-54``; report format ``pipeline/stats.py``), and
+        every backoff counts into ``retries`` → the manifest's
+        ``io_retries`` transient-pressure field."""
         url = f"{self.base_url}/{path}"
         last_error: Optional[Exception] = None
         for attempt in range(self.max_retries):
             self.counters.add_request()
+            delay: Optional[float] = None
             try:
+                # Registered IO fault boundary: one transport attempt
+                # (ioerror here exercises this very retry loop).
+                faults.io_point("rest.post")
                 return self.transport(url, payload, self._headers())
             except urllib.error.HTTPError as e:
                 self.counters.add_unsuccessful_response()
@@ -99,12 +113,18 @@ class RestClient(GenomicsClient):
                         "(not retryable)"
                     ) from e
                 last_error = e
+                if e.code in (429, 503):
+                    delay = retry_after_seconds(e.headers, self.backoff_cap)
             except (urllib.error.URLError, OSError) as e:
                 self.counters.add_io_exception()
                 last_error = e
             if attempt + 1 < self.max_retries:
-                ceiling = min(self.backoff_cap, self.backoff_base * (2**attempt))
-                self._sleep(self._rng.uniform(0.0, ceiling))
+                self.counters.add_retry()
+                if delay is None:
+                    delay = full_jitter_delay(
+                        attempt, self.backoff_base, self.backoff_cap, self._rng
+                    )
+                self._sleep(delay)
         raise RuntimeError(f"request to {url} failed after retries") from last_error
 
     def _paginate(

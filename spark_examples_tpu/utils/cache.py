@@ -54,10 +54,15 @@ def enable_persistent_compile_cache() -> None:
 # ---------------------------------------------------------------------------
 
 #: Conf fields that do NOT shape compiled programs: output/telemetry
-#: placement and credentials. Everything else (cohort, block size, mesh,
-#: strategy, dtype flags, ingest path, references, input files) is part of
-#: the geometry — conservative on purpose: a fingerprint hit promises the
-#: in-process jit caches are warm for every kernel this run dispatches.
+#: placement, credentials, and the robustness flags (checkpoint placement,
+#: resume source, fault plans change WHEN work runs, never what the
+#: compiled kernels compute — and the Gramian-checkpoint fingerprint
+#: (``pipeline/checkpoint.py``) requires the saving and the resuming run
+#: to digest identically despite differing in exactly these flags).
+#: Everything else (cohort, block size, mesh, strategy, dtype flags,
+#: ingest path, references, input files) is part of the geometry —
+#: conservative on purpose: a fingerprint hit promises the in-process jit
+#: caches are warm for every kernel this run dispatches.
 _NON_GEOMETRY_FIELDS = frozenset(
     {
         "output_path",
@@ -66,6 +71,10 @@ _NON_GEOMETRY_FIELDS = frozenset(
         "profile_dir",
         "client_secrets",
         "spark_master",
+        "gramian_checkpoint_dir",
+        "checkpoint_every_sites",
+        "resume_from",
+        "fault_plan",
     }
 )
 

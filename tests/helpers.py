@@ -1,6 +1,37 @@
 """Shared test helpers."""
 
+import os
+import subprocess
+import sys
+
 import numpy as np
+
+
+def run_cli(
+    args, env_extra=None, cwd=None, timeout=240, check=False
+):
+    """One CLI invocation as a REAL subprocess (CPU-pinned, no persistent
+    compile cache) — the harness the chaos matrix SIGKILLs mid-run. A dict
+    of extra environment variables (e.g. ``SPARK_EXAMPLES_TPU_FAULTS``)
+    rides on top of the inherited environment."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SPARK_EXAMPLES_TPU_NO_CACHE"] = "1"
+    if env_extra:
+        env.update(env_extra)
+    proc = subprocess.run(
+        [sys.executable, "-m", "spark_examples_tpu", *[str(a) for a in args]],
+        env=env,
+        cwd=cwd,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    if check and proc.returncode != 0:
+        raise AssertionError(
+            f"CLI {args[0]} exited {proc.returncode}:\n{proc.stderr[-4000:]}"
+        )
+    return proc
 
 
 def parse_pc_lines(lines):

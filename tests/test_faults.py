@@ -1,0 +1,939 @@
+"""Deterministic fault injection + crash-consistent checkpointing.
+
+Four surfaces, one recovery story:
+
+- the fault-plan grammar and the in-process hook semantics
+  (``utils/faults.py``: registered sites, one-shot deterministic firing,
+  kill/raise/crash vs ioerror/truncate/slow);
+- crash consistency of both checkpoint families
+  (``pipeline/checkpoint.py``): corruption is DETECTED (typed errors, not
+  parser tracebacks), resume fast-forward splits blocks exactly, and the
+  Gramian artifact round-trips with fingerprint enforcement;
+- the subprocess chaos matrix: a real CLI run SIGKILLed at EVERY
+  registered driver/checkpoint kill-point, resumed with ``--resume-from``,
+  and the eigenvector TSV byte-compared against an uninterrupted oracle —
+  the acceptance contract of ISSUE 9;
+- the serve self-healing loop: an injected worker crash mid-job yields a
+  ``failed`` job with a structured error while the daemon keeps serving,
+  and a crash before device work requeues exactly once.
+
+Plus the retry satellites: ``Retry-After`` + full jitter in
+``sources/rest.py`` (counted into ``io_retries``) and idempotent-GET
+retries in ``serve/client.py`` (POST stays single-shot).
+"""
+
+import email.message
+import gzip
+import io
+import json
+import os
+import random
+import signal
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.pipeline import checkpoint as cp
+from spark_examples_tpu.utils import faults
+from spark_examples_tpu.utils.retry import (
+    full_jitter_delay,
+    retry_after_seconds,
+)
+
+from helpers import run_cli
+
+#: The injected worker crash (a BaseException) escapes its thread BY
+#: DESIGN — pytest's unhandled-thread-exception warning is the expected
+#: crash signature here, not a defect.
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+
+TINY_FLAGS = ["--num-samples", "8", "--references", "1:0:50000"]
+
+
+@pytest.fixture(autouse=True)
+def _reset_fault_plan():
+    """Every test starts and ends with no active plan (configure(None)
+    also blocks lazy env-var pickup, so a leaked SPARK_EXAMPLES_TPU_FAULTS
+    cannot contaminate in-process tests)."""
+    faults.configure(None)
+    yield
+    faults.configure(None)
+
+
+# ------------------------------------------------------------ plan grammar
+
+
+def test_parse_plan_grammar():
+    entries = faults.parse_plan(
+        "kill@driver.post-flush, raise@driver.pre-finalize#3,"
+        "truncate@files.read=4096,slow@rest.post=0.05"
+    )
+    assert [(e.action, e.site, e.nth, e.arg) for e in entries] == [
+        ("kill", "driver.post-flush", 1, None),
+        ("raise", "driver.pre-finalize", 3, None),
+        ("truncate", "files.read", 1, "4096"),
+        ("slow", "rest.post", 1, "0.05"),
+    ]
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "no-at-sign",
+        "explode@driver.post-flush",  # unknown action
+        "kill@not.a.site",  # unknown site
+        "kill@driver.post-flush#0",  # occurrence must be >= 1
+        "kill@driver.post-flush#x",  # non-integer occurrence
+        "truncate@files.read",  # truncate needs =BYTES
+        "slow@rest.post=soon",  # slow needs =SECONDS
+        "truncate@driver.post-flush=4",  # IO action at a kill-point
+        "truncate@rest.post=4",  # rest.post carries no payload to shorten
+        "raise@files.read",  # control action at an IO point
+    ],
+)
+def test_parse_plan_rejects_bad_specs(spec):
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse_plan(spec)
+
+
+def test_config_rejects_bad_fault_plan_at_parse_time():
+    from spark_examples_tpu.config import PcaConf
+
+    with pytest.raises(ValueError):
+        PcaConf.parse(TINY_FLAGS + ["--fault-plan", "kill@not.a.site"])
+
+
+# ------------------------------------------------------------ hook behavior
+
+
+def test_hooks_are_noops_without_a_plan():
+    faults.kill_point("driver.post-flush")
+    assert faults.io_point("files.read", b"payload") == b"payload"
+    assert faults.injected_count() == 0
+
+
+def test_unregistered_sites_raise_key_error():
+    with pytest.raises(KeyError):
+        faults.kill_point("driver.not-registered")
+    with pytest.raises(KeyError):
+        faults.io_point("files.not-registered")
+
+
+def test_io_point_fires_at_exact_occurrence():
+    faults.configure("truncate@files.read#2=3")
+    assert faults.io_point("files.read", b"abcdef") == b"abcdef"
+    assert faults.io_point("files.read", b"abcdef") == b"abc"
+    # One-shot: the third hit passes through untouched.
+    assert faults.io_point("files.read", b"abcdef") == b"abcdef"
+    count, hits = faults.snapshot()
+    assert count == 1 and hits == {"files.read": 3}
+
+
+def test_io_point_ioerror_and_kill_point_raise():
+    faults.configure("ioerror@files.read,raise@driver.pre-finalize")
+    with pytest.raises(OSError, match="injected IO error"):
+        faults.io_point("files.read", b"x")
+    with pytest.raises(faults.InjectedFault):
+        faults.kill_point("driver.pre-finalize")
+    assert faults.injected_count() == 2
+
+
+def test_worker_crash_escapes_except_exception():
+    faults.configure("crash@serve.worker.mid-job")
+    with pytest.raises(faults.InjectedWorkerCrash):
+        try:
+            faults.kill_point("serve.worker.mid-job")
+        except Exception:  # noqa: BLE001 — the point: crash is NOT caught
+            pytest.fail("InjectedWorkerCrash must escape `except Exception`")
+    assert not issubclass(faults.InjectedWorkerCrash, Exception)
+
+
+def test_io_fault_reaches_the_streamed_read_boundary(tmp_path):
+    """The hook is wired into the real windowed read loop: an injected
+    ioerror on the second window surfaces from the chunk iterator."""
+    from spark_examples_tpu.sources.files import _iter_vcf_chunks
+
+    path = tmp_path / "data.txt"
+    path.write_bytes(b"line-one\nline-two\nline-three\n")
+    faults.configure("ioerror@files.read#2")
+    with pytest.raises(OSError, match="injected IO error"):
+        list(_iter_vcf_chunks(str(path), chunk_bytes=64))
+
+
+# -------------------------------------------------- retry arithmetic (shared)
+
+
+def test_full_jitter_delay_is_bounded():
+    rng = random.Random(7)
+    for attempt in range(6):
+        d = full_jitter_delay(attempt, 0.5, 8.0, rng)
+        assert 0.0 <= d <= min(8.0, 0.5 * 2**attempt)
+
+
+def test_retry_after_parses_and_caps():
+    headers = email.message.Message()
+    headers["Retry-After"] = "7"
+    assert retry_after_seconds(headers, 60.0) == 7.0
+    headers.replace_header("Retry-After", "9999")
+    assert retry_after_seconds(headers, 8.0) == 8.0
+    headers.replace_header("Retry-After", "not-a-date")
+    assert retry_after_seconds(headers, 8.0) is None
+    assert retry_after_seconds(None, 8.0) is None
+
+
+def _http_error(code, retry_after=None):
+    headers = email.message.Message()
+    if retry_after is not None:
+        headers["Retry-After"] = str(retry_after)
+    return urllib.error.HTTPError(
+        "http://svc/x", code, "boom", headers, io.BytesIO(b"")
+    )
+
+
+def test_rest_client_honors_retry_after_and_counts_retries():
+    from spark_examples_tpu.pipeline.stats import VariantsDatasetStats
+    from spark_examples_tpu.sources.rest import RestClient
+
+    attempts = []
+    sleeps = []
+
+    def transport(url, payload, headers):
+        attempts.append(url)
+        if len(attempts) == 1:
+            raise _http_error(429, retry_after=7)
+        return {"ok": True}
+
+    client = RestClient(
+        auth=None,
+        transport=transport,
+        max_retries=3,
+        backoff_base=0.5,
+        backoff_cap=60.0,
+        sleep=sleeps.append,
+        rng=random.Random(0),
+    )
+    assert client._post("variants/search", {}) == {"ok": True}
+    assert sleeps == [7.0]  # the server's word, not jitter
+    assert client.counters.retries == 1
+    assert client.counters.unsuccessful_responses == 1
+
+    stats = VariantsDatasetStats()
+    stats.add_client(client.counters)
+    assert stats.as_dict()["io_retries"] == 1
+    assert stats.registry.value("io_retries_total") == 1
+
+
+def test_rest_client_caps_hostile_retry_after():
+    from spark_examples_tpu.sources.rest import RestClient
+
+    sleeps = []
+    calls = []
+
+    def transport(url, payload, headers):
+        calls.append(url)
+        if len(calls) < 3:
+            raise _http_error(503, retry_after=99999)
+        return {"ok": True}
+
+    client = RestClient(
+        auth=None,
+        transport=transport,
+        max_retries=3,
+        backoff_cap=8.0,
+        sleep=sleeps.append,
+        rng=random.Random(0),
+    )
+    assert client._post("variants/search", {}) == {"ok": True}
+    assert sleeps == [8.0, 8.0]  # a broken header cannot park the pipeline
+    assert client.counters.retries == 2
+
+
+def test_rest_client_falls_back_to_jitter_without_header():
+    from spark_examples_tpu.sources.rest import RestClient
+
+    sleeps = []
+    calls = []
+
+    def transport(url, payload, headers):
+        calls.append(url)
+        if len(calls) == 1:
+            raise _http_error(500)
+        return {"ok": True}
+
+    client = RestClient(
+        auth=None,
+        transport=transport,
+        max_retries=3,
+        backoff_base=0.5,
+        backoff_cap=8.0,
+        sleep=sleeps.append,
+        rng=random.Random(0),
+    )
+    assert client._post("variants/search", {}) == {"ok": True}
+    assert len(sleeps) == 1 and 0.0 <= sleeps[0] <= 0.5
+
+
+class _FakeResponse:
+    def __init__(self, body=b'{"status": "ok"}'):
+        self.status = 200
+        self._body = body
+        self.headers = email.message.Message()
+        self.headers["Content-Type"] = "application/json"
+
+    def read(self, n=-1):
+        return self._body
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def test_serve_client_retries_idempotent_gets(monkeypatch):
+    from spark_examples_tpu.serve.client import ServeClient
+
+    calls = []
+
+    def flaky(req, timeout=None):
+        calls.append((req.get_method(), req.full_url))
+        if len(calls) == 1:
+            raise urllib.error.URLError(ConnectionResetError("reset"))
+        if len(calls) == 2:
+            raise _http_error(503, retry_after=0)
+        return _FakeResponse()
+
+    monkeypatch.setattr(urllib.request, "urlopen", flaky)
+    client = ServeClient(
+        "http://svc", max_retries=3, sleep=lambda s: None,
+        rng=random.Random(0),
+    )
+    assert client.healthz() == {"status": "ok"}
+    assert [m for m, _ in calls] == ["GET", "GET", "GET"]
+
+
+def test_serve_client_post_is_single_shot(monkeypatch):
+    from spark_examples_tpu.serve.client import ServeClient
+
+    calls = []
+
+    def refused(req, timeout=None):
+        calls.append(req.get_method())
+        raise urllib.error.URLError(ConnectionResetError("reset"))
+
+    monkeypatch.setattr(urllib.request, "urlopen", refused)
+    client = ServeClient(
+        "http://svc", max_retries=3, sleep=lambda s: None
+    )
+    with pytest.raises(urllib.error.URLError):
+        client.submit(TINY_FLAGS)
+    assert calls == ["POST"]  # a retried submit could enqueue twice
+
+
+# ------------------------------------------- variant checkpoint corruption
+
+
+def _variant_records(n=30):
+    from spark_examples_tpu.models.variant import VariantKey, VariantsBuilder
+
+    records = []
+    for i in range(n):
+        built = VariantsBuilder.build(
+            {
+                "referenceName": "1",
+                "variantSetId": "s",
+                "id": f"v{i}",
+                "start": 100 + i,
+                "end": 101 + i,
+                "referenceBases": "A",
+                "alternateBases": ["T"],
+                "info": {"AF": ["0.5"]},
+                "calls": [
+                    {
+                        "callSetId": "s-0",
+                        "callSetName": "S0",
+                        "genotype": [0, 1],
+                    }
+                ],
+            }
+        )
+        assert built is not None
+        records.append((VariantKey("1", 100 + i), built[1]))
+    return records
+
+
+def _write_checkpoint(path, records):
+    cp.save_variants(str(path), [records[:20], records[20:]])
+
+
+def test_rematerialize_into_smaller_checkpoint_stays_loadable(tmp_path):
+    """Re-running --save-variants into the same dir with fewer shards must
+    drop the stale part files: the reader's parts cross-check would
+    otherwise reject every later load of a perfectly good checkpoint."""
+    path = tmp_path / "ckpt"
+    records = _variant_records()
+    cp.save_variants(str(path), [records[:10], records[10:20], records[20:]])
+    cp.save_variants(str(path), [records[:20], records[20:]])  # 3 → 2 parts
+    parts = sorted(n for n in os.listdir(path) if n.startswith("part-"))
+    assert parts == ["part-00000.jsonl.gz", "part-00001.jsonl.gz"]
+    loaded = cp.load_variants(str(path))
+    assert sum(1 for _ in loaded) == len(records)
+
+
+def test_missing_manifest_is_a_typed_error(tmp_path):
+    path = tmp_path / "ckpt"
+    _write_checkpoint(path, _variant_records())
+    os.remove(path / "_manifest.json")
+    with pytest.raises(cp.CheckpointCorruptError, match="never completed"):
+        cp.load_variants(str(path))
+
+
+def test_truncated_manifest_is_a_typed_error(tmp_path):
+    """A crash mid-manifest-write used to surface as a raw JSONDecodeError;
+    with the atomic publish it can only happen to an externally-damaged
+    file — and still gets the clean 'cannot be trusted' diagnosis."""
+    path = tmp_path / "ckpt"
+    _write_checkpoint(path, _variant_records())
+    manifest = path / "_manifest.json"
+    manifest.write_bytes(manifest.read_bytes()[:10])
+    with pytest.raises(
+        cp.CheckpointCorruptError, match="truncated or unparseable"
+    ):
+        cp.load_variants(str(path))
+
+
+def test_manifest_write_is_atomic(tmp_path):
+    """The tmp file never lingers and the manifest appears only whole."""
+    path = tmp_path / "ckpt"
+    _write_checkpoint(path, _variant_records())
+    leftovers = [n for n in os.listdir(path) if n.endswith(".tmp")]
+    assert leftovers == []
+    with open(path / "_manifest.json") as f:
+        manifest = json.load(f)
+    assert manifest["parts"] == 2 and manifest["records"] == 30
+
+
+def test_deleted_part_fails_on_open(tmp_path):
+    path = tmp_path / "ckpt"
+    _write_checkpoint(path, _variant_records())
+    os.remove(path / "part-00001.jsonl.gz")
+    with pytest.raises(cp.CheckpointCorruptError, match="on disk"):
+        cp.load_variants(str(path))
+
+
+def test_foreign_part_fails_on_open(tmp_path):
+    path = tmp_path / "ckpt"
+    _write_checkpoint(path, _variant_records())
+    with gzip.open(path / "part-00002.jsonl.gz", "wt") as f:
+        f.write("{}\n")
+    with pytest.raises(cp.CheckpointCorruptError, match="on disk"):
+        cp.load_variants(str(path))
+
+
+def test_record_count_mismatch_fails_on_full_iteration(tmp_path):
+    path = tmp_path / "ckpt"
+    records = _variant_records()
+    _write_checkpoint(path, records)
+    # Re-write one part with a record quietly dropped (same part count, so
+    # open() passes; only the full-iteration re-count can prove the loss).
+    part = path / "part-00000.jsonl.gz"
+    with gzip.open(part, "rt") as f:
+        lines = f.readlines()
+    with gzip.open(part, "wt") as f:
+        f.writelines(lines[:-1])
+    loaded = cp.load_variants(str(path))
+    with pytest.raises(cp.CheckpointCorruptError, match="full iteration"):
+        list(loaded)
+
+
+def test_truncated_part_gzip_stream_is_a_typed_error(tmp_path):
+    path = tmp_path / "ckpt"
+    _write_checkpoint(path, _variant_records())
+    part = path / "part-00000.jsonl.gz"
+    part.write_bytes(part.read_bytes()[:-7])  # torn gzip stream
+    loaded = cp.load_variants(str(path))
+    with pytest.raises(cp.CheckpointCorruptError):
+        list(loaded)
+
+
+# ------------------------------------------------ Gramian checkpoint + feeder
+
+
+def _gramian_state(sites_shape=(1, 4, 4)):
+    return {
+        "strategy": "dense",
+        "G": np.arange(np.prod(sites_shape), dtype=np.int32).reshape(
+            sites_shape
+        ),
+        "accum_dtype": "int32",
+        "exact_int": True,
+        "entry_bound": 7,
+        "rows_seen": 12,
+        "flushes": 3,
+        "num_samples": 4,
+        "data_parallel": 1,
+        "padded": 4,
+    }
+
+
+def test_gramian_checkpoint_round_trip_and_fingerprint(tmp_path):
+    directory = str(tmp_path / "ck")
+    cp.save_gramian_checkpoint(directory, _gramian_state(), "fp-1", 12)
+    loaded = cp.load_gramian_checkpoint(directory, "fp-1")
+    assert loaded["meta"]["sites"] == 12
+    assert loaded["meta"]["accum_dtype"] == "int32"
+    np.testing.assert_array_equal(loaded["G"], _gramian_state()["G"])
+    # Fingerprint drift = a DIFFERENT analysis; merging would be silent lies.
+    with pytest.raises(cp.CheckpointMismatchError, match="fingerprint"):
+        cp.load_gramian_checkpoint(directory, "fp-2")
+
+
+def test_gramian_checkpoint_absent_and_corrupt(tmp_path):
+    assert cp.load_gramian_checkpoint(str(tmp_path / "nope")) is None
+    directory = str(tmp_path / "ck")
+    cp.save_gramian_checkpoint(directory, _gramian_state(), "fp", 1)
+    artifact = os.path.join(directory, cp.GRAMIAN_CKPT)
+    with open(artifact, "wb") as f:
+        f.write(b"not an npz")
+    with pytest.raises(cp.CheckpointCorruptError, match="delete"):
+        cp.load_gramian_checkpoint(directory)
+
+
+def test_gramian_checkpoint_bad_zip_tail_is_a_typed_error(tmp_path):
+    """A valid zip magic with a corrupt tail (disk corruption, partial
+    copy) raises BadZipFile inside np.load, not ValueError — it must get
+    the same typed diagnosis as any other unreadable artifact."""
+    directory = str(tmp_path / "ck")
+    cp.save_gramian_checkpoint(directory, _gramian_state(), "fp", 1)
+    artifact = os.path.join(directory, cp.GRAMIAN_CKPT)
+    with open(artifact, "wb") as f:
+        f.write(b"PK\x03\x04" + b"\x00" * 64)  # zip magic, garbage body
+    with pytest.raises(cp.CheckpointCorruptError, match="delete"):
+        cp.load_gramian_checkpoint(directory)
+
+
+def test_gramian_checkpoint_save_sweeps_orphaned_tmps(tmp_path):
+    """Every mid-write kill leaves a full-size pid-named tmp and each
+    resume runs under a fresh pid — saves must sweep the orphans or a
+    repeatedly-preempted run fills the directory with dead O(N²) files."""
+    directory = str(tmp_path / "ck")
+    os.makedirs(directory)
+    orphan = os.path.join(directory, f"{cp.GRAMIAN_CKPT}.99999.tmp")
+    with open(orphan, "wb") as f:
+        f.write(b"x" * 128)
+    cp.save_gramian_checkpoint(directory, _gramian_state(), "fp", 1)
+    leftovers = [n for n in os.listdir(directory) if n.endswith(".tmp")]
+    assert leftovers == []
+    assert cp.load_gramian_checkpoint(directory, "fp")["meta"]["sites"] == 1
+
+
+def test_gramian_checkpoint_fingerprint_ignores_robustness_flags():
+    """The saving run and the resuming run must digest identically even
+    though they differ in exactly the checkpoint/resume/fault flags."""
+    from spark_examples_tpu.config import PcaConf
+
+    saver = PcaConf.parse(
+        TINY_FLAGS + ["--gramian-checkpoint-dir", "/tmp/a"]
+    )
+    resumer = PcaConf.parse(
+        TINY_FLAGS
+        + [
+            "--gramian-checkpoint-dir",
+            "/tmp/b",
+            "--resume-from",
+            "/tmp/a",
+            "--checkpoint-every-sites",
+            "17",
+            "--fault-plan",
+            "slow@rest.post=0",
+        ]
+    )
+    other = PcaConf.parse(["--num-samples", "9"] + TINY_FLAGS[2:])
+    assert cp.gramian_checkpoint_fingerprint(
+        saver
+    ) == cp.gramian_checkpoint_fingerprint(resumer)
+    assert cp.gramian_checkpoint_fingerprint(
+        saver
+    ) != cp.gramian_checkpoint_fingerprint(other)
+
+
+class _StubAcc:
+    def __init__(self):
+        self.fed = []
+        self.restored = None
+
+    def add_rows(self, rows):
+        self.fed.append(np.asarray(rows))
+
+    def restore_state(self, ckpt):
+        self.restored = ckpt
+
+    def snapshot_state(self):
+        return _gramian_state()
+
+
+def test_feeder_resume_skips_the_cursor_and_splits_blocks():
+    acc = _StubAcc()
+    resume = {"meta": {**_gramian_state(), "sites": 5}, "G": None}
+    feeder = cp.GramianFeeder(acc, resume=resume)
+    assert acc.restored is resume
+    blocks = [np.arange(12).reshape(3, 4) + 10 * i for i in range(3)]
+    for block in blocks:
+        feeder.add_rows(block)
+    # 5 rows skipped: block 0 whole (3), block 1 split (2 of 3).
+    assert feeder.sites_skipped == 5
+    assert feeder.checkpoint_sites == 5
+    assert feeder.sites_done == 9
+    fed = np.concatenate(acc.fed)
+    np.testing.assert_array_equal(
+        fed, np.concatenate(blocks)[5:]
+    )
+
+
+def test_feeder_finish_rejects_truncated_input_stream():
+    """The fingerprint covers conf flags and input paths, not file
+    contents: an input that SHRANK since the checkpoint was written is
+    only detectable at end of ingest — finish() must refuse to finalize
+    a silently wrong analysis from the stale partial."""
+    acc = _StubAcc()
+    resume = {"meta": {**_gramian_state(), "sites": 5}, "G": None}
+    feeder = cp.GramianFeeder(acc, resume=resume)
+    feeder.add_rows(np.arange(12).reshape(3, 4))  # stream ends at 3 < 5
+    with pytest.raises(cp.CheckpointMismatchError):
+        feeder.finish()
+    assert acc.fed == []  # nothing past the cursor was ever fed
+
+
+def test_feeder_saves_on_cadence_and_finish(tmp_path):
+    directory = str(tmp_path / "ck")
+    acc = _StubAcc()
+    feeder = cp.GramianFeeder(
+        acc, directory=directory, every_sites=4, fingerprint="fp"
+    )
+    feeder.add_rows(np.zeros((3, 4), dtype=np.uint8))
+    assert feeder.saves == 0
+    feeder.add_rows(np.zeros((3, 4), dtype=np.uint8))
+    assert feeder.saves == 1  # crossed the 4-site cadence at 6
+    assert cp.load_gramian_checkpoint(directory, "fp")["meta"]["sites"] == 6
+    feeder.add_rows(np.zeros((1, 4), dtype=np.uint8))
+    feeder.finish()  # final snapshot covers the tail
+    assert feeder.saves == 2
+    assert cp.load_gramian_checkpoint(directory, "fp")["meta"]["sites"] == 7
+
+
+# ------------------------------------------------------ plan validator hooks
+
+
+def test_plan_validates_checkpoint_and_fault_flags():
+    from spark_examples_tpu.check.plan import validate_plan
+    from spark_examples_tpu.config import PcaConf
+
+    conf = PcaConf(
+        references="1:0:50000",
+        num_samples=8,
+        pca_backend="host",
+        gramian_checkpoint_dir="/tmp/ck",
+        fault_plan="kill@not.a.site",
+    )
+    codes = [i.code for i in validate_plan(conf, plan_devices=1).issues]
+    assert "checkpoint-backend" in codes
+    assert "fault-plan" in codes
+
+    conf = PcaConf(
+        references="1:0:50000",
+        num_samples=8,
+        ingest="device",
+        resume_from="/tmp/ck",
+    )
+    codes = [i.code for i in validate_plan(conf, plan_devices=1).issues]
+    assert "checkpoint-device-ingest" in codes
+
+
+# ------------------------------------------------------ chaos matrix (CLI)
+
+
+#: Occurrence per kill-point: post-flush/mid-write/post-save use the 2nd
+#: hit so at least one COMPLETE artifact precedes the crash (mid-write's
+#: tmp is torn on top of it); pre-finalize fires once, after the final
+#: snapshot — resume must then skip the whole stream.
+CHAOS_MATRIX = [
+    ("driver.post-flush", 2, True),
+    ("checkpoint.mid-write", 2, True),
+    ("checkpoint.post-save", 2, True),
+    ("driver.pre-finalize", 1, True),
+]
+
+
+def test_chaos_matrix_covers_every_driver_kill_point():
+    """The matrix below must enumerate every registered driver/checkpoint
+    kill-point — a new kill-point without chaos coverage fails HERE."""
+    registered = {
+        site
+        for site in faults.KILL_POINTS
+        if site.startswith(("driver.", "checkpoint."))
+    }
+    assert registered == {site for site, _, _ in CHAOS_MATRIX}
+
+
+def test_chaos_matrix_kill_resume_parity(tmp_path):
+    """SIGKILL a real CLI run at every registered driver/checkpoint
+    kill-point; ``--resume-from`` must reproduce the uninterrupted
+    oracle's eigenvector TSV byte for byte (the int32/f32 exactness
+    contracts make this assertable, not approximate), and the resumed
+    manifest must carry the resume accounting block."""
+    flags = [
+        "variants-pca",
+        "--num-samples", "8",
+        "--references", "1:0:150000",
+        "--ingest", "packed",
+        "--checkpoint-every-sites", "40",
+    ]
+    oracle_out = tmp_path / "oracle"
+    run_cli(
+        flags
+        + [
+            "--gramian-checkpoint-dir", tmp_path / "ck-oracle",
+            "--output-path", oracle_out,
+        ],
+        check=True,
+    )
+    oracle_tsv = (
+        tmp_path / "oracle-pca.tsv" / "part-00000"
+    ).read_bytes()
+    assert oracle_tsv
+
+    for site, nth, expect_skip in CHAOS_MATRIX:
+        ck = tmp_path / f"ck-{site}"
+        killed = run_cli(
+            flags
+            + ["--gramian-checkpoint-dir", ck, "--output-path",
+               tmp_path / f"killed-{site}"],
+            env_extra={"SPARK_EXAMPLES_TPU_FAULTS": f"kill@{site}#{nth}"},
+        )
+        assert killed.returncode == -signal.SIGKILL, (
+            f"{site}: expected SIGKILL, got rc={killed.returncode}\n"
+            f"{killed.stderr[-2000:]}"
+        )
+        resumed_out = tmp_path / f"resumed-{site}"
+        manifest = tmp_path / f"resumed-{site}.json"
+        run_cli(
+            flags
+            + [
+                "--gramian-checkpoint-dir", ck,
+                "--resume-from", ck,
+                "--output-path", resumed_out,
+                "--metrics-json", manifest,
+            ],
+            check=True,
+        )
+        resumed_tsv = (
+            tmp_path / f"resumed-{site}-pca.tsv" / "part-00000"
+        ).read_bytes()
+        assert resumed_tsv == oracle_tsv, f"{site}: resume parity broken"
+        doc = json.loads(manifest.read_text())
+        resume = doc["resume"]
+        assert resume is not None, f"{site}: manifest missing resume block"
+        assert resume["faults_injected"] == 0
+        assert resume["sites_skipped"] == resume["checkpoint_sites"]
+        if expect_skip:
+            # A complete artifact preceded the crash: the fast-forward
+            # must have skipped real ingest.
+            assert resume["sites_skipped"] > 0, f"{site}: nothing resumed"
+        from spark_examples_tpu.obs.manifest import validate_manifest
+
+        assert validate_manifest(doc) == []
+
+
+def test_resume_from_torn_first_write_starts_from_zero(tmp_path):
+    """A run killed DURING its very first artifact write leaves only the
+    tmp file; resume must ignore it and start from zero, cleanly."""
+    flags = [
+        "variants-pca",
+        "--num-samples", "8",
+        "--references", "1:0:150000",
+        "--ingest", "packed",
+        "--checkpoint-every-sites", "40",
+    ]
+    ck = tmp_path / "ck"
+    killed = run_cli(
+        flags + ["--gramian-checkpoint-dir", ck],
+        env_extra={
+            "SPARK_EXAMPLES_TPU_FAULTS": "kill@checkpoint.mid-write#1"
+        },
+    )
+    assert killed.returncode == -signal.SIGKILL
+    names = os.listdir(ck)
+    assert cp.GRAMIAN_CKPT not in names  # only the torn tmp remains
+    manifest = tmp_path / "resumed.json"
+    resumed = run_cli(
+        flags + ["--resume-from", ck, "--metrics-json", manifest],
+        check=True,
+    )
+    assert "Non zero rows in matrix: 8 / 8." in resumed.stdout
+    doc = json.loads(manifest.read_text())
+    assert doc["resume"]["sites_skipped"] == 0
+
+
+def test_resume_rejects_fingerprint_drift(tmp_path):
+    """Resuming with flags that shape a DIFFERENT analysis must fail
+    loudly before any ingest, not merge two different Gramians."""
+    base = [
+        "variants-pca",
+        "--num-samples", "8",
+        "--references", "1:0:50000",
+        "--ingest", "packed",
+    ]
+    ck = tmp_path / "ck"
+    run_cli(base + ["--gramian-checkpoint-dir", ck], check=True)
+    drifted = run_cli(
+        [
+            "variants-pca",
+            "--num-samples", "12",
+            "--references", "1:0:50000",
+            "--ingest", "packed",
+            "--resume-from", ck,
+        ]
+    )
+    assert drifted.returncode != 0
+    assert "fingerprint" in drifted.stderr
+
+
+# ------------------------------------------------------- serve self-healing
+
+
+class _InstantExecutor:
+    def __init__(self):
+        self.calls = 0
+
+    def __call__(self, job, run_dir):
+        from spark_examples_tpu.serve.executor import ExecutionOutcome
+
+        self.calls += 1
+        return ExecutionOutcome(
+            result={"ok": True}, manifest_path=None, compile_cache="cold"
+        )
+
+
+def _wait_terminal(svc, job_id, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        _status, doc = svc.job_status(job_id)
+        if doc["job"]["status"] in ("done", "failed", "cancelled"):
+            return doc["job"]
+        time.sleep(0.02)
+    raise AssertionError(f"job {job_id} never reached a terminal state")
+
+
+def _submit(svc, flags=TINY_FLAGS):
+    from spark_examples_tpu.serve.protocol import request_doc
+
+    status, doc = svc.submit(request_doc(flags))
+    assert status == 202, doc
+    return doc["job"]["id"]
+
+
+def test_daemon_start_rejects_malformed_env_fault_plan(tmp_path, monkeypatch):
+    """A typo'd SPARK_EXAMPLES_TPU_FAULTS must fail the daemon AT STARTUP
+    (the batch path's run_pipeline does the same): lazily parsed at the
+    first hook, it would instead surface as a crash/restart loop where
+    every job rides its one requeue and then fails 'worker-crashed:'."""
+    from spark_examples_tpu.serve.daemon import PcaService
+
+    monkeypatch.setenv(faults.ENV_VAR, "kill@serve.wrker.claim")
+    with faults._lock:
+        faults._plan_entries = faults._UNSET  # arm lazy env-var pickup
+    svc = PcaService(run_dir=str(tmp_path), executor=_InstantExecutor())
+    with pytest.raises(faults.FaultSpecError):
+        svc.start()
+
+
+def test_watchdog_fails_mid_job_crash_and_keeps_serving(tmp_path):
+    """The acceptance contract: a worker crash mid-job (after device work
+    began) yields a failed job with a structured error, the daemon stays
+    healthy, and the next job completes on a fresh worker — no requeue of
+    jobs that already touched the devices."""
+    from spark_examples_tpu.serve.daemon import PcaService
+
+    executor = _InstantExecutor()
+    faults.configure("crash@serve.worker.mid-job")
+    svc = PcaService(run_dir=str(tmp_path), executor=executor).start()
+    try:
+        job = _wait_terminal(svc, _submit(svc))
+        assert job["status"] == "failed"
+        assert job["error"].startswith("worker-crashed:")
+        assert "not requeued" in job["error"]
+        assert executor.calls == 0  # the crash preempted the executor
+
+        health = svc.healthz()
+        assert health["status"] == "ok"
+        assert health["queue"]["worker_alive"]
+        assert health["queue"]["worker_restarts"] == 1
+
+        job2 = _wait_terminal(svc, _submit(svc))
+        assert job2["status"] == "done"
+        assert executor.calls == 1
+    finally:
+        assert svc.stop(timeout=10.0)
+
+
+def test_watchdog_requeues_claim_crash_once(tmp_path):
+    """A crash BEFORE device work began is side-effect-free: the watchdog
+    requeues the job once and it completes invisibly to the client."""
+    from spark_examples_tpu.serve.daemon import PcaService
+
+    executor = _InstantExecutor()
+    faults.configure("crash@serve.worker.claim")
+    svc = PcaService(run_dir=str(tmp_path), executor=executor).start()
+    try:
+        job = _wait_terminal(svc, _submit(svc))
+        assert job["status"] == "done"
+        assert executor.calls == 1
+        assert svc.healthz()["queue"]["worker_restarts"] == 1
+    finally:
+        assert svc.stop(timeout=10.0)
+
+
+def test_watchdog_double_claim_crash_fails_the_job(tmp_path):
+    """The one-requeue bound: a job whose claim crashes the worker twice
+    is failed, not retried forever."""
+    from spark_examples_tpu.serve.daemon import PcaService
+
+    executor = _InstantExecutor()
+    faults.configure(
+        "crash@serve.worker.claim#1,crash@serve.worker.claim#2"
+    )
+    svc = PcaService(run_dir=str(tmp_path), executor=executor).start()
+    try:
+        job = _wait_terminal(svc, _submit(svc))
+        assert job["status"] == "failed"
+        assert job["error"].startswith("worker-crashed:")
+        assert "requeue" in job["error"]
+        assert executor.calls == 0
+        assert svc.healthz()["queue"]["worker_restarts"] == 2
+        # And the daemon still serves.
+        assert _wait_terminal(svc, _submit(svc))["status"] == "done"
+    finally:
+        assert svc.stop(timeout=10.0)
+
+
+def test_drain_completes_after_a_crash(tmp_path):
+    """A crash does not break the drain contract: remaining admitted jobs
+    finish on the replacement worker and stop() returns True."""
+    from spark_examples_tpu.serve.daemon import PcaService
+
+    executor = _InstantExecutor()
+    faults.configure("crash@serve.worker.mid-job")
+    svc = PcaService(run_dir=str(tmp_path), executor=executor).start()
+    first = _submit(svc)
+    second = _submit(svc)
+    svc.begin_drain()
+    assert svc.wait_drained(timeout=10.0)
+    _status, doc1 = svc.job_status(first)
+    _status, doc2 = svc.job_status(second)
+    statuses = {doc1["job"]["status"], doc2["job"]["status"]}
+    assert statuses == {"failed", "done"}

@@ -151,6 +151,7 @@ def test_stats_report_format_unchanged_and_writes_forbidden():
         "requests": 5,
         "unsuccessful_responses": 1,
         "io_exceptions": 1,
+        "io_retries": 0,
     }
 
 
@@ -401,6 +402,24 @@ def test_manifest_validation_catches_tampering():
     assert validate_manifest([]) == ["manifest is not a JSON object"]
 
 
+def test_manifest_validation_treats_io_retries_as_additive():
+    """``io_retries`` joined IO_STAT_FIELDS after schema v2 shipped:
+    archived v2 manifests without it must still validate (the additive
+    contract), while the other fields stay required."""
+    from spark_examples_tpu.obs.manifest import IO_STAT_FIELDS
+
+    doc = build_run_manifest(
+        conf={}, spans=SpanRecorder(), registry=MetricsRegistry()
+    )
+    doc = json.loads(json.dumps(doc))
+    doc["io_stats"] = {f: 0 for f in IO_STAT_FIELDS}
+    assert validate_manifest(doc) == []
+    del doc["io_stats"]["io_retries"]  # a pre-0.6 archived manifest
+    assert validate_manifest(doc) == []
+    del doc["io_stats"]["requests"]  # required fields stay enforced
+    assert any("io_stats.requests" in e for e in validate_manifest(doc))
+
+
 # ------------------------------------------------- end-to-end driver parity
 
 
@@ -436,7 +455,9 @@ def test_manifest_matches_printed_epilogue_exactly(tmp_path, capsys):
     out = capsys.readouterr().out
     doc = read_manifest(str(path))
     assert validate_manifest(doc) == []
-    assert doc["io_stats"] == _parse_epilogue(out)
+    # io_retries rides the manifest only (the printed report keeps the
+    # reference's six-line format, pipeline/stats.py).
+    assert doc["io_stats"] == {**_parse_epilogue(out), "io_retries": 0}
     # Stage spans match the printed Stage timings block to the 3 printed
     # decimals (both are views of one measurement).
     printed = dict(
