@@ -128,6 +128,136 @@ CONFIGS = {
 }
 
 
+# -------------------------------------------------------------- analyses bench
+# The population-genetics analyses (analyses/: GRM/kinship, windowed LD
+# pruning, association scan) ride the host-fed packed block stream — the
+# per-site workload layer on the same substrate. Each config is a
+# chromosome-17-scale synthetic cohort (1KG sample count) reported from the
+# run MANIFEST like every device config; wall-clock includes the analysis's
+# (small, stateless) kernel compiles — there is no PCA-style warmup split
+# because per-block kernels compile once in milliseconds, not tens of
+# seconds.
+
+ANALYSIS_REFERENCES = "17:0:81195210"
+
+ANALYSIS_CONFIGS = {
+    "grm": {
+        "metric": (
+            "GRM/kinship (VanRaden, 2,504 samples, chr17) wall-clock"
+        ),
+    },
+    "ld-prune": {
+        "metric": (
+            "windowed LD r² prune (2,504 samples, chr17, window 256) "
+            "wall-clock"
+        ),
+    },
+    "assoc-scan": {
+        "metric": (
+            "per-site case/control chi-square scan (2,504 samples, chr17) "
+            "wall-clock"
+        ),
+    },
+}
+
+
+def _write_bench_phenotypes(path: str, conf) -> None:
+    """A balanced case/control TSV over the synthetic cohort's real
+    callset names (the assoc verb's strict both-ways coverage check)."""
+    from spark_examples_tpu.pipeline.pca_driver import make_source
+
+    names = [
+        cs["name"]
+        for cs in make_source(conf).search_callsets(conf.variant_set_id)
+    ]
+    with open(path, "w") as f:
+        for i, name in enumerate(names):
+            f.write(f"{name}\t{i % 2}\n")
+
+
+def _run_analysis_config(name: str, device) -> dict:
+    import tempfile
+
+    from spark_examples_tpu.obs.manifest import validate_manifest
+
+    tmpdir = tempfile.mkdtemp(prefix="analyses_bench_")
+    try:
+        manifest_path = os.path.join(tmpdir, "manifest.json")
+        base = [
+            "--num-samples", str(N_SAMPLES),
+            "--references", ANALYSIS_REFERENCES,
+            "--block-size", "4096",
+            "--metrics-json", manifest_path,
+        ]
+        if name == "grm":
+            from spark_examples_tpu.analyses.grm import run_grm_pipeline
+            from spark_examples_tpu.config import GrmConf
+
+            conf = GrmConf.parse(base)
+            start = time.perf_counter()
+            result = run_grm_pipeline(conf)
+            wall = time.perf_counter() - start
+            manifest = result.manifest
+            extra = {"kinship_summary": result.summary}
+        elif name == "ld-prune":
+            from spark_examples_tpu.analyses.ld import run_ld_pipeline
+            from spark_examples_tpu.config import LdConf
+
+            conf = LdConf.parse(
+                base + ["--ld-r2-threshold", "0.2", "--ld-window-sites", "256"]
+            )
+            start = time.perf_counter()
+            result = run_ld_pipeline(conf)
+            wall = time.perf_counter() - start
+            manifest = result.manifest
+            extra = {
+                "sites_kept": result.sites_kept,
+                "kept_fraction": (
+                    round(result.sites_kept / result.sites_tested, 4)
+                    if result.sites_tested
+                    else None
+                ),
+            }
+        else:  # assoc-scan
+            from spark_examples_tpu.analyses.assoc import run_assoc_pipeline
+            from spark_examples_tpu.config import AssocConf
+
+            phenotypes = os.path.join(tmpdir, "phenotypes.tsv")
+            conf = AssocConf.parse(base + ["--phenotypes", phenotypes])
+            _write_bench_phenotypes(phenotypes, conf)
+            start = time.perf_counter()
+            result = run_assoc_pipeline(conf)
+            wall = time.perf_counter() - start
+            manifest = result.manifest
+            extra = {
+                "cases": result.n_cases,
+                "controls": result.n_controls,
+                "top_chi2": result.top[0][0] if result.top else None,
+            }
+        schema_errors = validate_manifest(manifest)
+        assert not schema_errors, schema_errors
+        analysis = manifest["analysis"]
+        sites = int(analysis["sites_tested"])
+        return {
+            "metric": ANALYSIS_CONFIGS[name]["metric"],
+            "value": round(wall, 3),
+            "unit": "s",
+            "vs_baseline": None,
+            "details": {
+                "analysis": analysis,
+                "sites_per_sec": round(sites / wall) if wall > 0 else None,
+                "compile_seconds_excluded": 0.0,
+                **extra,
+                "device": str(device),
+                "baseline": (
+                    "no published reference number for this analysis"
+                ),
+            },
+        }
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 # ---------------------------------------------------------------- ingest bench
 # The file-ingest data plane (chunk-parallel native parse + prefetch +
 # double-buffered device feed) is benchmarked apart from the device configs:
@@ -501,13 +631,14 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--config",
-        choices=sorted(CONFIGS) + ["ingest"],
+        choices=sorted(CONFIGS) + ["ingest"] + sorted(ANALYSIS_CONFIGS),
         default=None,
         help=(
-            "Run ONE benchmark config. Default: run ALL configs and print "
-            "the whole-genome headline with every config's result embedded "
-            "in details.configs — each README number gets a driver-verified "
-            "artifact."
+            "Run ONE benchmark config (PCA device configs, 'ingest', or an "
+            "analyses/ config: grm, ld-prune, assoc-scan). Default: run ALL "
+            "configs and print the whole-genome headline with every "
+            "config's result embedded in details.configs — each README "
+            "number gets a driver-verified artifact."
         ),
     )
     args = parser.parse_args()
@@ -522,11 +653,12 @@ def main() -> None:
 
     if args.config is not None:
         with contextlib.redirect_stdout(sys.stderr):
-            payload = (
-                _run_ingest_config(device)
-                if args.config == "ingest"
-                else _run_config(args.config, device)
-            )
+            if args.config == "ingest":
+                payload = _run_ingest_config(device)
+            elif args.config in ANALYSIS_CONFIGS:
+                payload = _run_analysis_config(args.config, device)
+            else:
+                payload = _run_config(args.config, device)
         print(json.dumps(payload))
         return
 
@@ -587,6 +719,19 @@ def main() -> None:
         "parse_by_workers": ingest["details"]["parse_by_workers"],
         "ingest_compute_overlap": ingest["details"]["ingest_compute_overlap"],
     }
+    # The analyses layer rides along too: one manifest-verified artifact
+    # per population-genetics workload (GRM/LD/assoc on the same substrate).
+    for name in sorted(ANALYSIS_CONFIGS):
+        with contextlib.redirect_stdout(sys.stderr):
+            r = _run_analysis_config(name, device)
+        payload["details"]["configs"][name] = {
+            "metric": r["metric"],
+            "value": r["value"],
+            "unit": r["unit"],
+            "vs_baseline": r["vs_baseline"],
+            "analysis": r["details"]["analysis"],
+            "sites_per_sec": r["details"]["sites_per_sec"],
+        }
     print(json.dumps(payload))
 
 

@@ -38,6 +38,14 @@
 #      oracle (off). Result rows must be byte-identical and the manifests'
 #      gramian_ring_bytes must show the >= 8x packed traffic reduction —
 #      the ring path can never regress silently on a CPU-only runner.
+#   4b. analyses smoke — the population-genetics analyses (analyses/) end
+#      to end on CPU: plan entries accept valid GRM/LD/assoc configs and
+#      exit-2 reject doomed ones; a tiny synthetic GRM run's kinship TSV
+#      byte-compares against the full-matrix NumPy oracle; a 2-contig LD
+#      prune is deterministic across runs and oracle-exact; an assoc scan
+#      with a planted signal (phenotype = one site's carrier vector) ranks
+#      that site top. Every run's manifest validates with the v2-additive
+#      analysis block.
 #   5. serve smoke — the resident daemon (serve/) end to end on CPU: start
 #      `python -m spark_examples_tpu serve` with a synthetic source, assert
 #      a plan-invalid request returns a structured 400 carrying the plan
@@ -232,6 +240,225 @@ else
   echo "sharded-ring smoke failed (rc=$ring_rc):"; tail -20 "$RING_TMP"/*.err
 fi
 rm -rf "$RING_TMP"
+
+echo "== analyses smoke (GRM oracle, LD determinism, assoc signal) =="
+an_rc=0
+AN_TMP=$(mktemp -d)
+an_flags="--num-samples 8 --references 1:0:60000"
+
+# Plan entries: every analysis verb validates device-free, and a doomed
+# configuration is an exit-2 reject (the admission contract of analyses/).
+env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck plan \
+  --analysis grm $an_flags > /dev/null || {
+    echo "analyses smoke: grm plan REJECTED"; an_rc=1; }
+env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck plan \
+  --analysis ld $an_flags > /dev/null || {
+    echo "analyses smoke: ld plan REJECTED"; an_rc=1; }
+env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck plan \
+  --analysis ld $an_flags --ld-r2-threshold 1.5 > /dev/null 2>&1
+if [ "$?" -ne 2 ]; then
+  echo "analyses smoke: bad LD threshold did not exit 2"; an_rc=1
+fi
+
+# 1. GRM: tiny synthetic CLI run; the written kinship TSV must be
+#    BYTE-IDENTICAL to the full-matrix NumPy oracle over the same stream,
+#    and the manifest must validate with the analysis block.
+grm_rc=0
+env JAX_PLATFORMS=cpu SPARK_EXAMPLES_TPU_NO_CACHE=1 \
+  python -m spark_examples_tpu grm $an_flags \
+    --grm-out "$AN_TMP/kin.tsv" --metrics-json "$AN_TMP/grm.json" \
+    > "$AN_TMP/grm.out" 2> "$AN_TMP/grm.err" || grm_rc=$?
+if [ "$grm_rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python - "$AN_TMP" $an_flags <<'PYEOF' || grm_rc=$?
+import sys
+import numpy as np
+from spark_examples_tpu.analyses.grm import format_grm_rows, grm_reference
+from spark_examples_tpu.config import GrmConf
+from spark_examples_tpu.obs.manifest import read_manifest, validate_manifest
+from spark_examples_tpu.pipeline.pca_driver import make_source
+
+tmp, flags = sys.argv[1], sys.argv[2:]
+conf = GrmConf.parse(flags)
+src = make_source(conf)
+names = [cs["name"] for cs in src.search_callsets(conf.variant_set_id)]
+rows = [
+    block["has_variation"]
+    for contig in conf.get_contigs(src, conf.variant_set_id)
+    for block in src.genotype_blocks(
+        conf.variant_set_id[0], contig, block_size=conf.block_size,
+        min_allele_frequency=conf.min_allele_frequency)
+]
+oracle = grm_reference(np.concatenate(rows), len(names))
+expected = ["\t".join(["name", *names])] + [
+    "\t".join(str(field) for field in row)
+    for row in format_grm_rows(names, oracle)
+]
+actual = open(f"{tmp}/kin.tsv").read().splitlines()
+if actual != expected:
+    print("GRM kinship TSV differs from the NumPy oracle")
+    sys.exit(1)
+doc = read_manifest(f"{tmp}/grm.json")
+errors = validate_manifest(doc)
+if errors:
+    print("GRM manifest INVALID:\n  " + "\n  ".join(errors)); sys.exit(1)
+analysis = doc["analysis"]
+if analysis["kind"] != "grm" or analysis["sites_tested"] != len(
+        np.concatenate(rows)):
+    print(f"GRM manifest analysis block wrong: {analysis}"); sys.exit(1)
+print(f"GRM smoke OK: {analysis['sites_tested']} sites, kinship "
+      "byte-identical to the NumPy oracle, manifest valid")
+PYEOF
+else
+  echo "GRM smoke run failed (rc=$grm_rc):"; tail -10 "$AN_TMP/grm.err"
+fi
+[ "$grm_rc" -eq 0 ] || an_rc=1
+
+# 2. LD prune on a 2-contig synthetic, twice: the kept-site mask must be
+#    deterministic (byte-identical across runs) and match the windowed
+#    NumPy oracle. Runs on its own step rc: a failure upstream must not
+#    skip this coverage or masquerade as an LD failure.
+ld_rc=0
+ld_flags="--num-samples 8 --references 1:0:40000,2:0:40000 \
+  --ld-r2-threshold 0.2 --ld-window-sites 64"
+for run in a b; do
+  env JAX_PLATFORMS=cpu SPARK_EXAMPLES_TPU_NO_CACHE=1 \
+    python -m spark_examples_tpu ld-prune $ld_flags \
+      --ld-out "$AN_TMP/kept-$run.tsv" --metrics-json "$AN_TMP/ld-$run.json" \
+      > /dev/null 2> "$AN_TMP/ld-$run.err" || ld_rc=$?
+done
+if [ "$ld_rc" -ne 0 ]; then
+  echo "LD smoke run failed:"; tail -10 "$AN_TMP"/ld-*.err
+elif ! cmp -s "$AN_TMP/kept-a.tsv" "$AN_TMP/kept-b.tsv"; then
+  echo "LD kept-site mask is NOT deterministic across identical runs"
+  ld_rc=1
+else
+  env JAX_PLATFORMS=cpu python - "$AN_TMP" $ld_flags <<'PYEOF' || ld_rc=$?
+import sys
+import numpy as np
+from spark_examples_tpu.analyses.ld import ld_prune_reference
+from spark_examples_tpu.config import LdConf
+from spark_examples_tpu.obs.manifest import read_manifest, validate_manifest
+from spark_examples_tpu.pipeline.pca_driver import make_source
+
+tmp, flags = sys.argv[1], sys.argv[2:]
+conf = LdConf.parse(flags)
+src = make_source(conf)
+expected = ["contig\tpos\tkept"]
+kept_total = tested_total = 0
+for contig in conf.get_contigs(src, conf.variant_set_id):
+    rows = [
+        (block["positions"], block["has_variation"])
+        for block in src.genotype_blocks(
+            conf.variant_set_id[0], contig, block_size=conf.block_size,
+            min_allele_frequency=conf.min_allele_frequency)
+    ]
+    positions = np.concatenate([p for p, _ in rows])
+    hv = np.concatenate([h for _, h in rows])
+    W = conf.ld_window_sites
+    windows = [
+        (positions[i:i + W], hv[i:i + W])
+        for i in range(0, len(positions), W)
+    ]
+    for pos, kept in ld_prune_reference(
+            windows, conf.num_samples, conf.ld_r2_threshold):
+        expected.append(f"{contig.reference_name}\t{pos}\t{int(kept)}")
+        kept_total += int(kept)
+        tested_total += 1
+actual = open(f"{tmp}/kept-a.tsv").read().splitlines()
+if actual != expected:
+    print("LD kept mask differs from the windowed NumPy oracle")
+    sys.exit(1)
+doc = read_manifest(f"{tmp}/ld-a.json")
+errors = validate_manifest(doc)
+if errors:
+    print("LD manifest INVALID:\n  " + "\n  ".join(errors)); sys.exit(1)
+analysis = doc["analysis"]
+if analysis != {"kind": "ld", "sites_kept": kept_total,
+                "sites_tested": tested_total}:
+    print(f"LD manifest analysis block wrong: {analysis} vs "
+          f"kept={kept_total} tested={tested_total}")
+    sys.exit(1)
+print(f"LD smoke OK: deterministic kept mask ({kept_total}/{tested_total} "
+      "sites), oracle-exact, manifest valid")
+PYEOF
+fi
+[ "$ld_rc" -eq 0 ] || an_rc=1
+
+# 3. Association scan with a PLANTED signal: phenotypes are the carrier
+#    vector of one polymorphic site, so that site's chi-square is the
+#    theoretical maximum (n) and must rank top. Own step rc, like LD.
+assoc_rc=0
+env JAX_PLATFORMS=cpu python - "$AN_TMP" $an_flags <<'PYEOF' > /dev/null || assoc_rc=$?
+import sys
+import numpy as np
+from spark_examples_tpu.config import AssocConf
+from spark_examples_tpu.pipeline.pca_driver import make_source
+
+tmp, flags = sys.argv[1], sys.argv[2:]
+conf = AssocConf.parse(flags + ["--phenotypes", "unused"])
+src = make_source(conf)
+names = [cs["name"] for cs in src.search_callsets(conf.variant_set_id)]
+for contig in conf.get_contigs(src, conf.variant_set_id):
+    for block in src.genotype_blocks(
+            conf.variant_set_id[0], contig, block_size=conf.block_size,
+            min_allele_frequency=conf.min_allele_frequency):
+        carriers = block["has_variation"].sum(axis=1)
+        target = np.nonzero(
+            (carriers >= 2) & (carriers <= len(names) - 2))[0]
+        if len(target):
+            i = int(target[0])
+            with open(f"{tmp}/pheno.tsv", "w") as f:
+                for name, status in zip(names, block["has_variation"][i]):
+                    f.write(f"{name}\t{int(status)}\n")
+            with open(f"{tmp}/signal.txt", "w") as f:
+                f.write(
+                    f"{contig.reference_name}\t{int(block['positions'][i])}"
+                )
+            sys.exit(0)
+print("no polymorphic site found for the planted signal")
+sys.exit(1)
+PYEOF
+if [ "$assoc_rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu SPARK_EXAMPLES_TPU_NO_CACHE=1 \
+    python -m spark_examples_tpu assoc-scan $an_flags \
+      --phenotypes "$AN_TMP/pheno.tsv" --assoc-out "$AN_TMP/scan.tsv" \
+      --metrics-json "$AN_TMP/assoc.json" \
+      > "$AN_TMP/assoc.out" 2> "$AN_TMP/assoc.err" || assoc_rc=$?
+fi
+if [ "$assoc_rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python - "$AN_TMP" <<'PYEOF' || assoc_rc=$?
+import sys
+from spark_examples_tpu.obs.manifest import read_manifest, validate_manifest
+
+tmp = sys.argv[1]
+signal_contig, signal_pos = open(f"{tmp}/signal.txt").read().split()
+best = None
+with open(f"{tmp}/scan.tsv") as f:
+    next(f)  # header
+    for line in f:
+        contig, pos, a, t, chi2 = line.rstrip("\n").split("\t")
+        if best is None or float(chi2) > best[2]:
+            best = (contig, pos, float(chi2))
+if best is None or best[0] != signal_contig or best[1] != signal_pos:
+    print(f"planted signal {signal_contig}:{signal_pos} NOT top-ranked "
+          f"(top was {best})")
+    sys.exit(1)
+doc = read_manifest(f"{tmp}/assoc.json")
+errors = validate_manifest(doc)
+if errors:
+    print("assoc manifest INVALID:\n  " + "\n  ".join(errors)); sys.exit(1)
+if doc["analysis"]["kind"] != "assoc" or \
+        doc["analysis"]["sites_tested"] <= 0:
+    print(f"assoc manifest analysis block wrong: {doc['analysis']}")
+    sys.exit(1)
+print(f"assoc smoke OK: planted signal {signal_contig}:{signal_pos} "
+      f"top-ranked (chi2 {best[2]:g}), manifest valid")
+PYEOF
+else
+  echo "assoc smoke failed:"; tail -10 "$AN_TMP/assoc.err" 2>/dev/null
+fi
+[ "$assoc_rc" -eq 0 ] || an_rc=1
+rm -rf "$AN_TMP"
 
 echo "== serve smoke (resident daemon: admit, reject, warm cache, drain) =="
 serve_rc=0
@@ -448,6 +675,7 @@ if [ "$rg_rc" -ne 0 ]; then exit "$rg_rc"; fi
 if [ "$hm_rc" -ne 0 ]; then exit "$hm_rc"; fi
 if [ "$obs_rc" -ne 0 ]; then exit "$obs_rc"; fi
 if [ "$ring_rc" -ne 0 ]; then exit "$ring_rc"; fi
+if [ "$an_rc" -ne 0 ]; then exit "$an_rc"; fi
 if [ "$serve_rc" -ne 0 ]; then exit "$serve_rc"; fi
 if [ "$faults_rc" -ne 0 ]; then exit "$faults_rc"; fi
 exit "$san_rc"

@@ -1,0 +1,312 @@
+"""Per-site case/control association scan (L5): allelic 2×2 chi-square.
+
+Phenotypes arrive as a two-column TSV (callset name, status 0/1); per
+streamed site the device counts carriers among cases ``a`` and carriers
+total ``t`` (``ops/ld.py:build_case_counts`` — one matvec per block,
+riding the same dispatch loop as every other analysis), and the host
+closes the 2×2 table in exact integers:
+
+    a = case carriers        b = n_cases − a
+    c = control carriers = t − a
+    d = n_controls − c
+
+    χ² = n · (a·d − b·c)² / (n_cases · n_controls · t · (n − t))
+
+The cross-product difference is computed in int64 (|a·d − b·c| ≤ n²/4,
+exact through the declared 25K-sample geometry) and squared in float64 —
+so the statistic is the exact float64 of the integer counts, and the
+NumPy oracle (:func:`chi2_from_counts` over :func:`case_counts_reference`)
+matches it to ZERO tolerance (the documented tolerance: float64-exact,
+not approximate). Sites with ``t == n`` (every sample a carrier: zero
+genotype variance) get χ² = 0 via the shared zero-variance convention;
+``t == 0`` rows never arrive (the sources drop all-zero rows).
+
+Per-site statistics spill incrementally through the windowed writer
+(``pipeline/sitewriter.py``); the printed ranking rides a bounded
+``--assoc-top`` heap — nothing O(M) ever lives on host.
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_examples_tpu.analyses.base import (
+    AnalysisContext,
+    finish_analysis_run,
+)
+from spark_examples_tpu.config import AssocConf
+from spark_examples_tpu.ops.ld import build_case_counts
+
+
+def load_phenotypes(path: str) -> Dict[str, int]:
+    """Parse the ``--phenotypes`` TSV: ``name<TAB>status`` per line, '#'
+    comments and blank lines skipped, status strictly 0 or 1. Duplicate
+    names and malformed lines fail loudly — a silently-dropped sample
+    would bias every statistic. Device-free; the plan validator calls
+    this too, so a bad file is an exit-2 reject before any ingest."""
+    statuses: Dict[str, int] = {}
+    with open(path, "r", encoding="utf-8") as f:
+        for lineno, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split("\t")
+            if len(parts) != 2:
+                raise ValueError(
+                    f"{path}:{lineno}: expected 'name<TAB>status', got "
+                    f"{line!r}"
+                )
+            name, status = parts[0].strip(), parts[1].strip()
+            if status not in ("0", "1"):
+                raise ValueError(
+                    f"{path}:{lineno}: status must be 0 (control) or 1 "
+                    f"(case), got {status!r}"
+                )
+            if name in statuses:
+                raise ValueError(
+                    f"{path}:{lineno}: duplicate sample {name!r}"
+                )
+            statuses[name] = int(status)
+    if not statuses:
+        raise ValueError(f"{path}: no phenotype rows")
+    values = set(statuses.values())
+    if values != {0, 1}:
+        missing = "case (1)" if 1 not in values else "control (0)"
+        raise ValueError(
+            f"{path}: needs at least one case AND one control; no "
+            f"{missing} rows present"
+        )
+    return statuses
+
+
+def case_vector(
+    statuses: Dict[str, int], sample_names: Sequence[str]
+) -> np.ndarray:
+    """The cohort-ordered {0,1} case mask. Coverage is strict both ways:
+    every cohort sample must carry a status, and every status row must
+    name a cohort sample — anything else is a silent cohort mismatch."""
+    missing = [n for n in sample_names if n not in statuses]
+    if missing:
+        raise ValueError(
+            f"--phenotypes covers {len(statuses)} samples but the cohort "
+            f"has {len(sample_names)}; missing e.g. {missing[:5]}"
+        )
+    extra = set(statuses) - set(sample_names)
+    if extra:
+        raise ValueError(
+            f"--phenotypes names {len(extra)} sample(s) not in the "
+            f"cohort, e.g. {sorted(extra)[:5]}"
+        )
+    return np.array(
+        [statuses[n] for n in sample_names], dtype=np.uint8
+    )
+
+
+def chi2_from_counts(
+    a: np.ndarray,
+    t: np.ndarray,
+    n_cases: int,
+    n_controls: int,
+) -> np.ndarray:
+    """Vectorized allelic chi-square from integer per-site counts (module
+    docstring formula), float64, with the zero-variance guard (``t == 0``
+    or ``t == n`` → 0). Shared verbatim by the streamed run and the
+    NumPy oracle — parity is exact equality."""
+    n = int(n_cases) + int(n_controls)
+    a = np.asarray(a, dtype=np.int64)
+    t = np.asarray(t, dtype=np.int64)
+    c = t - a
+    b = n_cases - a
+    d = n_controls - c
+    diff = a * d - b * c  # |diff| <= n_cases*n_controls <= n²/4: exact int64
+    denom = (
+        float(n_cases)
+        * float(n_controls)
+        * t.astype(np.float64)
+        * (n - t).astype(np.float64)
+    )
+    num = float(n) * diff.astype(np.float64) ** 2
+    out = np.zeros_like(num)
+    np.divide(num, denom, out=out, where=denom > 0)
+    return out
+
+
+@dataclass
+class AssocResult:
+    """One completed scan: tested-site count, the bounded top ranking
+    (``(chi2, contig, pos, case_carriers, total_carriers)`` descending),
+    the output path (when written), and the manifest bookkeeping."""
+
+    sites_tested: int
+    top: List[Tuple[float, str, int, int, int]]
+    n_cases: int
+    n_controls: int
+    out_path: Optional[str] = None
+    manifest: Optional[Dict] = None
+    manifest_path: Optional[str] = None
+
+
+def run_assoc_pipeline(conf: AssocConf) -> AssocResult:
+    """The association-scan core, CLI-free: conf in, per-site statistics
+    out (spilled), bounded top ranking returned."""
+    import jax
+
+    from spark_examples_tpu.utils.tracing import StageTimes
+
+    if not getattr(conf, "phenotypes", None):
+        raise ValueError("the assoc analysis requires --phenotypes TSV")
+    ctx = AnalysisContext(conf, "assoc")
+    statuses = load_phenotypes(conf.phenotypes)
+    case = case_vector(statuses, ctx.sample_names())
+    n_cases = int(case.sum())
+    n_controls = ctx.num_samples - n_cases
+    print(f"Phenotypes: {n_cases} cases / {n_controls} controls.")
+    times = StageTimes(recorder=ctx.spans)
+    host_oracle = conf.pca_backend == "host"
+    counts_fn = None if host_oracle else build_case_counts()
+    writer = None
+    if conf.assoc_out:
+        from spark_examples_tpu.pipeline.sitewriter import SiteOutputWriter
+
+        writer = SiteOutputWriter(
+            conf.assoc_out,
+            header=("contig", "pos", "case_carriers", "carriers", "chi2"),
+        )
+    heartbeat = None
+    if getattr(conf, "heartbeat_seconds", 0) and conf.heartbeat_seconds > 0:
+        from spark_examples_tpu.obs.heartbeat import Heartbeat
+
+        heartbeat = Heartbeat(conf.heartbeat_seconds, ctx.registry).start()
+    sites_tested = 0
+    # Bounded ranking: a size-K min-heap of (chi2, tie-break) — the O(M)
+    # stream never accumulates, only the K best survive on host.
+    top_heap: List[Tuple[float, int, str, int, int, int]] = []
+    seq = 0
+    try:
+        with times.stage("ingest+assoc-scan"):
+            for contig, block in ctx.blocks():
+                hv = np.asarray(block["has_variation"], dtype=np.uint8)
+                positions = np.asarray(block["positions"], dtype=np.int64)
+                if host_oracle:
+                    from spark_examples_tpu.ops.ld import (
+                        case_counts_reference,
+                    )
+
+                    a, t = case_counts_reference(hv, case)
+                else:
+                    # Static-shape the dispatch: ragged blocks (the
+                    # nonzero/AF drops) pad to --block-size with zero
+                    # rows so ONE compiled program serves every block —
+                    # padding rows are trimmed right back off.
+                    b = hv.shape[0]
+                    if b < conf.block_size:
+                        padded = np.zeros(
+                            (conf.block_size, hv.shape[1]), dtype=np.uint8
+                        )
+                        padded[:b] = hv
+                        hv_dev = padded
+                    else:
+                        hv_dev = hv
+                    a_dev, t_dev = counts_fn(hv_dev, case)
+                    a = np.asarray(jax.device_get(a_dev))[:b]  # graftcheck: disable=GC001 -- deliberate per-block fetch: the chi-square close-out and the bounded ranking are host-side scalar work on two B-length vectors
+                    t = np.asarray(jax.device_get(t_dev))[:b]  # graftcheck: disable=GC001 -- same per-block fetch as `a` above
+                chi2 = chi2_from_counts(a, t, n_cases, n_controls)
+                if writer is not None:
+                    writer.write_rows(
+                        (
+                            contig,
+                            int(positions[i]),
+                            int(a[i]),
+                            int(t[i]),
+                            repr(float(chi2[i])),
+                        )
+                        for i in range(len(positions))
+                    )
+                # Vectorized candidate pre-filter: once the heap is full,
+                # a streamed site can only displace the minimum with a
+                # STRICTLY greater chi2 (every heap entry has an earlier
+                # seq, so equal statistics always lose the -seq
+                # tie-break) — the Python-level heap loop runs over the
+                # handful of block rows above the floor, not all M sites.
+                if len(top_heap) < conf.assoc_top:
+                    candidates = range(len(positions))
+                else:
+                    candidates = np.nonzero(chi2 > top_heap[0][0])[0]
+                for i in candidates:
+                    # seq is a deterministic tie-break (stream order) so
+                    # equal statistics rank stably across runs.
+                    entry = (
+                        float(chi2[i]),
+                        -(seq + int(i)),
+                        contig,
+                        int(positions[i]),
+                        int(a[i]),
+                        int(t[i]),
+                    )
+                    if len(top_heap) < conf.assoc_top:
+                        heapq.heappush(top_heap, entry)
+                    elif entry > top_heap[0]:
+                        heapq.heapreplace(top_heap, entry)
+                seq += len(positions)
+                sites_tested += len(positions)
+    except BaseException:
+        if writer is not None:
+            writer.abort()
+        raise
+    finally:
+        if heartbeat is not None:
+            heartbeat.stop()
+    if writer is not None:
+        writer.close()
+        print(f"Per-site scan written to {conf.assoc_out}.")
+    top = [
+        (chi2, contig, pos, a_i, t_i)
+        for chi2, _seq, contig, pos, a_i, t_i in sorted(
+            top_heap, reverse=True
+        )
+    ]
+    print(f"Association scan: {sites_tested} sites tested.")
+    for chi2, contig, pos, a_i, t_i in top:
+        print(f"{contig}\t{pos}\t{a_i}\t{t_i}\t{chi2:.6g}")
+    print(str(ctx.io_stats))
+    if conf.profile_dir:
+        print(str(times))
+    manifest, manifest_path, _ = finish_analysis_run(
+        conf,
+        "assoc",
+        ctx.spans,
+        ctx.registry,
+        ctx.io_stats,
+        sites_tested=sites_tested,
+        sites_kept=None,
+    )
+    return AssocResult(
+        sites_tested=sites_tested,
+        top=top,
+        n_cases=n_cases,
+        n_controls=n_controls,
+        out_path=conf.assoc_out,
+        manifest=manifest,
+        manifest_path=manifest_path,
+    )
+
+
+def run(argv: Sequence[str]) -> AssocResult:
+    """The ``assoc-scan`` CLI verb."""
+    conf = AssocConf.parse(argv)
+    conf.init_distributed()
+    return run_assoc_pipeline(conf)
+
+
+__all__ = [
+    "AssocResult",
+    "case_vector",
+    "chi2_from_counts",
+    "load_phenotypes",
+    "run",
+    "run_assoc_pipeline",
+]

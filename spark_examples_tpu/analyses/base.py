@@ -1,0 +1,331 @@
+"""Shared plumbing of the population-genetics analyses (``analyses/``).
+
+The three analyses (GRM/kinship, windowed LD pruning, association scan)
+are new L5 applications on the proven substrate: they stream the SAME
+contig-ordered has-variation blocks the PCA Gramian accumulates (one
+``genotype_blocks`` contract across the synthetic and file sources), under
+the same partitioner, the same telemetry registry/span/heartbeat stack,
+and the same manifest epilogue. This module is the one home of that
+shared plumbing, so each analysis file holds only its own math:
+
+- :func:`check_analysis_conf` — the runtime half of the admission
+  contract (``check/plan.py`` repeats it device-free): analyses are
+  single-variant-set, synthetic/file-source runs; the PCA-only flags
+  (checkpoint/resume, ``--save-variants``, ``--input-path`` resume,
+  explicit streaming) are rejected loudly instead of half-working;
+- :func:`iter_site_blocks` — the contig-ordered block stream with the
+  standard ingest accounting (partition/request/variant stats, the
+  planned/done/sites gauges the heartbeat reads);
+- :class:`AnalysisContext` — source + callsets + registry/spans/stats +
+  mesh resolution for the analyses that do not embed a full
+  ``VariantsPcaDriver`` (LD, assoc; GRM reuses the driver so the Gramian
+  strategy/dtype-ladder/ring machinery stays single-sourced);
+- :func:`finish_analysis_run` — the manifest epilogue: the schema-v2 run
+  manifest with the v2-additive ``analysis`` block
+  (``{kind, sites_kept, sites_tested}``), warm-geometry ledger recording,
+  and the same atomic ``--metrics-json`` write contract as the PCA
+  pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from spark_examples_tpu.pipeline.stats import VariantsDatasetStats
+from spark_examples_tpu.sharding.partitioners import VariantsPartitioner
+from spark_examples_tpu.utils import faults
+
+#: The analysis kinds this subsystem ships; ``serve/protocol.py`` keys its
+#: job-kind table off the same spellings (``grm`` served, ``ld``/``assoc``
+#: reserved batch-only for now).
+ANALYSIS_KINDS = ("grm", "ld", "assoc")
+
+
+def analysis_conf_violations(conf, kind: str) -> List[Tuple[str, str]]:
+    """Every shared-precondition violation of ``conf`` for analysis
+    ``kind``, as ``(code, message)`` pairs — the ONE catalogue behind both
+    the runtime gate (:func:`check_analysis_conf`, first violation raises)
+    and the device-free plan validator
+    (``check/plan.py:validate_plan(analysis=...)``, every violation an
+    exit-2 plan error), so the two can never drift."""
+    if kind not in ANALYSIS_KINDS:
+        raise ValueError(f"unknown analysis kind {kind!r}")
+    violations: List[Tuple[str, str]] = []
+    if len(conf.variant_set_id) != 1:
+        violations.append((
+            "analysis-variant-sets",
+            f"the {kind} analysis takes exactly one variant set "
+            f"(got {len(conf.variant_set_id)}); joins/merges are a PCA "
+            "pipeline capability",
+        ))
+    if getattr(conf, "source", "synthetic") == "rest":
+        violations.append((
+            "analysis-source",
+            f"the {kind} analysis streams packed genotype blocks; the "
+            "paginated REST source has no packed path (--source synthetic "
+            "or file)",
+        ))
+    if getattr(conf, "input_path", None):
+        violations.append((
+            "analysis-input-path",
+            "--input-path checkpoint resume loads wire records; the "
+            f"{kind} analysis streams packed blocks (run from the "
+            "original source)",
+        ))
+    if getattr(conf, "save_variants", None):
+        violations.append((
+            "analysis-save-variants",
+            "--save-variants materializes wire records; the packed "
+            f"{kind} analysis never builds them",
+        ))
+    if getattr(conf, "gramian_checkpoint_dir", None) or getattr(
+        conf, "resume_from", None
+    ):
+        violations.append((
+            "analysis-checkpoint",
+            "--gramian-checkpoint-dir/--resume-from checkpoint the PCA "
+            f"similarity accumulator; the {kind} analysis is not "
+            "checkpointable yet",
+        ))
+    if getattr(conf, "ingest", "auto") not in ("auto", "packed"):
+        violations.append((
+            "analysis-ingest",
+            f"the {kind} analysis has one ingest path (packed blocks); "
+            f"--ingest {conf.ingest} does not apply",
+        ))
+    stream = getattr(conf, "stream_chunk_bytes", None)
+    if stream is not None and stream > 0:
+        violations.append((
+            "analysis-streaming",
+            f"explicit --stream-chunk-bytes streaming is not wired into "
+            f"the {kind} analysis yet; it uses the windowed packed parse "
+            "(drop the flag, or 0 to silence the auto decision)",
+        ))
+    return violations
+
+
+def check_analysis_conf(conf, kind: str) -> None:
+    """Runtime preconditions every analysis shares — mirrored device-free
+    by ``check/plan.py:validate_plan(analysis=...)`` so a doomed
+    configuration is rejected at admission, not after ingest."""
+    violations = analysis_conf_violations(conf, kind)
+    if violations:
+        raise ValueError(violations[0][1])
+
+
+def analysis_partitions(conf, source):
+    """The run's shard windows: the SAME contig resolution and partitioner
+    the PCA driver builds (one ``VariantsPartitioner`` over the flattened
+    contig list), for the analyses' single variant set."""
+    contigs = conf.get_contigs(source, conf.variant_set_id)
+    partitioner = VariantsPartitioner(contigs, conf.bases_per_partition)
+    return partitioner.get_partitions(conf.variant_set_id[0])
+
+
+def iter_site_blocks(
+    conf, source, partitions, io_stats, registry
+) -> Iterator[Tuple[str, Dict[str, np.ndarray]]]:
+    """Contig-ordered block stream for one variant set with the standard
+    ingest accounting: yields ``(contig_name, block)`` where ``block`` is
+    the sources' ``genotype_blocks`` dict (``positions``,
+    ``has_variation``, ``af``) — blocks flow one at a time (peak host
+    memory O(block), the bounded-iteration idiom of the PCA packed path).
+
+    Deliberately parallel to ``pipeline/pca_driver.py``'s ``block_stream``
+    (same partition/page/variant accounting around the same
+    ``genotype_blocks`` call; the page-request branch is shared via
+    ``sources.partition_page_requests``). The loops stay separate because
+    the PCA path must NOT set the sites-scanned gauge here (file sources
+    already advance it during parse, and the PCA device-gen path owns its
+    own count) — keep accounting changes mirrored in both."""
+    from spark_examples_tpu.obs.metrics import (
+        INGEST_PARTITIONS_DONE,
+        INGEST_PARTITIONS_PLANNED,
+        INGEST_SITES_SCANNED,
+        well_known_gauge,
+    )
+    from spark_examples_tpu.sources import partition_page_requests
+
+    well_known_gauge(registry, INGEST_PARTITIONS_PLANNED).set(len(partitions))
+    done_gauge = well_known_gauge(registry, INGEST_PARTITIONS_DONE)
+    sites_gauge = well_known_gauge(registry, INGEST_SITES_SCANNED)
+    sites_scanned = 0
+    for index, part in enumerate(partitions):
+        if io_stats is not None:
+            io_stats.add_partition(part.range)
+            io_stats.add_requests(
+                partition_page_requests(
+                    source,
+                    part.variant_set_id,
+                    part.contig,
+                    conf.bases_per_partition,
+                )
+            )
+        window_variants = 0
+        for block in source.genotype_blocks(
+            part.variant_set_id,
+            part.contig,
+            block_size=conf.block_size,
+            min_allele_frequency=conf.min_allele_frequency,
+        ):
+            window_variants += len(block["positions"])
+            sites_scanned += len(block["positions"])
+            sites_gauge.set(sites_scanned)
+            yield part.contig.reference_name, block
+        if io_stats is not None:
+            io_stats.add_variants(window_variants)
+        done_gauge.set(index + 1)
+
+
+def cohort_sample_names(
+    indexes: Dict[str, int], names: Dict[str, str]
+) -> List[str]:
+    """Callset names in cohort column order, from the ``{id: index}`` /
+    ``{id: name}`` pair every driver carries — ONE ordering rule, so GRM
+    row labels can never disagree with LD/assoc labels."""
+    reverse = {i: cs_id for cs_id, i in indexes.items()}
+    return [names[reverse[i]] for i in range(len(indexes))]
+
+
+class AnalysisContext:
+    """Source + callsets + telemetry + mesh for the per-site analyses.
+
+    Deliberately a subset of ``VariantsPcaDriver``: LD and assoc have no
+    N×N accumulator, so they need the shared *plumbing* (cohort
+    discovery, partitioning, registry/spans/stats, mesh resolution) but
+    none of the similarity machinery. GRM, which DOES accumulate an N×N
+    Gramian, embeds the real driver instead — the strategy/dtype-ladder
+    logic stays single-sourced there.
+    """
+
+    def __init__(self, conf, kind: str):
+        check_analysis_conf(conf, kind)
+        from spark_examples_tpu.obs import MetricsRegistry, SpanRecorder
+        from spark_examples_tpu.pipeline.pca_driver import make_source
+
+        self.conf = conf
+        self.kind = kind
+        self.source = make_source(conf)
+        self.registry = MetricsRegistry()
+        self.spans = SpanRecorder()
+        self.io_stats = VariantsDatasetStats(self.registry)
+        callsets = self.source.search_callsets(conf.variant_set_id)
+        self.indexes: Dict[str, int] = {
+            cs["id"]: i for i, cs in enumerate(callsets)
+        }
+        self.names: Dict[str, str] = {cs["id"]: cs["name"] for cs in callsets}
+        self.num_samples = len(self.indexes)
+        if self.num_samples < 1:
+            raise ValueError(
+                f"the {kind} analysis found an empty cohort for variant "
+                f"set {conf.variant_set_id[0]!r}"
+            )
+        print(f"Cohort size: {self.num_samples}.")
+
+    def sample_names(self) -> List[str]:
+        """Callset names in column order (the analyses' row/column label
+        order — cohort order, not the PCA emit's name-sorted order)."""
+        return cohort_sample_names(self.indexes, self.names)
+
+    def partitions(self):
+        return analysis_partitions(self.conf, self.source)
+
+    def blocks(self) -> Iterator[Tuple[str, Dict[str, np.ndarray]]]:
+        return iter_site_blocks(
+            self.conf,
+            self.source,
+            self.partitions(),
+            self.io_stats,
+            self.registry,
+        )
+
+    def make_mesh(self):
+        """The run's mesh, resolved by the same rule as the PCA driver
+        (``parallel/mesh.py:resolve_run_mesh``)."""
+        from spark_examples_tpu.parallel.mesh import resolve_run_mesh
+
+        return resolve_run_mesh(
+            self.conf.mesh_shape, self.conf.num_reduce_partitions
+        )
+
+
+def finish_analysis_run(
+    conf,
+    kind: str,
+    spans,
+    registry,
+    io_stats,
+    sites_tested: int,
+    sites_kept: Optional[int],
+) -> Tuple[Optional[Dict], Optional[str], Dict]:
+    """The analyses' run epilogue, mirroring ``run_pipeline``'s: record
+    the geometry in the warm ledger (kind-keyed — a GRM run never
+    pre-warms the PCA fingerprint), build the schema-v2 manifest with the
+    v2-additive ``analysis`` block, and write it atomically when
+    ``--metrics-json`` asked. Returns ``(manifest_doc, manifest_path,
+    analysis_block)``."""
+    from spark_examples_tpu.obs.metrics import (
+        ANALYSIS_SITES_KEPT,
+        ANALYSIS_SITES_TESTED,
+        well_known_gauge,
+    )
+    from spark_examples_tpu.utils.cache import (
+        compile_fingerprint,
+        record_geometry,
+    )
+
+    faults.kill_point("analysis.pre-manifest")
+    record_geometry(compile_fingerprint(conf, kind=kind))
+    well_known_gauge(registry, ANALYSIS_SITES_TESTED).set(int(sites_tested))
+    well_known_gauge(registry, ANALYSIS_SITES_KEPT).set(
+        int(sites_kept if sites_kept is not None else sites_tested)
+    )
+    analysis_block = {
+        "kind": kind,
+        "sites_kept": int(sites_kept) if sites_kept is not None else None,
+        "sites_tested": int(sites_tested),
+    }
+    manifest_doc: Optional[Dict] = None
+    manifest_path: Optional[str] = None
+    if getattr(conf, "metrics_json", None):
+        from spark_examples_tpu.obs.manifest import (
+            build_run_manifest,
+            write_manifest,
+        )
+
+        manifest_doc = build_run_manifest(
+            conf=conf,
+            spans=spans,
+            registry=registry,
+            io_stats=io_stats,
+            analysis=analysis_block,
+        )
+        try:
+            write_manifest(conf.metrics_json, manifest_doc)
+        except OSError as e:
+            # Same contract as run_pipeline: a bad telemetry path must not
+            # destroy completed compute — report loudly, keep the results.
+            import sys
+
+            print(
+                f"Run manifest NOT written to {conf.metrics_json}: {e}",
+                file=sys.stderr,
+            )
+        else:
+            manifest_path = conf.metrics_json
+            print(f"Run manifest written to {conf.metrics_json}.")
+    return manifest_doc, manifest_path, analysis_block
+
+
+__all__ = [
+    "ANALYSIS_KINDS",
+    "AnalysisContext",
+    "analysis_conf_violations",
+    "analysis_partitions",
+    "check_analysis_conf",
+    "cohort_sample_names",
+    "finish_analysis_run",
+    "iter_site_blocks",
+]

@@ -1,0 +1,249 @@
+"""GRM/kinship: allele-frequency-standardized genetic relatedness (L5).
+
+The VanRaden genetic relatedness matrix over has-variation genotypes
+``X ∈ {0,1}^(M×N)`` with per-site observed frequencies ``p_v = k_v / n``:
+
+    GRM = (X − P)ᵀ (X − P) / Σ_v p_v·q_v,       P[v, s] = p_v
+
+This is a TWO-PASS REWEIGHTING of the existing Gramian, not a new
+reduction: expanding the centering,
+
+    (X − P)ᵀ(X − P) = XᵀX − (U·1ᵀ + 1·Uᵀ)/n + (Σ_v k_v²)/n² · J
+
+where ``U = Σ_v k_v·x_v`` (an N-vector) — so the O(M·N²) device work is
+EXACTLY the PCA similarity accumulation (``ops/gramian.py``: same dtype
+ladder, same packed ring, same exactness contracts ``check/ranges.py``
+proves), and the AF pass is O(M·N) integer moments computed on host from
+the same streamed blocks (``utils/af.py``: carrier counts, the integer
+variance numerator ``k·(n−k)`` with its monomorphic zero-variance guard).
+The finalize is one float64 formula over EXACT int64 numerators:
+
+    GRM = (n²·G − n·(U·1ᵀ + 1·Uᵀ) + S2·J) / C,
+    S2 = Σ k_v²,   C = Σ k_v·(n − k_v) = n²·Σ p·q
+
+— every term an exact integer (int64 headroom: ``n²·G ≤ n²·M < 2^63``
+through the declared 40M-site, 25K-sample geometry), so the NumPy oracle
+computes the IDENTICAL float64 matrix and CI's byte compare is exact,
+not approximate. ``C == 0`` (every site monomorphic) is an error, not a
+NaN matrix.
+
+The device accumulation rides a full ``VariantsPcaDriver`` — strategy
+resolution (dense vs packed-ring sharded), the f32→int32 dtype ladder,
+``--ring-pack-bits``, flush telemetry — so the GRM inherits every Gramian
+hardening without duplicating a line of it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_examples_tpu.analyses.base import (
+    analysis_partitions,
+    check_analysis_conf,
+    cohort_sample_names,
+    finish_analysis_run,
+    iter_site_blocks,
+)
+from spark_examples_tpu.config import GrmConf
+from spark_examples_tpu.utils.af import carrier_counts, variance_counts
+
+
+class GrmMoments:
+    """The AF pass: exact int64 per-site moments accumulated block by
+    block alongside the device Gramian feed — ``U = Σ k·x`` (N,), ``S2 =
+    Σ k²``, ``C = Σ k·(n−k)`` — O(N) host state, never O(M)."""
+
+    def __init__(self, num_samples: int):
+        self.n = int(num_samples)
+        self.U = np.zeros(self.n, dtype=np.int64)
+        self.S2 = 0
+        self.C = 0
+        self.sites = 0
+
+    def add_block(self, rows: np.ndarray) -> None:
+        X = np.asarray(rows, dtype=np.int64)
+        k = carrier_counts(X)
+        self.U += k @ X
+        self.S2 += int((k * k).sum())
+        self.C += int(variance_counts(k, self.n).sum())
+        self.sites += X.shape[0]
+
+
+def grm_finalize(G: np.ndarray, moments: GrmMoments) -> np.ndarray:
+    """The float64 VanRaden finalize over exact int64 numerators (module
+    docstring formula). ``G`` is the raw integer Gramian ``XᵀX`` (any
+    accumulator dtype whose entries are exact integers — the
+    ``graftcheck ranges`` invariant)."""
+    n = moments.n
+    if moments.C == 0:
+        raise ValueError(
+            f"kinship undefined: all {moments.sites} streamed site(s) are "
+            "monomorphic (zero variance) — nothing to standardize by"
+        )
+    Gi = np.asarray(G).astype(np.int64)  # private copy, mutated in place
+    if Gi.shape != (n, n):
+        raise ValueError(f"expected a ({n}, {n}) Gramian, got {Gi.shape}")
+    # n²·G − n·(U·1ᵀ + 1·Uᵀ) + S2, built in place: the transients are two
+    # N-vectors, not N×N temporaries — at the declared 25K-sample geometry
+    # each N×N int64 is ~5 GB, so the expression form would triple the
+    # finalize's peak host memory after all device work succeeded.
+    Gi *= n * n
+    nU = n * moments.U
+    Gi -= nU[:, None]
+    Gi -= nU[None, :]
+    Gi += moments.S2
+    return np.true_divide(Gi, float(moments.C))
+
+
+def grm_reference(rows: np.ndarray, num_samples: int) -> np.ndarray:
+    """Host NumPy oracle: the same integer-moment formula over the full
+    (M, N) genotype matrix at once — what the streamed two-pass result
+    must match byte for byte."""
+    X = np.asarray(rows, dtype=np.int64)
+    moments = GrmMoments(num_samples)
+    moments.add_block(X)
+    return grm_finalize(X.T @ X, moments)
+
+
+def format_grm_rows(
+    names: Sequence[str], matrix: np.ndarray
+) -> Iterator[Tuple]:
+    """The kinship TSV rows (name + float64 reprs) — ONE formatter shared
+    by the CLI writer and the CI oracle, so the byte compare tests the
+    math, never the formatting."""
+    for name, row in zip(names, np.asarray(matrix)):
+        yield (name, *(repr(float(v)) for v in row))
+
+
+@dataclass
+class GrmResult:
+    """One completed GRM run: the host kinship matrix (trimmed, float64),
+    column-order sample names, the served-surface summary, and the
+    manifest bookkeeping."""
+
+    matrix: np.ndarray
+    sample_names: List[str]
+    summary: Dict
+    manifest: Optional[Dict] = None
+    manifest_path: Optional[str] = None
+
+
+def _summarize(matrix: np.ndarray, sites: int) -> Dict:
+    """Host-side facts about a kinship matrix (the serve result surface —
+    a served response must not ship the N×N matrix)."""
+    M = np.asarray(matrix)
+    n = M.shape[0]
+    diag = np.diagonal(M)
+    off_mask = ~np.eye(n, dtype=bool)
+    return {
+        "shape": [int(s) for s in M.shape],
+        "sites": int(sites),
+        "trace": float(np.trace(M)),
+        "diag_mean": float(diag.mean()),
+        "off_diag_mean": float(M[off_mask].mean()) if n > 1 else 0.0,
+    }
+
+
+def run_grm_pipeline(conf: GrmConf) -> GrmResult:
+    """The GRM core, CLI-free: conf in, kinship + manifest out — the
+    batch verb and the serve executor's ``grm`` kind both call this, so a
+    served job executes the identical analysis."""
+    import jax
+
+    check_analysis_conf(conf, "grm")
+    from spark_examples_tpu.pipeline.pca_driver import VariantsPcaDriver
+    from spark_examples_tpu.utils.tracing import StageTimes
+
+    driver = VariantsPcaDriver(conf)
+    n = len(driver.indexes)
+    moments = GrmMoments(n)
+    times = StageTimes(recorder=driver.spans)
+    heartbeat = None
+    if getattr(conf, "heartbeat_seconds", 0) and conf.heartbeat_seconds > 0:
+        from spark_examples_tpu.obs.heartbeat import Heartbeat
+
+        heartbeat = Heartbeat(conf.heartbeat_seconds, driver.registry).start()
+    try:
+        with times.stage("ingest+gramian"):
+
+            def rows():
+                for _contig, block in iter_site_blocks(
+                    conf,
+                    driver.source,
+                    analysis_partitions(conf, driver.source),
+                    driver.io_stats,
+                    driver.registry,
+                ):
+                    hv = block["has_variation"]
+                    moments.add_block(hv)
+                    yield hv
+
+            similarity = driver.get_similarity_rows(rows())
+        with times.stage("grm-finalize"):
+            if conf.pca_backend == "host":
+                G_host = np.asarray(similarity)
+            else:
+                G_host = np.asarray(jax.device_get(similarity))
+            # Sharded finalizes return the padded matrix; trim to the
+            # true cohort (pad columns are all-zero by construction).
+            G_host = G_host[:n, :n]
+            matrix = grm_finalize(G_host, moments)
+    finally:
+        if heartbeat is not None:
+            heartbeat.stop()
+
+    names = cohort_sample_names(driver.indexes, driver.names)
+    if conf.grm_out:
+        from spark_examples_tpu.pipeline.sitewriter import SiteOutputWriter
+
+        with SiteOutputWriter(
+            conf.grm_out, header=("name", *names)
+        ) as writer:
+            writer.write_rows(format_grm_rows(names, matrix))
+        print(f"Kinship matrix written to {conf.grm_out}.")
+
+    summary = _summarize(matrix, moments.sites)
+    print(
+        f"GRM over {moments.sites} sites x {n} samples: trace "
+        f"{summary['trace']:.4f}, diag mean {summary['diag_mean']:.4f}."
+    )
+    driver.report_io_stats()
+    if conf.profile_dir:
+        print(str(times))
+    manifest, manifest_path, _ = finish_analysis_run(
+        conf,
+        "grm",
+        driver.spans,
+        driver.registry,
+        driver.io_stats,
+        sites_tested=moments.sites,
+        sites_kept=None,
+    )
+    return GrmResult(
+        matrix=matrix,
+        sample_names=names,
+        summary=summary,
+        manifest=manifest,
+        manifest_path=manifest_path,
+    )
+
+
+def run(argv: Sequence[str]) -> GrmResult:
+    """The ``grm`` CLI verb."""
+    conf = GrmConf.parse(argv)
+    conf.init_distributed()
+    return run_grm_pipeline(conf)
+
+
+__all__ = [
+    "GrmMoments",
+    "GrmResult",
+    "format_grm_rows",
+    "grm_finalize",
+    "grm_reference",
+    "run",
+    "run_grm_pipeline",
+]

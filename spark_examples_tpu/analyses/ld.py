@@ -1,0 +1,257 @@
+"""Windowed LD r² pruning (L5): the first M-sized-output analysis.
+
+A streaming device pass over contig-ordered site windows: sites fill a
+fixed ``(W, N)`` window buffer as blocks stream; each full window runs ONE
+device dispatch (``ops/ld.py:build_ld_window_stats`` — blockwise
+co-carrier counts under ``shard_map`` when the mesh has a samples axis),
+the host greedy-prunes the W×W r² matrix in contig order
+(``ops/ld.py:greedy_prune``, strictly-above ``--ld-r2-threshold``), and
+the window's kept-mask rows spill straight to the windowed writer
+(``pipeline/sitewriter.py``). Windows never cross a contig boundary and
+tail windows are zero-padded to the static ``W`` (padding rows are
+monomorphic → r² 0 → never pruned against — one compiled program serves
+every window).
+
+Host memory is O(window), device memory O(W² + W·N/devices), and the
+O(M) result exists only on disk — the per-site output path the N²
+reduction layer never needed, bounded by construction (no O(M) host
+list anywhere; ``graftcheck hostmem`` audits this file like any staging
+layer).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_examples_tpu.analyses.base import (
+    AnalysisContext,
+    finish_analysis_run,
+)
+from spark_examples_tpu.config import LdConf
+from spark_examples_tpu.ops.ld import build_ld_window_stats, greedy_prune
+
+
+@dataclass
+class LdResult:
+    """One completed LD prune: tested/kept counts, the output path (when
+    written), and the manifest bookkeeping."""
+
+    sites_tested: int
+    sites_kept: int
+    out_path: Optional[str] = None
+    manifest: Optional[Dict] = None
+    manifest_path: Optional[str] = None
+
+
+class _WindowedPruner:
+    """The bounded window engine: a pre-allocated ``(W, N)`` buffer fills
+    from the block stream; each flush is one device dispatch + one host
+    greedy prune + one writer append. State is O(W·N), independent of M."""
+
+    def __init__(
+        self, conf: LdConf, num_samples: int, stats_fn, writer, registry=None
+    ):
+        self.conf = conf
+        self.n = int(num_samples)
+        self.W = int(conf.ld_window_sites)
+        self.stats_fn = stats_fn
+        self.writer = writer
+        self.rows = np.zeros((self.W, self.n), dtype=np.uint8)
+        self.positions = np.zeros(self.W, dtype=np.int64)
+        self.fill = 0
+        self.contig: Optional[str] = None
+        self.sites_tested = 0
+        self.sites_kept = 0
+        # Live progress gauges (heartbeat's "analysis kept K/T" segment):
+        # a whole-genome prune reports its kept ratio per window, not after
+        # hours of silence. None-tolerant so oracle tests can run bare.
+        self._tested_gauge = self._kept_gauge = None
+        if registry is not None:
+            from spark_examples_tpu.obs.metrics import (
+                ANALYSIS_SITES_KEPT,
+                ANALYSIS_SITES_TESTED,
+                well_known_gauge,
+            )
+
+            self._tested_gauge = well_known_gauge(
+                registry, ANALYSIS_SITES_TESTED
+            )
+            self._kept_gauge = well_known_gauge(registry, ANALYSIS_SITES_KEPT)
+
+    def add_block(self, contig: str, block: Dict[str, np.ndarray]) -> None:
+        if contig != self.contig:
+            # Contig boundary: the prune is contig-ordered by contract —
+            # flush the tail window before the next contig's sites enter.
+            self.flush()
+            self.contig = contig
+        hv = np.asarray(block["has_variation"], dtype=np.uint8)
+        positions = np.asarray(block["positions"], dtype=np.int64)
+        offset = 0
+        while offset < hv.shape[0]:
+            take = min(self.W - self.fill, hv.shape[0] - offset)
+            self.rows[self.fill : self.fill + take] = hv[
+                offset : offset + take
+            ]
+            self.positions[self.fill : self.fill + take] = positions[
+                offset : offset + take
+            ]
+            self.fill += take
+            offset += take
+            if self.fill == self.W:
+                self.flush()
+
+    def flush(self) -> None:
+        """Process the current (possibly partial) window."""
+        if self.fill == 0:
+            return
+        import jax
+
+        fill = self.fill
+        # Tail windows ride the same compiled program: padding rows are
+        # all-zero (k = 0, zero variance), so the r² guard keeps them
+        # inert — and `valid` excludes them from the output/counters.
+        C, k = self.stats_fn(self.rows)
+        C = np.asarray(jax.device_get(C))  # graftcheck: disable=GC001 -- deliberate per-window fetch: the greedy prune is host-sequential by design, and the window (not the block) is the bounded unit of device work
+        k = np.asarray(jax.device_get(k))  # graftcheck: disable=GC001 -- same per-window fetch as C above
+        valid = np.zeros(self.W, dtype=bool)
+        valid[:fill] = True
+        kept = greedy_prune(
+            C, k, self.n, self.conf.ld_r2_threshold, valid=valid
+        )
+        if self.writer is not None:
+            contig = self.contig
+            self.writer.write_rows(
+                (contig, int(self.positions[i]), int(kept[i]))
+                for i in range(fill)
+            )
+        self.sites_tested += fill
+        self.sites_kept += int(kept[:fill].sum())
+        if self._tested_gauge is not None:
+            self._tested_gauge.set(self.sites_tested)
+            self._kept_gauge.set(self.sites_kept)
+        self.rows[:fill] = 0
+        self.fill = 0
+
+
+def ld_prune_reference(
+    windows: Sequence[Tuple[np.ndarray, np.ndarray]],
+    num_samples: int,
+    r2_threshold: float,
+) -> List[Tuple[int, bool]]:
+    """Host NumPy oracle of the windowed prune: ``windows`` is the
+    contig-partitioned, window-chunked site stream as ``(positions,
+    rows)`` pairs; returns ``(position, kept)`` in stream order."""
+    from spark_examples_tpu.ops.ld import ld_window_stats_reference
+
+    out: List[Tuple[int, bool]] = []
+    for positions, rows in windows:
+        C, k = ld_window_stats_reference(rows)
+        kept = greedy_prune(C, k, num_samples, r2_threshold)
+        out.extend(
+            (int(p), bool(m)) for p, m in zip(positions, kept)
+        )
+    return out
+
+
+def run_ld_pipeline(conf: LdConf) -> LdResult:
+    """The LD-prune core, CLI-free: conf in, kept-mask + manifest out."""
+    from spark_examples_tpu.utils.tracing import StageTimes
+
+    ctx = AnalysisContext(conf, "ld")
+    times = StageTimes(recorder=ctx.spans)
+    # --pca-backend host runs the window statistics as the NumPy oracle —
+    # no mesh, no compiled program — the same host escape hatch GRM and
+    # assoc honor.
+    host_oracle = conf.pca_backend == "host"
+    mesh = None if host_oracle else ctx.make_mesh()
+    if mesh is not None:
+        from spark_examples_tpu.parallel.mesh import SAMPLES_AXIS
+
+        samples_axis = mesh.shape.get(SAMPLES_AXIS, 1)
+        if samples_axis >= 2 and ctx.num_samples % samples_axis:
+            # Mirrored by `graftcheck plan --analysis ld`
+            # (ld-cohort-not-divisible): the window kernel shards sample
+            # columns without padding.
+            raise ValueError(
+                f"--num-samples {ctx.num_samples} does not divide over "
+                f"the mesh samples axis ({samples_axis}); choose a mesh "
+                "whose samples axis divides the cohort"
+            )
+    if host_oracle:
+        from spark_examples_tpu.ops.ld import ld_window_stats_reference
+
+        stats_fn = ld_window_stats_reference
+    else:
+        stats_fn = build_ld_window_stats(mesh)
+    writer = None
+    if conf.ld_out:
+        from spark_examples_tpu.pipeline.sitewriter import SiteOutputWriter
+
+        writer = SiteOutputWriter(
+            conf.ld_out, header=("contig", "pos", "kept")
+        )
+    heartbeat = None
+    if getattr(conf, "heartbeat_seconds", 0) and conf.heartbeat_seconds > 0:
+        from spark_examples_tpu.obs.heartbeat import Heartbeat
+
+        heartbeat = Heartbeat(conf.heartbeat_seconds, ctx.registry).start()
+    pruner = _WindowedPruner(
+        conf, ctx.num_samples, stats_fn, writer, registry=ctx.registry
+    )
+    try:
+        with times.stage("ingest+ld-prune"):
+            for contig, block in ctx.blocks():
+                pruner.add_block(contig, block)
+            pruner.flush()
+    except BaseException:
+        if writer is not None:
+            writer.abort()
+        raise
+    finally:
+        if heartbeat is not None:
+            heartbeat.stop()
+    if writer is not None:
+        writer.close()
+        print(f"Kept-site mask written to {conf.ld_out}.")
+    print(
+        f"LD prune (r² > {conf.ld_r2_threshold} pruned, window "
+        f"{conf.ld_window_sites}): kept {pruner.sites_kept} / "
+        f"{pruner.sites_tested} sites."
+    )
+    print(str(ctx.io_stats))
+    if conf.profile_dir:
+        print(str(times))
+    manifest, manifest_path, _ = finish_analysis_run(
+        conf,
+        "ld",
+        ctx.spans,
+        ctx.registry,
+        ctx.io_stats,
+        sites_tested=pruner.sites_tested,
+        sites_kept=pruner.sites_kept,
+    )
+    return LdResult(
+        sites_tested=pruner.sites_tested,
+        sites_kept=pruner.sites_kept,
+        out_path=conf.ld_out,
+        manifest=manifest,
+        manifest_path=manifest_path,
+    )
+
+
+def run(argv: Sequence[str]) -> LdResult:
+    """The ``ld-prune`` CLI verb."""
+    conf = LdConf.parse(argv)
+    conf.init_distributed()
+    return run_ld_pipeline(conf)
+
+
+__all__ = [
+    "LdResult",
+    "ld_prune_reference",
+    "run",
+    "run_ld_pipeline",
+]

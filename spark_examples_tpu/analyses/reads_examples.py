@@ -111,7 +111,7 @@ def run_example2(
             lengths = jnp.asarray(
                 [len(read.aligned_sequence) for _, read in shard], dtype=jnp.int32
             )
-            total += int(jnp.sum(lengths))
+            total += int(jnp.sum(lengths))  # graftcheck: disable=GC001 -- deliberate per-shard scalar fetch: the running total is host state and shards arrive serially from the paged source; there is no dispatch pipeline to stall
     coverage = total / float(length)
     print(f"Coverage of chromosome {sequence} = {coverage}")
     return coverage
@@ -162,9 +162,10 @@ def run_example3(
         # (and any carry from the previous shard) — no truncation cap.
         overhang = carry_start + len(carry) - part.start if carry_start is not None else 0
         window = max(span + read_pad, int(overhang))
-        counts = np.zeros(window, dtype=np.int64)
+        # Fresh per-shard window (O(window), reset every iteration — the
+        # carry below is the only state crossing shards).
         if shard:
-            counts += np.asarray(
+            counts = np.asarray(
                 depth_counts(
                     jnp.asarray(positions),
                     jnp.asarray(lengths),
@@ -174,17 +175,21 @@ def run_example3(
                 ),
                 dtype=np.int64,
             )
+        else:
+            counts = np.zeros(window, dtype=np.int64)
         if carry_start is not None and len(carry):
             off = carry_start - part.start
             lo, hi = max(0, off), min(window, off + len(carry))
             if hi > lo:
                 counts[lo:hi] += carry[lo - off : hi - off]
         covered = np.nonzero(counts[:span] > 0)[0]
+        # graftcheck: hostmem(unbounded) -- the reads examples replicate the reference's saveAsTextFile result surface (whole-region (pos,depth) lines in memory); small-region demos by contract — the per-site streaming writer (pipeline/sitewriter.py) is the analyses/ path for genome-scale outputs
         lines.extend(f"({part.start + i},{counts[i]})" for i in covered)
         carry = counts[span:].copy()
         carry_start = part.end
     if carry_start is not None:
         for i in np.nonzero(carry > 0)[0]:
+            # graftcheck: hostmem(unbounded) -- same whole-region result surface as the shard loop above (reference saveAsTextFile shape; small-region demos)
             lines.append(f"({carry_start + i},{carry[i]})")
     _write_part_file(os.path.join(out_path, f"coverage_{sequence}"), lines)
     return lines
@@ -213,7 +218,9 @@ def _base_frequencies(
         read_pad = _pad_read_length(L) if kept else 64
         overhang = carry_start + len(carry) - part.start if carry_start is not None else 0
         window = max(span + read_pad, int(overhang))
-        counts = np.zeros((window, len(BASES)), dtype=np.int64)
+        # Fresh per-shard window (O(window); the carry is the only state
+        # crossing shards) — the device scatter-add result, or zeros when
+        # no read passed the mapping-quality gate.
         if kept:
             positions = np.asarray([r.position for r in kept], dtype=np.int32)
             codes = np.full((len(kept), L), -1, dtype=np.int8)
@@ -227,7 +234,7 @@ def _base_frequencies(
                 qual_ok[i, :nq] = (
                     np.asarray(read.aligned_quality[:nq]) >= min_base_quality
                 )
-            counts += np.asarray(
+            counts = np.asarray(
                 base_counts(
                     jnp.asarray(positions),
                     jnp.asarray(codes),
@@ -237,6 +244,8 @@ def _base_frequencies(
                 ),
                 dtype=np.int64,
             )
+        else:
+            counts = np.zeros((window, len(BASES)), dtype=np.int64)
         if carry_start is not None and len(carry):
             off = carry_start - part.start
             lo, hi = max(0, off), min(window, off + len(carry))

@@ -13,7 +13,8 @@ on them:
                                               0 acyclic+clean / 1 findings
     graftcheck hostmem [PATH...] [--json]     0 clean (declared sites
                                               allowed) / 1 findings
-    graftcheck plan <pca flags> [--plan-devices N]
+    graftcheck plan [--analysis pca|grm|ld|assoc] <verb flags>
+                  [--plan-devices N]
                   [--host-mem-budget BYTES] [--json]
                                               0 plan OK / 2 rejected
     graftcheck sanitize [--modes m1,m2] [--strict]
@@ -229,14 +230,18 @@ def _cmd_plan(argv: Sequence[str]) -> int:
     from spark_examples_tpu.check.plan import parse_plan_args, validate_plan
 
     try:
-        conf, plan_devices, json_out, host_mem_budget = parse_plan_args(argv)
+        conf, plan_devices, json_out, host_mem_budget, analysis = (
+            parse_plan_args(argv)
+        )
     except ValueError as e:
         # Cross-flag contract violations from PcaConf._from_namespace are
         # plan rejections in their own right (e.g. --blocks-per-dispatch 0).
         print(f"  ERROR [flag-contract] {e}")
         print("plan REJECTED")
         return 2
-    report = validate_plan(conf, plan_devices, host_mem_budget=host_mem_budget)
+    report = validate_plan(
+        conf, plan_devices, host_mem_budget=host_mem_budget, analysis=analysis
+    )
     print(report.to_json() if json_out else report.format())
     return 0 if report.ok else 2
 

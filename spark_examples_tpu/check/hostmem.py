@@ -571,14 +571,16 @@ def audit_source(
 
 def default_hostmem_paths() -> List[str]:
     """The audited host-staging layers of the installed package (kept in
-    lockstep with ``check/rules.py:HOSTMEM_GLOBS``): the ingest stack
-    plus the resident service's control plane (``serve/``)."""
+    lockstep with ``check/rules.py:HOSTMEM_GLOBS``): the ingest stack,
+    the resident service's control plane (``serve/``), and the
+    population-genetics analyses (``analyses/`` — the per-site output
+    layer whose boundedness is the whole point)."""
     import spark_examples_tpu
 
     package_dir = os.path.dirname(os.path.abspath(spark_examples_tpu.__file__))
     return [
         os.path.join(package_dir, sub)
-        for sub in ("sources", "pipeline", "ops", "serve")
+        for sub in ("sources", "pipeline", "ops", "serve", "analyses")
     ]
 
 
@@ -730,6 +732,8 @@ def conf_host_peak_bytes(
     host_backend = getattr(conf, "pca_backend", "tpu") == "host"
     if num_samples is None:
         num_samples = int(conf.num_samples)
+    from spark_examples_tpu.config import AssocConf, GrmConf, LdConf
+
     return host_peak_bytes(
         num_samples=int(num_samples),
         block_size=int(conf.block_size),
@@ -738,7 +742,25 @@ def conf_host_peak_bytes(
         chunk_bytes=chunk_bytes,
         prefetch_depth=prefetch_depth,
         pipeline_depth=pipeline_depth,
-        host_accumulator=host_backend,
+        # The host-oracle N×N accumulator exists only where the run
+        # builds a Gramian (PCA, and GRM whose device work IS the
+        # Gramian); LD/assoc under --pca-backend host run O(window)
+        # NumPy oracles and must not be charged for a matrix they
+        # never allocate.
+        host_accumulator=(
+            host_backend and not isinstance(conf, (LdConf, AssocConf))
+        ),
+        # The GRM finalize's N×N host matrices and the LD prune's W×W
+        # per-flush working set are costs the PCA path never pays — the
+        # plan budget, the driver's gauge, and the manifest's host_memory
+        # block all resolve through here, so the terms cannot drift
+        # between prover and runtime.
+        grm_finalize=isinstance(conf, GrmConf),
+        ld_window_sites=(
+            int(getattr(conf, "ld_window_sites", 0) or 0)
+            if isinstance(conf, LdConf)
+            else 0
+        ),
     )
 
 

@@ -17,6 +17,15 @@ flush) wastes all of it. This module dry-runs a full flag configuration
   ingest-block → accumulator shape/dtype agreement is proven by the same
   code that will run, without touching a device or allocating a byte.
 
+The population-genetics analyses (``analyses/``: GRM/kinship, windowed LD
+pruning, association scan) validate through the same machinery —
+``graftcheck plan --analysis grm|ld|assoc <flags>`` parses the REAL
+per-verb parser (``config.build_grm_parser`` etc.), mirrors the runtime
+admission gate (``analyses/base.py:analysis_conf_violations`` — one
+catalogue, zero drift), and eval_shapes the real per-site kernels
+(``ops/ld.py``), so a doomed GRM/LD/assoc configuration is an exit-2
+reject before any ingest, exactly like a doomed PCA one.
+
 Exit contract (``check/cli.py``): 0 = plan OK (warnings allowed),
 2 = plan rejected with at least one error.
 """
@@ -28,7 +37,25 @@ import json
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
-from spark_examples_tpu.config import PcaConf, build_pca_parser
+from spark_examples_tpu.config import (
+    AssocConf,
+    GrmConf,
+    LdConf,
+    PcaConf,
+    build_assoc_parser,
+    build_grm_parser,
+    build_ld_parser,
+    build_pca_parser,
+)
+
+#: The validated flag surfaces: one entry per CLI verb, each the REAL
+#: parser/conf pair the verb itself parses — never a drifted copy.
+ANALYSIS_SURFACES = {
+    "pca": (build_pca_parser, PcaConf),
+    "grm": (build_grm_parser, GrmConf),
+    "ld": (build_ld_parser, LdConf),
+    "assoc": (build_assoc_parser, AssocConf),
+}
 
 
 @dataclass
@@ -102,13 +129,47 @@ class _RaisingParser(argparse.ArgumentParser):
 
 
 def parse_plan_args(argv: Sequence[str]):
-    """Parse ``graftcheck plan`` argv: the full PCA flag surface plus the
+    """Parse ``graftcheck plan`` argv: the analysis's full flag surface
+    (``--analysis pca|grm|ld|assoc``, default pca — pre-scanned so the
+    remaining flags parse through that verb's REAL parser) plus the
     plan-only ``--plan-devices`` and ``--host-mem-budget``. Returns
-    ``(conf, plan_devices, json_out, host_mem_budget)``. Flag errors raise
-    ``ValueError`` (argparse's SystemExit is converted so the caller
-    reports them as plan rejections, not a CLI crash)."""
-    parser = build_pca_parser(
-        _RaisingParser(prog="graftcheck plan", add_help=True)
+    ``(conf, plan_devices, json_out, host_mem_budget, analysis)``. Flag
+    errors raise ``ValueError`` (argparse's SystemExit is converted so the
+    caller reports them as plan rejections, not a CLI crash)."""
+    argv = list(argv)
+    analysis = "pca"
+    for index, arg in enumerate(argv):
+        if arg == "--analysis":
+            if index + 1 >= len(argv):
+                raise ValueError(
+                    "--analysis needs a value: one of "
+                    + "|".join(sorted(ANALYSIS_SURFACES))
+                )
+            analysis = argv[index + 1]
+            del argv[index : index + 2]
+            break
+        if arg.startswith("--analysis="):
+            analysis = arg.split("=", 1)[1]
+            del argv[index]
+            break
+    if analysis not in ANALYSIS_SURFACES:
+        raise ValueError(
+            f"--analysis {analysis!r} is not one of "
+            + "|".join(sorted(ANALYSIS_SURFACES))
+        )
+    build_parser, conf_cls = ANALYSIS_SURFACES[analysis]
+    parser = build_parser(
+        _RaisingParser(prog=f"graftcheck plan [{analysis}]", add_help=True)
+    )
+    parser.add_argument(
+        "--analysis",
+        choices=sorted(ANALYSIS_SURFACES),
+        default=analysis,
+        help=(
+            "Which analysis surface to validate (default pca). Consumed "
+            "by a pre-scan so the remaining flags parse through that "
+            "verb's real parser; registered here so --help documents it."
+        ),
     )
     parser.add_argument(
         "--plan-devices",
@@ -135,9 +196,9 @@ def parse_plan_args(argv: Sequence[str]):
     parser.add_argument(
         "--json", action="store_true", help="Emit the machine-readable report."
     )
-    ns = parser.parse_args(list(argv))
-    conf = PcaConf._from_namespace(ns)
-    return conf, ns.plan_devices, ns.json, ns.host_mem_budget
+    ns = parser.parse_args(argv)
+    conf = conf_cls._from_namespace(ns)
+    return conf, ns.plan_devices, ns.json, ns.host_mem_budget, analysis
 
 
 def _resolve_mesh_axes(
@@ -548,6 +609,264 @@ def _check_exactness(
         )
 
 
+def _check_artifact_parent(
+    report: PlanReport, code: str, flag: str, path: Optional[str]
+) -> None:
+    """An output artifact whose parent directory is missing/unwritable only
+    fails AFTER the analysis streamed every site — the exact class of
+    late-surfacing error the validator exists to catch (the
+    ``--metrics-json`` rule, shared by the analyses' out flags)."""
+    if not path:
+        return
+    import os
+
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    if not os.path.isdir(parent):
+        report.error(
+            code,
+            f"{flag} {path}: parent directory {parent} does not exist; "
+            "the output publish would fail AFTER the analysis completed",
+        )
+    elif not os.access(parent, os.W_OK):
+        report.error(
+            code,
+            f"{flag} {path}: parent directory {parent} is not writable; "
+            "the output publish would fail AFTER the analysis completed",
+        )
+    elif os.path.isdir(path):
+        report.error(
+            code,
+            f"{flag} {path} is a directory; the output needs a file path",
+        )
+
+
+def _check_analysis(
+    report: PlanReport, conf: PcaConf, analysis: str, samples: int
+) -> None:
+    """The device-free mirror of the analyses' runtime admission gate
+    (``analyses/base.py:analysis_conf_violations`` — the ONE catalogue)
+    plus per-analysis flag contracts: LD window/threshold grammar and the
+    samples-axis divisibility the ``shard_map`` kernel needs, the assoc
+    phenotype TSV (parsed HERE, device-free, including synthetic-cohort
+    coverage), and every per-site output path's parent."""
+    from spark_examples_tpu.analyses.base import analysis_conf_violations
+
+    for code, message in analysis_conf_violations(conf, analysis):
+        report.error(code, message)
+
+    if analysis == "grm":
+        _check_artifact_parent(
+            report, "grm-out", "--grm-out", getattr(conf, "grm_out", None)
+        )
+        return
+
+    if analysis == "ld":
+        threshold = getattr(conf, "ld_r2_threshold", 0.2)
+        if not 0.0 <= threshold <= 1.0:
+            report.error(
+                "ld-r2-threshold",
+                f"--ld-r2-threshold must be in [0, 1], got {threshold} "
+                "(outside the range every site, or no site, is pruned)",
+            )
+        window = int(getattr(conf, "ld_window_sites", 256))
+        if window < 2:
+            report.error(
+                "ld-window-sites",
+                f"--ld-window-sites must be >= 2, got {window} (a "
+                "one-site window has nothing to correlate)",
+            )
+        else:
+            N = int(conf.num_samples)
+            report.geometry["ld_window_sites"] = window
+            # The per-window device statistics: C (W, W) int32 + k (W,)
+            # int32 — the whole M-sized analysis only ever materializes
+            # this much at once (plus the (W, N) uint8 window buffer).
+            stats_bytes = window * window * 4 + window * 4
+            report.geometry["ld_window_stats_bytes"] = stats_bytes
+            report.geometry["ld_window_buffer_bytes"] = window * N
+            from spark_examples_tpu.ops.gramian import (
+                _DEFAULT_DEVICE_BYTES,
+                DENSE_HBM_FRACTION,
+            )
+
+            if stats_bytes > DENSE_HBM_FRACTION * _DEFAULT_DEVICE_BYTES:
+                report.error(
+                    "ld-window-exceeds-hbm",
+                    f"--ld-window-sites {window} needs a ~"
+                    f"{stats_bytes / (1 << 30):.1f} GiB W×W statistics "
+                    f"matrix per flush, past {DENSE_HBM_FRACTION:.0%} of "
+                    f"the {_DEFAULT_DEVICE_BYTES >> 30} GiB default HBM "
+                    "budget; shrink the window (host memory scales with "
+                    "W² too — see host_peak_bytes)",
+                )
+        if (
+            samples >= 2
+            and conf.pca_backend != "host"
+            and int(conf.num_samples) % samples
+        ):
+            # --pca-backend host runs the NumPy window oracle: no mesh,
+            # no sharding constraint (mirrors analyses/ld.py).
+            report.error(
+                "ld-cohort-not-divisible",
+                f"--num-samples {conf.num_samples} does not divide over "
+                f"the mesh samples axis ({samples}); the LD window kernel "
+                "shards sample columns without padding (choose a mesh "
+                "whose samples axis divides the cohort)",
+            )
+        _check_artifact_parent(
+            report, "ld-out", "--ld-out", getattr(conf, "ld_out", None)
+        )
+        return
+
+    # assoc
+    top = int(getattr(conf, "assoc_top", 10))
+    if top < 1:
+        report.error(
+            "assoc-top", f"--assoc-top must be >= 1, got {top}"
+        )
+    phenotypes = getattr(conf, "phenotypes", None)
+    if not phenotypes:
+        report.error(
+            "assoc-phenotypes",
+            "the assoc analysis requires --phenotypes TSV "
+            "(name<TAB>status per line, status 0=control/1=case)",
+        )
+    else:
+        from spark_examples_tpu.analyses.assoc import load_phenotypes
+
+        try:
+            statuses = load_phenotypes(phenotypes)
+        except (OSError, ValueError) as e:
+            report.error("assoc-phenotypes", f"--phenotypes: {e}")
+        else:
+            cases = sum(statuses.values())
+            report.geometry["assoc_cases"] = cases
+            report.geometry["assoc_controls"] = len(statuses) - cases
+            if getattr(conf, "source", "synthetic") == "synthetic":
+                # The synthetic cohort's callset names are derivable
+                # device-free, so the strict both-ways coverage check the
+                # runtime applies (``analyses/assoc.py:case_vector``) runs
+                # at plan time too; file cohorts carry their names in the
+                # data, so only the runtime can check them.
+                from spark_examples_tpu.analyses.assoc import case_vector
+                from spark_examples_tpu.pipeline.pca_driver import (
+                    make_source,
+                )
+
+                try:
+                    callsets = make_source(conf).search_callsets(
+                        conf.variant_set_id
+                    )
+                    case_vector(
+                        statuses, [cs["name"] for cs in callsets]
+                    )
+                except ValueError as e:
+                    report.error("assoc-cohort-mismatch", str(e))
+    _check_artifact_parent(
+        report, "assoc-out", "--assoc-out", getattr(conf, "assoc_out", None)
+    )
+
+
+def _eval_analysis_kernels(
+    report: PlanReport, conf: PcaConf, analysis: str, data: int, samples: int
+) -> None:
+    """Abstract shape proof of the per-site kernels the analysis will
+    dispatch (``ops/ld.py`` — the SAME construction sites the runtime
+    calls), traced with ``jax.eval_shape`` over ``ShapeDtypeStruct``
+    operands and, when the mesh has a samples axis, through ``shard_map``
+    over an ``AbstractMesh`` — zero devices, zero bytes."""
+    import jax
+    import jax.numpy as jnp
+
+    N = int(conf.num_samples)
+    if analysis == "ld":
+        from spark_examples_tpu.ops.ld import build_ld_window_stats
+
+        window = int(conf.ld_window_sites)
+        mesh = None
+        mesh_note = "single-device"
+        if samples >= 2:
+            try:
+                from jax.sharding import AbstractMesh
+            except ImportError:
+                report.warn(
+                    "no-abstract-mesh",
+                    "this jax has no AbstractMesh; the LD window kernel "
+                    "is shape-checked single-device only",
+                )
+            else:
+                from spark_examples_tpu.parallel.mesh import (
+                    DATA_AXIS,
+                    SAMPLES_AXIS,
+                )
+
+                mesh = AbstractMesh(
+                    ((DATA_AXIS, data), (SAMPLES_AXIS, samples))
+                )
+                mesh_note = f"abstract {data}x{samples} mesh"
+        try:
+            stats_fn = build_ld_window_stats(mesh)
+            C, k = jax.eval_shape(
+                stats_fn, jax.ShapeDtypeStruct((window, N), jnp.uint8)
+            )
+        except Exception as e:  # noqa: BLE001 — the trace failure is the finding
+            report.error(
+                "ld-window-stats-trace",
+                f"LD window-statistics kernel fails to trace over "
+                f"{mesh_note}: {type(e).__name__}: {e}",
+            )
+            return
+        if (
+            C.shape != (window, window)
+            or str(C.dtype) != "int32"
+            or k.shape != (window,)
+            or str(k.dtype) != "int32"
+        ):
+            report.error(
+                "ld-window-stats-shape",
+                f"LD window statistics map ({window}, {N}) uint8 to "
+                f"C {C.shape} {C.dtype}, k {k.shape} {k.dtype} — expected "
+                f"(({window}, {window}) int32, ({window},) int32)",
+            )
+        else:
+            report.shape_checks.append(
+                f"LD window stats over {mesh_note}: ({window}, {N}) uint8 "
+                f"window -> C ({window}, {window}) int32, k ({window},) "
+                "int32"
+            )
+        return
+
+    if analysis == "assoc":
+        from spark_examples_tpu.ops.ld import build_case_counts
+
+        B = int(conf.block_size)
+        try:
+            a, t = jax.eval_shape(
+                build_case_counts(),
+                jax.ShapeDtypeStruct((B, N), jnp.uint8),
+                jax.ShapeDtypeStruct((N,), jnp.uint8),
+            )
+        except Exception as e:  # noqa: BLE001 — the trace failure is the finding
+            report.error(
+                "assoc-counts-trace",
+                f"association counts kernel fails to trace: "
+                f"{type(e).__name__}: {e}",
+            )
+            return
+        if a.shape != (B,) or t.shape != (B,) or str(a.dtype) != "int32":
+            report.error(
+                "assoc-counts-shape",
+                f"association counts map ({B}, {N}) uint8 blocks to "
+                f"a {a.shape} {a.dtype}, t {t.shape} {t.dtype} — expected "
+                f"(({B},) int32, ({B},) int32)",
+            )
+        else:
+            report.shape_checks.append(
+                f"association counts: ({B}, {N}) uint8 blocks x ({N},) "
+                f"case mask -> a ({B},) int32, t ({B},) int32"
+            )
+
+
 def _check_host_memory(
     conf: PcaConf,
     plan_devices: Optional[int],
@@ -601,10 +920,25 @@ def validate_plan(
     conf: PcaConf,
     plan_devices: Optional[int] = None,
     host_mem_budget: Optional[int] = None,
+    analysis: str = "pca",
 ) -> PlanReport:
     """Statically validate one pipeline configuration. Pure flag/geometry
-    arithmetic plus abstract kernel traces — no device is queried."""
+    arithmetic plus abstract kernel traces — no device is queried.
+    ``analysis`` selects the validated workload: ``pca`` (the default —
+    also the ``similarity`` served kind) keeps every Gramian proof;
+    ``grm`` adds the analyses' shared admission gate on top of them (its
+    device work IS the Gramian); ``ld``/``assoc`` swap the Gramian
+    shape/exactness/HBM proofs for their own per-site kernel proofs —
+    they never allocate an N×N accumulator, so rejecting an LD plan for a
+    Gramian-only bound would be a false contract."""
+    if analysis not in ANALYSIS_SURFACES:
+        raise ValueError(
+            f"analysis {analysis!r} is not one of "
+            + "|".join(sorted(ANALYSIS_SURFACES))
+        )
     report = PlanReport()
+    if analysis != "pca":
+        report.geometry["analysis"] = analysis
     if host_mem_budget is not None and host_mem_budget <= 0:
         report.error(
             "host-mem-budget",
@@ -632,7 +966,10 @@ def validate_plan(
         )
     if conf.num_pc < 1:
         report.error("num-pc", f"--num-pc must be >= 1, got {conf.num_pc}")
-    elif conf.num_pc > conf.num_samples:
+    elif conf.num_pc > conf.num_samples and analysis == "pca":
+        # Only the PCA pipeline eigensolves; the analyses ride the PCA
+        # flag surface but never call compute_pca, so a defaulted --num-pc
+        # must not reject a 1-sample GRM/LD/assoc run.
         report.error(
             "num-pc-exceeds-cohort",
             f"--num-pc {conf.num_pc} exceeds the cohort size "
@@ -786,8 +1123,17 @@ def validate_plan(
             "the window count",
         )
 
+    # -------------------------------------- analyses admission gate (if any)
+    if analysis != "pca":
+        _check_analysis(report, conf, analysis, samples)
+
     # ----------------------------------------- abstract kernel shape proofs
-    if conf.pca_backend == "tpu":
+    # GRM's device work IS the Gramian accumulation (analyses/grm.py rides
+    # the full driver), so pca and grm prove the Gramian kernels; ld and
+    # assoc never allocate an N×N accumulator — they prove their own
+    # per-site kernels instead.
+    gramian_like = analysis in ("pca", "grm")
+    if conf.pca_backend == "tpu" and gramian_like:
         if report.ok:
             _eval_dense_update(report, data, conf)
         ring_trace = None
@@ -798,6 +1144,8 @@ def validate_plan(
             _check_exactness(
                 report, data, samples, conf, ring_trace=ring_trace
             )
+    if conf.pca_backend == "tpu" and not gramian_like and report.ok:
+        _eval_analysis_kernels(report, conf, analysis, data, samples)
 
     # --------------------------------------------------- memory feasibility
     from spark_examples_tpu.ops.gramian import (
@@ -809,10 +1157,16 @@ def validate_plan(
     N = int(conf.num_samples)
     accum_bytes = 4
     dense_need = _DENSE_BUFFERS * N * N * accum_bytes
-    report.geometry["dense_accumulator_bytes_per_device"] = N * N * accum_bytes
+    if gramian_like:
+        report.geometry["dense_accumulator_bytes_per_device"] = (
+            N * N * accum_bytes
+        )
     staging = data * conf.block_size * N
     report.geometry["host_staging_bytes"] = staging
     _check_host_memory(conf, plan_devices, host_mem_budget, report)
+    if not gramian_like:
+        # LD/assoc never build the Gramian: no dense-HBM rule to apply.
+        return report
     if not sharded and conf.similarity_strategy == "dense":
         # Explicit dense: validate against the default HBM budget (the
         # validator must not query real devices; the run's auto rule reads
@@ -830,4 +1184,10 @@ def validate_plan(
     return report
 
 
-__all__ = ["PlanIssue", "PlanReport", "parse_plan_args", "validate_plan"]
+__all__ = [
+    "ANALYSIS_SURFACES",
+    "PlanIssue",
+    "PlanReport",
+    "parse_plan_args",
+    "validate_plan",
+]

@@ -30,8 +30,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 #: Directories (package-relative glob prefixes) that are "hot path" for
 #: device-sync rules: per-block work that runs once per genotype block or
-#: per shard, where one stray sync serializes the pipeline.
-HOT_PATH_GLOBS = ("ops/*", "pipeline/*")
+#: per shard, where one stray sync serializes the pipeline. ``analyses/*``
+#: joined with the population-genetics subsystem: its per-window/per-block
+#: device fetches are deliberate (host-sequential prune/chi-square) and
+#: carry justified GC001 disables — new ones must justify themselves too.
+HOT_PATH_GLOBS = ("ops/*", "pipeline/*", "analyses/*")
 
 #: Ingest-concurrency scope: modules where threads share parse state, so
 #: bare lock creation must carry the documented lock-ordering idiom
@@ -43,13 +46,15 @@ INGEST_GLOBS = (
     "pipeline/datasets.py",
     "utils/native.py",
     "serve/*",
+    "analyses/*",
 )
 
 #: Telemetry scope: pipeline code whose counters must flow through the
 #: metrics registry (``obs/metrics.py``) via the owning object's methods —
 #: a bare ``stats.x += n`` bypasses both the lock and the manifest. The
-#: service's control plane (``serve/*``) carries the same obligation.
-TELEMETRY_GLOBS = ("ops/*", "pipeline/*", "sources/*", "serve/*")
+#: service's control plane (``serve/*``) and the analyses layer
+#: (``analyses/*``) carry the same obligation.
+TELEMETRY_GLOBS = ("ops/*", "pipeline/*", "sources/*", "serve/*", "analyses/*")
 
 
 @dataclass(frozen=True)
@@ -313,7 +318,11 @@ RANGES_RULES: Dict[str, Rule] = {
 #: HBM/ring-traffic bounds the plan validator already proves. ``serve/*``
 #: joined with the resident service: a daemon that buffers request bodies
 #: or job backlogs unboundedly would OOM exactly like an O(file) ingest.
-HOSTMEM_GLOBS = ("sources/*", "pipeline/*", "ops/*", "serve/*")
+#: ``analyses/*`` joined with the population-genetics subsystem: its
+#: per-site (M-sized) outputs are exactly the shape an accidental O(M)
+#: host list would silently break — the windowed writer discipline is
+#: machine-checked from birth.
+HOSTMEM_GLOBS = ("sources/*", "pipeline/*", "ops/*", "serve/*", "analyses/*")
 
 #: ``graftcheck hostmem`` rule catalogue (``check/hostmem.py``): an AST
 #: dataflow audit classifying every host ingest/consume path as

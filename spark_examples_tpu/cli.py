@@ -16,6 +16,18 @@ output):
     python -m spark_examples_tpu variants-pca --source file \\
         --input-files cohort.vcf.gz --ingest-workers 8
 
+Population-genetics analyses (``analyses/``; README "Analyses"): three
+per-site workloads on the same substrate — ``grm`` (allele-frequency-
+standardized VanRaden kinship, a reweighting of the PCA Gramian), and two
+M-sized-output analyses whose statistics spill window by window:
+
+    python -m spark_examples_tpu grm --num-samples 64 \\
+        --references 1:0:400000 --grm-out kinship.tsv
+    python -m spark_examples_tpu ld-prune --ld-r2-threshold 0.2 \\
+        --ld-window-sites 256 --ld-out kept.tsv
+    python -m spark_examples_tpu assoc-scan --phenotypes pheno.tsv \\
+        --assoc-out scan.tsv
+
 Static analysis (``check/``; README "graftcheck"): ``graftcheck lint``
 (AST JAX-pitfall linter), ``graftcheck ir`` (jaxpr-level audit of the real
 Gramian kernels: ring overlap, donation contract, packed-wire dtype flow,
@@ -120,6 +132,26 @@ def _serve(argv):
     return serve_main(argv)
 
 
+def _grm(argv):
+    # Population-genetics analyses (analyses/; README "Analyses"):
+    # imported lazily so `--help` and graftcheck stay import-light.
+    from spark_examples_tpu.analyses import grm
+
+    return grm.run(argv)
+
+
+def _ld_prune(argv):
+    from spark_examples_tpu.analyses import ld
+
+    return ld.run(argv)
+
+
+def _assoc_scan(argv):
+    from spark_examples_tpu.analyses import assoc
+
+    return assoc.run(argv)
+
+
 def _submit(argv):
     # Pure HTTP client: submitting to a remote daemon must not initialize
     # a local jax backend — dispatched before the real-command setup.
@@ -130,6 +162,9 @@ def _submit(argv):
 
 COMMANDS = {
     "variants-pca": lambda argv: pca_driver.run(argv),
+    "grm": _grm,
+    "ld-prune": _ld_prune,
+    "assoc-scan": _assoc_scan,
     "graftcheck": _graftcheck,
     "serve": _serve,
     "submit": _submit,

@@ -589,4 +589,186 @@ class PcaConf(GenomicsConf):
         return contigs
 
 
-__all__ = ["GenomicsConf", "PcaConf", "build_pca_parser"]
+# --------------------------------------------------------------------------
+# Population-genetics analyses (analyses/): one conf per CLI verb, each a
+# thin extension of the PCA flag surface — the analyses ride the same
+# sources/mesh/block/telemetry flags, so everything the plan validator and
+# the serve admission path already know keeps applying. The shared base
+# parser means `graftcheck plan --analysis grm|ld|assoc` validates EXACTLY
+# the grammar the real verbs parse, never a drifted copy.
+# --------------------------------------------------------------------------
+
+
+def build_grm_parser(
+    parser: Optional[argparse.ArgumentParser] = None,
+) -> argparse.ArgumentParser:
+    """``grm`` verb flags: the PCA surface plus the kinship output path."""
+    parser = build_pca_parser(parser)
+    parser.add_argument(
+        "--grm-out",
+        default=None,
+        metavar="PATH",
+        help=(
+            "Write the N×N VanRaden kinship matrix as a TSV (one row per "
+            "sample: name, then N float64 values; atomic publish). Unset: "
+            "only the summary is printed — the matrix never needs to "
+            "leave the device path for summaries."
+        ),
+    )
+    return parser
+
+
+def build_ld_parser(
+    parser: Optional[argparse.ArgumentParser] = None,
+) -> argparse.ArgumentParser:
+    """``ld-prune`` verb flags: windowed r² pruning over contig-ordered
+    sites."""
+    parser = build_pca_parser(parser)
+    parser.add_argument(
+        "--ld-r2-threshold",
+        type=float,
+        default=0.2,
+        help=(
+            "Prune a site whose r² with any previously-kept site in its "
+            "window is STRICTLY greater than this (greedy, contig order; "
+            "must be in [0, 1])."
+        ),
+    )
+    parser.add_argument(
+        "--ld-window-sites",
+        type=int,
+        default=256,
+        help=(
+            "Sites per pruning window (>= 2). Windows are contig-ordered "
+            "and independent; the device computes one W×W co-carrier "
+            "matrix per window, so host and HBM cost is O(W²), never O(M)."
+        ),
+    )
+    parser.add_argument(
+        "--ld-out",
+        default=None,
+        metavar="PATH",
+        help=(
+            "Write the per-site kept mask as a TSV (contig, pos, kept "
+            "0/1), streamed window by window (bounded host memory, atomic "
+            "publish). Unset: only the kept/tested counts are printed."
+        ),
+    )
+    return parser
+
+
+def build_assoc_parser(
+    parser: Optional[argparse.ArgumentParser] = None,
+) -> argparse.ArgumentParser:
+    """``assoc-scan`` verb flags: per-site case/control chi-square."""
+    parser = build_pca_parser(parser)
+    parser.add_argument(
+        "--phenotypes",
+        default=None,
+        metavar="TSV",
+        help=(
+            "REQUIRED: two-column TSV (sample name, status 0=control/"
+            "1=case; '#' comment lines skipped) covering every cohort "
+            "sample by its callset name."
+        ),
+    )
+    parser.add_argument(
+        "--assoc-out",
+        default=None,
+        metavar="PATH",
+        help=(
+            "Write the per-site scan as a TSV (contig, pos, case "
+            "carriers, total carriers, chi2), streamed block by block "
+            "(bounded host memory, atomic publish). Unset: only the "
+            "top-ranked sites are printed."
+        ),
+    )
+    parser.add_argument(
+        "--assoc-top",
+        type=int,
+        default=10,
+        help=(
+            "How many top-chi² sites to print (and return) — a bounded "
+            "heap, so the ranking never holds O(M) rows on host."
+        ),
+    )
+    return parser
+
+
+@dataclass
+class GrmConf(PcaConf):
+    """``grm`` flags: allele-frequency-standardized kinship (VanRaden)."""
+
+    grm_out: Optional[str] = None
+
+    @classmethod
+    def parse(cls, argv: Sequence[str]) -> "GrmConf":
+        ns = build_grm_parser().parse_args(list(argv))
+        return cls._from_namespace(ns)
+
+
+@dataclass
+class LdConf(PcaConf):
+    """``ld-prune`` flags: windowed LD r² pruning."""
+
+    ld_r2_threshold: float = 0.2
+    ld_window_sites: int = 256
+    ld_out: Optional[str] = None
+
+    @classmethod
+    def parse(cls, argv: Sequence[str]) -> "LdConf":
+        ns = build_ld_parser().parse_args(list(argv))
+        return cls._from_namespace(ns)
+
+    @classmethod
+    def _from_namespace(cls, ns: argparse.Namespace) -> "LdConf":
+        conf = super()._from_namespace(ns)
+        # Parse-time rejects (the plan validator repeats these for
+        # programmatic confs): a threshold outside [0,1] silently keeps or
+        # prunes everything, a window below 2 has nothing to correlate.
+        if not (0.0 <= conf.ld_r2_threshold <= 1.0):
+            raise ValueError(
+                f"--ld-r2-threshold must be in [0, 1], got "
+                f"{conf.ld_r2_threshold}"
+            )
+        if conf.ld_window_sites < 2:
+            raise ValueError(
+                f"--ld-window-sites must be >= 2, got {conf.ld_window_sites}"
+            )
+        return conf
+
+
+@dataclass
+class AssocConf(PcaConf):
+    """``assoc-scan`` flags: per-site case/control chi-square."""
+
+    phenotypes: Optional[str] = None
+    assoc_out: Optional[str] = None
+    assoc_top: int = 10
+
+    @classmethod
+    def parse(cls, argv: Sequence[str]) -> "AssocConf":
+        ns = build_assoc_parser().parse_args(list(argv))
+        return cls._from_namespace(ns)
+
+    @classmethod
+    def _from_namespace(cls, ns: argparse.Namespace) -> "AssocConf":
+        conf = super()._from_namespace(ns)
+        if conf.assoc_top < 1:
+            raise ValueError(
+                f"--assoc-top must be >= 1, got {conf.assoc_top}"
+            )
+        return conf
+
+
+__all__ = [
+    "AssocConf",
+    "GenomicsConf",
+    "GrmConf",
+    "LdConf",
+    "PcaConf",
+    "build_assoc_parser",
+    "build_grm_parser",
+    "build_ld_parser",
+    "build_pca_parser",
+]

@@ -26,6 +26,9 @@ Well-known metric names sampled (producers register them; see DESIGN.md §9):
   ``--num-reduce-partitions``-bounded shard progress and ETA
 - ``prefetch_queue_occupancy`` / ``prefetch_queue_depth`` (gauges)
 - ``gramian_inflight_dispatches`` (gauge)
+- ``analysis_sites_kept`` vs ``analysis_sites_tested`` (gauges,
+  ``analyses/`` pruning runs — the LD kept ratio advances per flushed
+  window)
 - ``gramian_ring_bytes`` (counter, sharded paths) — cumulative ICI ring
   traffic, the number ``--ring-pack-bits`` cuts 8×
 - ``host_peak_rss_bytes`` (function-backed gauge — each tick samples the
@@ -54,6 +57,8 @@ import time
 from typing import Callable, Optional
 
 from spark_examples_tpu.obs.metrics import (
+    ANALYSIS_SITES_KEPT,
+    ANALYSIS_SITES_TESTED,
     COMPILE_CACHE_GEOMETRY_HITS,
     COMPILE_CACHE_GEOMETRY_MISSES,
     GRAMIAN_INFLIGHT_DISPATCHES,
@@ -226,6 +231,18 @@ class Heartbeat:
         in_flight = self.registry.value(GRAMIAN_INFLIGHT_DISPATCHES)
         if in_flight is not None:
             parts.append(f"dispatch in-flight {int(in_flight)}")
+
+        # Per-site analysis progress (analyses/ LD prune): kept vs tested,
+        # advanced per flushed window. The tested count alone would repeat
+        # the sites-scanned segment, so the pair only appears once a
+        # pruning analysis registers its kept gauge.
+        kept = self.registry.value(ANALYSIS_SITES_KEPT)
+        if kept is not None and kept == kept:
+            tested = self.registry.value(ANALYSIS_SITES_TESTED)
+            if tested is not None and tested == tested:
+                parts.append(
+                    f"analysis kept {int(kept):,}/{int(tested):,} sites"
+                )
 
         ring_bytes = self.registry.value(GRAMIAN_RING_BYTES)
         if ring_bytes:

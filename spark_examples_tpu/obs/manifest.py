@@ -32,7 +32,14 @@ from), ``sites_skipped`` (ingest rows the resume fast-forward consumed
 without device work), ``faults_injected`` (deterministic faults fired
 in-process, ``utils/faults.py``); present exactly when Gramian
 checkpointing/resume was active (``--gramian-checkpoint-dir`` /
-``--resume-from``), null otherwise.
+``--resume-from``), null otherwise. Still v2 (additive): the optional
+``analysis`` block — ``{kind, sites_kept, sites_tested}`` — present on
+``analyses/`` runs (GRM/kinship, windowed LD pruning, association scan),
+so their manifests are self-describing next to PCA's: ``kind`` names the
+analysis, ``sites_tested`` the per-site rows it consumed, ``sites_kept``
+the surviving count for pruning analyses (null where keeping is not the
+analysis's question). Null on PCA runs, so existing consumers are
+untouched.
 
 Multi-host: under ``jax.distributed`` each process carries per-process
 I/O counters. :func:`build_run_manifest` aggregates them across processes
@@ -175,6 +182,7 @@ def build_manifest(
     host_memory: Optional[Dict] = None,
     gramian_exactness: Optional[Dict] = None,
     resume: Optional[Dict] = None,
+    analysis: Optional[Dict] = None,
 ) -> Dict:
     """Assemble a manifest from already-snapshotted parts (the low-level
     form; :func:`build_run_manifest` snapshots a live driver). The
@@ -182,7 +190,9 @@ def build_manifest(
     bound, so hand-assembled manifests stay schema-valid;
     ``gramian_exactness`` (v2-additive) stays null unless ``--check-ranges``
     sampling ran; ``resume`` (v2-additive) stays null unless Gramian
-    checkpointing/resume was active."""
+    checkpointing/resume was active; ``analysis`` (v2-additive) stays null
+    on PCA runs and carries ``{kind, sites_kept, sites_tested}`` on
+    ``analyses/`` runs."""
     return {
         "schema": {"id": MANIFEST_ID, "version": MANIFEST_VERSION},
         "created_unix": time.time(),
@@ -196,6 +206,7 @@ def build_manifest(
         ),
         "gramian_exactness": gramian_exactness,
         "resume": resume,
+        "analysis": analysis,
         "compile_cache": _compile_cache_block(),
         "process": _process_block(),
         "multihost": multihost,
@@ -203,14 +214,16 @@ def build_manifest(
 
 
 def build_run_manifest(conf=None, spans=None, registry=None, io_stats=None,
-                       overlap=None, resume=None) -> Dict:
+                       overlap=None, resume=None, analysis=None) -> Dict:
     """Snapshot a live run: ``conf`` (dataclass or mapping), a
     :class:`~spark_examples_tpu.obs.spans.SpanRecorder`, a
     :class:`~spark_examples_tpu.obs.metrics.MetricsRegistry`, the driver's
     ``VariantsDatasetStats`` (or ``None`` when stats are disabled), the
-    structured overlap dict from ``PrefetchIterator.overlap_stats()``, and
+    structured overlap dict from ``PrefetchIterator.overlap_stats()``,
     the checkpoint/resume accounting dict (``None`` when Gramian
-    checkpointing was not active)."""
+    checkpointing was not active), and the per-site analysis block
+    (``None`` on PCA runs; ``analyses/`` passes its
+    ``{kind, sites_kept, sites_tested}``)."""
     config = (
         dataclasses.asdict(conf)
         if dataclasses.is_dataclass(conf)
@@ -239,6 +252,7 @@ def build_run_manifest(conf=None, spans=None, registry=None, io_stats=None,
         host_memory=_host_memory_block(registry),
         gramian_exactness=_gramian_exactness_block(registry),
         resume=resume,
+        analysis=analysis,
     )
 
 
@@ -366,6 +380,31 @@ def validate_manifest(doc) -> List[str]:
                     errors.append(
                         f"resume.{field} missing or not a non-negative "
                         f"int: {value!r}"
+                    )
+
+    analysis = doc.get("analysis")
+    if analysis is not None:
+        if not isinstance(analysis, Mapping):
+            errors.append("'analysis' is neither null nor an object")
+        else:
+            kind = analysis.get("kind")
+            if not isinstance(kind, str) or not kind:
+                errors.append(
+                    f"analysis.kind missing or not a non-empty string: "
+                    f"{kind!r}"
+                )
+            for field in ("sites_kept", "sites_tested"):
+                value = analysis.get(field, "absent")
+                if value == "absent":
+                    errors.append(f"analysis.{field} missing")
+                elif value is not None and (
+                    not isinstance(value, int)
+                    or isinstance(value, bool)
+                    or value < 0
+                ):
+                    errors.append(
+                        f"analysis.{field} is neither null nor a "
+                        f"non-negative int: {value!r}"
                     )
 
     host_memory = doc.get("host_memory")

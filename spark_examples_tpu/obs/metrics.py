@@ -114,6 +114,14 @@ SERVE_JOBS_DONE = "serve_jobs_done"
 HOST_PEAK_RSS_BYTES = "host_peak_rss_bytes"
 HOST_STATIC_BOUND_BYTES = "host_static_bound_bytes"
 
+#: Per-site analysis progress (``analyses/``): sites a GRM/LD/assoc run
+#: has tested so far, and — for pruning analyses — how many survived. The
+#: manifest's ``analysis`` block snapshots the pair; the heartbeat samples
+#: them like any ingest gauge, so a whole-genome LD prune shows live
+#: kept/tested counts instead of hours of silence.
+ANALYSIS_SITES_TESTED = "analysis_sites_tested"
+ANALYSIS_SITES_KEPT = "analysis_sites_kept"
+
 _WELL_KNOWN_GAUGE_HELP = {
     INGEST_SITES_SCANNED: (
         "Candidate sites scanned so far (heartbeat progress)."
@@ -184,6 +192,14 @@ _WELL_KNOWN_GAUGE_HELP = {
         "Ingest cursor (rows of the deterministic stream) covered by the "
         "newest published Gramian checkpoint — what a preemption would "
         "resume from."
+    ),
+    ANALYSIS_SITES_TESTED: (
+        "Sites this per-site analysis (analyses/: GRM, LD prune, assoc "
+        "scan) has tested so far."
+    ),
+    ANALYSIS_SITES_KEPT: (
+        "Sites the pruning analysis has kept so far (LD kept-mask "
+        "cardinality; equals tested for non-pruning analyses)."
     ),
 }
 

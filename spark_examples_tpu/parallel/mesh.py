@@ -124,6 +124,8 @@ def host_peak_bytes(
     prefetch_depth: int = 0,
     pipeline_depth: int = 0,
     host_accumulator: bool = False,
+    grm_finalize: bool = False,
+    ld_window_sites: int = 0,
     baseline_bytes: int = HOST_RUNTIME_BASELINE_BYTES,
 ) -> int:
     """Closed-form peak host-memory bound of one bounded-ingest run — the
@@ -152,6 +154,19 @@ def host_peak_bytes(
       while their transfers overlap compute (``ops/gramian.py``).
     - **host accumulator** — the ``--pca-backend host`` oracle's int64
       N x N matrix (+ its f64 centering copy), zero on the device path.
+    - **GRM finalize** — ``21 * N * N``: the kinship close-out
+      (``analyses/grm.py:grm_finalize`` + its summary) holds the fetched
+      f32 Gramian (4 N²), EITHER the int64 working copy OR the summary's
+      off-diagonal float64 extraction (8 N² — they never overlap), the
+      float64 kinship itself (8 N²), and the off-diagonal bool mask
+      (1 N²) simultaneously on host; zero for every other analysis.
+    - **LD window** — ``56 * W² + W * N``: each flush fetches the W×W
+      int32 co-carrier matrix and closes r² on host
+      (``ops/ld.py:r2_from_counts`` holds up to seven 8-byte W×W working
+      matrices — the int64 copy, cov, the variance outer product, the
+      squared numerator and its cast temp, the r² result — next to the
+      fetched int32 stats; 56 W² bounds the lot) plus the (W, N) uint8
+      window buffer; zero when the run has no LD window.
     - **baseline** — :data:`HOST_RUNTIME_BASELINE_BYTES`.
     """
     n = int(num_samples)
@@ -161,6 +176,9 @@ def host_peak_bytes(
     prefetch = int(prefetch_depth) * block_bytes
     flush_copies = (1 + int(pipeline_depth)) * staging
     host_matrix = 2 * n * n * 8 if host_accumulator else 0
+    grm_term = 21 * n * n if grm_finalize else 0
+    w = int(ld_window_sites)
+    ld_term = 56 * w * w + w * n if w > 0 else 0
     return int(
         baseline_bytes
         + parse_window
@@ -168,6 +186,8 @@ def host_peak_bytes(
         + staging
         + flush_copies
         + host_matrix
+        + grm_term
+        + ld_term
     )
 
 
@@ -393,6 +413,21 @@ def parse_mesh_shape(spec: str) -> Dict[str, int]:
     return {DATA_AXIS: parts[0], SAMPLES_AXIS: parts[1]}
 
 
+def resolve_run_mesh(
+    mesh_shape: Optional[str] = None,
+    num_reduce_partitions: Optional[int] = None,
+):
+    """The ONE run-mesh resolution rule (explicit ``--mesh-shape``, else
+    all devices capped by ``--num-reduce-partitions``; ``None`` on one
+    device) — shared by the PCA driver and the analyses so a change to
+    the rule can never leave them resolving different meshes."""
+    if mesh_shape:
+        return make_mesh(parse_mesh_shape(mesh_shape))
+    if len(jax.devices()) == 1:
+        return None
+    return default_mesh(num_reduce_partitions=num_reduce_partitions)
+
+
 __all__ = [
     "DATA_AXIS",
     "SAMPLES_AXIS",
@@ -410,4 +445,5 @@ __all__ = [
     "make_mesh",
     "default_mesh",
     "parse_mesh_shape",
+    "resolve_run_mesh",
 ]

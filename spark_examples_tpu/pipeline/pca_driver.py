@@ -41,16 +41,12 @@ from spark_examples_tpu.ops.pca import (
     mllib_reference_pca,
     principal_components_subspace,
 )
-from spark_examples_tpu.parallel.mesh import (
-    SAMPLES_AXIS,
-    default_mesh,
-    make_mesh,
-    parse_mesh_shape,
-)
+from spark_examples_tpu.parallel.mesh import SAMPLES_AXIS, resolve_run_mesh
 from spark_examples_tpu.pipeline.checkpoint import load_variants
 from spark_examples_tpu.pipeline.datasets import VariantsDataset, _parallel_shards
 from spark_examples_tpu.pipeline.stats import VariantsDatasetStats
 from spark_examples_tpu.sharding.partitioners import VariantsPartitioner
+from spark_examples_tpu.sources import partition_page_requests
 from spark_examples_tpu.sources.base import GenomicsSource
 from spark_examples_tpu.sources.files import FileGenomicsSource, af_float
 from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource
@@ -419,13 +415,9 @@ class VariantsPcaDriver:
     # ------------------------------------------------------------ similarity
 
     def _make_mesh(self):
-        import jax
-
-        if self.conf.mesh_shape:
-            return make_mesh(parse_mesh_shape(self.conf.mesh_shape))
-        if len(jax.devices()) == 1:
-            return None
-        return default_mesh(num_reduce_partitions=self.conf.num_reduce_partitions)
+        return resolve_run_mesh(
+            self.conf.mesh_shape, self.conf.num_reduce_partitions
+        )
 
     def _resolve_sharded(self, sharded: Optional[bool], mesh) -> bool:
         """``--similarity-strategy``: explicit dense/sharded, or auto from
@@ -1406,13 +1398,11 @@ def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
             for index, part in enumerate(partitions):
                 if driver.io_stats is not None:
                     driver.io_stats.add_partition(part.range)
-                    # Wire-equivalent page accounting (shared helpers).
+                    # Wire-equivalent page accounting (shared helper —
+                    # the same rule analyses/base.py streams under).
                     driver.io_stats.add_requests(
-                        source.page_requests(
-                            part.contig, conf.bases_per_partition
-                        )
-                        if synthetic
-                        else source.page_requests(
+                        partition_page_requests(
+                            source,
                             part.variant_set_id,
                             part.contig,
                             conf.bases_per_partition,

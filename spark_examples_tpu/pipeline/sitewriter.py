@@ -1,0 +1,97 @@
+"""Bounded per-site output writer — the M-sized spill path of ``analyses/``.
+
+The PCA pipeline's outputs are O(N) (PC rows) and were emitted from memory;
+the population-genetics analyses emit one row PER SITE — O(M), up to ~40M
+rows for a whole genome (``ops/contracts.py:DECLARED_MAX_SITES``) — so an
+in-memory list of result rows would be exactly the O(file) staging shape
+``graftcheck hostmem`` exists to forbid. This writer is the shared bounded
+alternative:
+
+- rows are appended WINDOW BY WINDOW as the analysis streams (one
+  ``write_rows`` call per genotype block / LD window), formatted and
+  written straight into a buffered file handle — peak host memory is
+  O(window), never O(M);
+- the output is published ATOMICALLY: rows land in ``<path>.<pid>.tmp``
+  and one ``os.replace`` at :meth:`close` makes the finished file appear —
+  a killed run leaves a ``.tmp`` orphan, never a truncated file that looks
+  complete (the same contract as ``obs/manifest.py:write_manifest``);
+- accounting rides the owning run's metrics registry (``sites_written``
+  count exposed for the manifest's ``analysis`` block), never ad-hoc
+  attribute mutation.
+
+Column layout is the caller's: the writer takes a header tuple once and
+pre-formatted row tuples after, so GRM/LD/assoc share one spill mechanism
+without sharing a schema.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Optional, Sequence, Tuple
+
+
+class SiteOutputWriter:
+    """One streaming TSV output file with atomic publish.
+
+    Usage::
+
+        writer = SiteOutputWriter(path, header=("contig", "pos", "kept"))
+        for window in ...:
+            writer.write_rows((c, p, int(k)) for c, p, k in window_rows)
+        writer.close()   # atomic rename; the file now exists
+    """
+
+    def __init__(self, path: str, header: Sequence[str]):
+        self.path = str(path)
+        self.rows_written = 0
+        self._closed = False
+        directory = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(directory, exist_ok=True)
+        self._tmp = f"{self.path}.{os.getpid()}.tmp"
+        self._f = open(self._tmp, "w", encoding="utf-8")
+        self._f.write("\t".join(str(h) for h in header) + "\n")
+
+    def write_rows(self, rows: Iterable[Tuple]) -> int:
+        """Append one window's rows (any iterable of field tuples); returns
+        the row count written. Rows stream straight through the buffered
+        handle — nothing is retained."""
+        if self._closed:
+            raise ValueError(f"writer for {self.path} is closed")
+        n = 0
+        for row in rows:
+            self._f.write("\t".join(str(field) for field in row) + "\n")
+            n += 1
+        self.rows_written += n
+        return n
+
+    def close(self) -> None:
+        """Flush and atomically publish the finished file (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._f.close()
+        os.replace(self._tmp, self.path)
+
+    def abort(self) -> None:
+        """Discard the temp file without publishing (error paths): the
+        output either exists complete or not at all."""
+        if self._closed:
+            return
+        self._closed = True
+        self._f.close()
+        try:
+            os.remove(self._tmp)
+        except OSError:
+            pass
+
+    def __enter__(self) -> "SiteOutputWriter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.close()
+        else:
+            self.abort()
+
+
+__all__ = ["SiteOutputWriter"]

@@ -28,6 +28,8 @@ import urllib.request
 from typing import Callable, Dict, Optional, Sequence, Tuple
 
 from spark_examples_tpu.serve.protocol import (
+    JOB_KINDS,
+    RESERVED_KINDS,
     TERMINAL_STATUSES,
     request_doc,
 )
@@ -35,6 +37,12 @@ from spark_examples_tpu.utils.retry import (
     full_jitter_delay,
     retry_after_seconds,
 )
+
+#: The submit verb's ``--kind`` choices, sourced from the protocol's own
+#: tables (never a drifted copy). Reserved kinds pass argparse on purpose:
+#: the server's structured ``reserved-kind`` 400 is the answer the user
+#: should see, not an argparse usage error.
+SUBMIT_KIND_CHOICES = tuple(JOB_KINDS) + tuple(RESERVED_KINDS)
 
 #: Hard cap on response bodies (bounded read — a misbehaving server must
 #: not stage unbounded bytes in client memory).
@@ -229,7 +237,7 @@ def submit_main(argv: Optional[Sequence[str]] = None) -> int:
         "--url", required=True, help="Service base URL (see serve --port)."
     )
     parser.add_argument(
-        "--kind", choices=["pca", "similarity"], default="pca"
+        "--kind", choices=list(SUBMIT_KIND_CHOICES), default="pca"
     )
     parser.add_argument("--deadline-seconds", type=float, default=None)
     parser.add_argument("--tag", default=None)

@@ -102,20 +102,26 @@ _RESERVED_FLAG_FIELDS = (
     ("fault_plan", "--fault-plan"),
     ("gramian_checkpoint_dir", "--gramian-checkpoint-dir"),
     ("resume_from", "--resume-from"),
+    # The analyses' per-site output paths are daemon-host write primitives
+    # too; a served grm job returns the kinship SUMMARY, never a
+    # client-placed matrix file.
+    ("grm_out", "--grm-out"),
 )
 
 
-def _parse_job_flags(flags):
-    """Parse a request's flag list through the REAL PCA parser (never a
-    drifted copy); argparse errors raise ``ValueError``."""
-    from spark_examples_tpu.check.plan import _RaisingParser
-    from spark_examples_tpu.config import PcaConf, build_pca_parser
+def _parse_job_flags(flags, kind: str = "pca"):
+    """Parse a request's flag list through the REAL parser of the job's
+    kind (``check/plan.py:ANALYSIS_SURFACES`` — never a drifted copy;
+    ``pca``/``similarity`` share the PCA surface, ``grm`` parses the grm
+    verb's); argparse errors raise ``ValueError``."""
+    from spark_examples_tpu.check.plan import ANALYSIS_SURFACES, _RaisingParser
 
-    parser = build_pca_parser(
-        _RaisingParser(prog="serve-job", add_help=False)
-    )
+    build_parser, conf_cls = ANALYSIS_SURFACES[
+        kind if kind in ANALYSIS_SURFACES else "pca"
+    ]
+    parser = build_parser(_RaisingParser(prog="serve-job", add_help=False))
     ns = parser.parse_args(list(flags))
-    return PcaConf._from_namespace(ns)
+    return conf_cls._from_namespace(ns)
 
 
 class PcaService:
@@ -350,7 +356,7 @@ class PcaService:
             self._rejected.labels(code=e.code).inc()
             return 400, error_doc(e.code, e.message)
         try:
-            conf = _parse_job_flags(request.flags)
+            conf = _parse_job_flags(request.flags, kind=request.kind)
         except ValueError as e:
             self._rejected.labels(code="flag-grammar").inc()
             return 400, error_doc("flag-grammar", str(e))
@@ -376,6 +382,10 @@ class PcaService:
             conf,
             plan_devices=self.device_count,
             host_mem_budget=self.host_mem_budget,
+            # The grm kind admits through the analysis's own plan entry
+            # (the analyses admission gate + Gramian proofs); pca and
+            # similarity keep the default PCA surface.
+            analysis="grm" if request.kind == "grm" else "pca",
         )
         plan_block = {
             "ok": report.ok,

@@ -3,7 +3,10 @@
 ``pipeline.pca_driver.run_pipeline`` is the library entry point the
 batch CLI and this executor share — a served job executes the IDENTICAL
 pipeline a batch invocation would, and produces the identical schema-v2
-run manifest. The executor's additions are service concerns only:
+run manifest. The ``grm`` kind dispatches the same way to the analysis
+core (``analyses/grm.py:run_grm_pipeline``), returning the kinship
+summary with the per-job manifest carrying the ``analysis`` block. The
+executor's additions are service concerns only:
 
 - **per-job manifest placement**: every job's manifest is written to
   ``<run_dir>/jobs/<job_id>/manifest.json`` (atomic rename, validated
@@ -102,29 +105,45 @@ def execute_job(job: Job, run_dir: str) -> ExecutionOutcome:
             previous, threading.get_ident(), captured
         )
         try:
-            pipeline = run_pipeline(conf, similarity_only=similarity_only)
+            if job.request.kind == "grm":
+                # The analyses dispatch: the IDENTICAL analysis core the
+                # batch `grm` verb runs (its finish_analysis_run writes
+                # the same schema-v2 manifest to the per-job path and
+                # records the kind-keyed warm-ledger geometry).
+                from spark_examples_tpu.analyses.grm import run_grm_pipeline
+
+                grm = run_grm_pipeline(conf)
+                result: Dict = {"grm": grm.summary}
+                manifest_doc = grm.manifest
+                manifest_path = grm.manifest_path
+            else:
+                pipeline = run_pipeline(
+                    conf, similarity_only=similarity_only
+                )
+                if similarity_only:
+                    result = {"similarity": pipeline.similarity_summary}
+                else:
+                    result = {"pc_lines": pipeline.lines}
+                manifest_doc = pipeline.manifest
+                manifest_path = pipeline.manifest_path
         finally:
             sys.stdout = previous
 
-    if pipeline.manifest_path is None:
+    if manifest_path is None:
         raise RuntimeError(
             f"job {job.id} completed but its manifest was not written "
             f"(expected {conf.metrics_json})"
         )
-    errors = validate_manifest(pipeline.manifest)
+    errors = validate_manifest(manifest_doc)
     if errors:
         raise RuntimeError(
             f"job {job.id} produced an invalid run manifest: "
             + "; ".join(errors)
         )
 
-    if similarity_only:
-        result: Dict = {"similarity": pipeline.similarity_summary}
-    else:
-        result = {"pc_lines": pipeline.lines}
     return ExecutionOutcome(
         result=result,
-        manifest_path=pipeline.manifest_path,
+        manifest_path=manifest_path,
         compile_cache="warm" if warm else "cold",
     )
 

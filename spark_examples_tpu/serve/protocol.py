@@ -21,7 +21,15 @@ Request document (``POST /v1/jobs``)::
 ``kind`` selects the result surface: ``pca`` returns the emitted PC rows,
 ``similarity`` stops after the ingest+similarity stage and returns a
 host-side summary of the Gramian (shape, nonzero rows, trace). Both ride
-the identical pipeline (``pipeline.pca_driver.run_pipeline``).
+the identical pipeline (``pipeline.pca_driver.run_pipeline``). ``grm``
+runs the GRM/kinship analysis (``analyses/grm.py:run_grm_pipeline`` —
+the identical analysis the batch ``grm`` verb runs) and returns the
+kinship summary (shape, sites, trace, diagonal/off-diagonal means; the
+N×N matrix itself never rides a response). The other per-site analyses
+(``ld``, ``assoc``) are RESERVED kinds: recognized, rejected with
+``reserved-kind`` — batch-only until their M-sized output spill gets a
+served placement story — so a future server that serves them is a
+protocol version bump, not a silent behavior change.
 
 Versioning contract: a request whose ``protocol.version`` differs from
 :data:`PROTOCOL_VERSION` is rejected with ``unsupported-protocol-version``
@@ -38,8 +46,19 @@ from typing import Dict, Mapping, Optional, Sequence, Tuple
 PROTOCOL_ID = "spark-examples-tpu/serve"
 PROTOCOL_VERSION = 1
 
-#: Request kinds and the result surface each returns.
-JOB_KINDS = ("pca", "similarity")
+#: Request kinds and the result surface each returns. ``grm`` joined when
+#: the analyses subsystem landed (its flags parse through the REAL
+#: ``config.build_grm_parser``, its admission plan runs with
+#: ``analysis="grm"``, and its warm-ledger fingerprint is kind-keyed so a
+#: GRM run never pre-warms the PCA geometry).
+JOB_KINDS = ("pca", "similarity", "grm")
+
+#: Analysis kinds that exist as batch CLI verbs but are NOT served yet:
+#: their outputs are per-site (M-sized) files, and a served job has no
+#: client-visible placement for an O(M) artifact until the result-surface
+#: story lands. Requests naming them get ``reserved-kind`` (HTTP 400) —
+#: a deliberate, tested rejection distinct from an unknown kind.
+RESERVED_KINDS = ("ld", "assoc")
 
 #: Terminal job states (``GET /v1/jobs/<id>`` polling stops here).
 TERMINAL_STATUSES = ("done", "failed", "cancelled")
@@ -123,6 +142,12 @@ def parse_request(doc) -> JobRequest:
             f"(this server speaks version {PROTOCOL_VERSION})",
         )
     kind = doc.get("kind")
+    if kind in RESERVED_KINDS:
+        raise ProtocolError(
+            "reserved-kind",
+            f"kind {kind!r} is a batch-only analysis for now (run the "
+            f"CLI verb); served kinds are {list(JOB_KINDS)}",
+        )
     if kind not in JOB_KINDS:
         raise ProtocolError(
             "unknown-kind",
@@ -225,6 +250,7 @@ __all__ = [
     "PROTOCOL_ID",
     "PROTOCOL_VERSION",
     "JOB_KINDS",
+    "RESERVED_KINDS",
     "TERMINAL_STATUSES",
     "ProtocolError",
     "JobRequest",

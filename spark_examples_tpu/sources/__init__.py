@@ -8,6 +8,20 @@ from spark_examples_tpu.sources.base import (
 )
 from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource
 
+
+def partition_page_requests(
+    source, variant_set_id, contig, bases_per_partition: int
+) -> int:
+    """Wire-equivalent page-request count for ONE shard of one variant
+    set. The synthetic source's ``page_requests`` takes no set id (one
+    synthetic wire serves every set); file/REST sources take it — this is
+    the ONE home of that branch, shared by the PCA driver's and the
+    analyses' ingest accounting so the two can never drift."""
+    if isinstance(source, SyntheticGenomicsSource):
+        return source.page_requests(contig, bases_per_partition)
+    return source.page_requests(variant_set_id, contig, bases_per_partition)
+
+
 __all__ = [
     "ClientCounters",
     "GenomicsClient",
@@ -15,5 +29,6 @@ __all__ = [
     "OfflineAuth",
     "ShardBoundary",
     "get_access_token",
+    "partition_page_requests",
     "SyntheticGenomicsSource",
 ]

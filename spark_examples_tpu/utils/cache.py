@@ -75,6 +75,12 @@ _NON_GEOMETRY_FIELDS = frozenset(
         "checkpoint_every_sites",
         "resume_from",
         "fault_plan",
+        # The analyses' output placements: pure artifact paths, no effect
+        # on compiled programs — the fingerprint stays placement-invariant
+        # (same contract as output_path/metrics_json above).
+        "grm_out",
+        "ld_out",
+        "assoc_out",
     }
 )
 

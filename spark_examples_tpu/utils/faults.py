@@ -78,6 +78,12 @@ KILL_POINTS: Dict[str, str] = {
         "serve/daemon.py:_run_job — device work marked begun, executor "
         "about to run (a crash here must NOT be requeued)"
     ),
+    "analysis.pre-manifest": (
+        "analyses/base.py:finish_analysis_run — every site streamed and "
+        "every per-site output published, before the warm-ledger record "
+        "and the manifest write (a kill here must leave the atomic "
+        "outputs complete and the manifest absent, never half-written)"
+    ),
 }
 
 #: Registered IO-boundary fault sites.

@@ -1,0 +1,974 @@
+"""The population-genetics analyses (``analyses/``: GRM, LD prune, assoc
+scan) against NumPy oracles, plus their plan/serve/manifest integration.
+
+The oracle discipline mirrors the Gramian tests: every device path must
+match a host recomputation EXACTLY (the analyses' statistics are integer
+moments closed in float64, so parity is equality, not tolerance)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.analyses.assoc import (
+    AssocResult,
+    case_vector,
+    chi2_from_counts,
+    load_phenotypes,
+    run_assoc_pipeline,
+)
+from spark_examples_tpu.analyses.base import (
+    ANALYSIS_KINDS,
+    analysis_conf_violations,
+    check_analysis_conf,
+)
+from spark_examples_tpu.analyses.grm import (
+    GrmMoments,
+    format_grm_rows,
+    grm_finalize,
+    grm_reference,
+    run_grm_pipeline,
+)
+from spark_examples_tpu.analyses.ld import ld_prune_reference, run_ld_pipeline
+from spark_examples_tpu.config import AssocConf, GrmConf, LdConf
+from spark_examples_tpu.ops.ld import (
+    build_case_counts,
+    build_ld_window_stats,
+    case_counts_reference,
+    greedy_prune,
+    ld_window_stats_reference,
+    r2_from_counts,
+)
+from spark_examples_tpu.pipeline.sitewriter import SiteOutputWriter
+from spark_examples_tpu.utils.af import (
+    carrier_counts,
+    variance_counts,
+)
+
+REFS = "1:0:30000"
+
+
+def _rand_rows(rng, m, n):
+    """A random has-variation block with no all-zero rows (the sources
+    drop them before the analyses ever see one)."""
+    rows = (rng.random((m, n)) < 0.4).astype(np.uint8)
+    rows[rows.sum(axis=1) == 0, 0] = 1
+    return rows
+
+
+def _grm_conf(*extra):
+    return GrmConf.parse(
+        ["--num-samples", "8", "--references", REFS, *extra]
+    )
+
+
+def _stream_rows(conf):
+    """Every has-variation block of the conf's synthetic stream, in
+    contig order — the analyses' exact input, recomputed independently."""
+    from spark_examples_tpu.pipeline.pca_driver import make_source
+
+    src = make_source(conf)
+    return [
+        block["has_variation"]
+        for contig in conf.get_contigs(src, conf.variant_set_id)
+        for block in src.genotype_blocks(
+            conf.variant_set_id[0],
+            contig,
+            block_size=conf.block_size,
+            min_allele_frequency=conf.min_allele_frequency,
+        )
+    ]
+
+
+def _cohort_names(conf):
+    from spark_examples_tpu.pipeline.pca_driver import make_source
+
+    return [
+        cs["name"]
+        for cs in make_source(conf).search_callsets(conf.variant_set_id)
+    ]
+
+
+# --------------------------------------------------------------- utils/af
+
+
+class TestAfHelpers:
+    def test_carrier_counts_ragged_tail(self):
+        rng = np.random.default_rng(0)
+        for m in (1, 3, 17):  # ragged block sizes need no special casing
+            rows = _rand_rows(rng, m, 6)
+            k = carrier_counts(rows)
+            assert k.dtype == np.int64
+            assert k.tolist() == rows.sum(axis=1).tolist()
+
+    def test_carrier_counts_rejects_non_block(self):
+        with pytest.raises(ValueError, match=r"\(B, N\) block"):
+            carrier_counts(np.zeros(4, dtype=np.uint8))
+
+    def test_variance_counts_out_of_contract_rejects(self):
+        # Count-valued join rows leaking into a {0,1} path fail loudly:
+        # the implied frequency k/n would leave the AF [0, 1] contract.
+        with pytest.raises(ValueError, match="outside"):
+            variance_counts(np.array([7]), 6)
+        with pytest.raises(ValueError, match="outside"):
+            variance_counts(np.array([-1]), 6)
+        with pytest.raises(ValueError, match="num_samples"):
+            variance_counts(np.array([1]), 0)
+
+    def test_monomorphic_zero_variance_guard(self):
+        # k == 0 and k == n are exactly zero variance — the denominator
+        # every consumer divides by is exactly 0 (guarded), never NaN:
+        # GRM raises on C == 0, LD's r² treats zero-variance pairs as 0.
+        counts = np.array([0, 4, 8])
+        var = variance_counts(counts, 8)
+        assert var.tolist() == [0, 16, 0]
+        r2 = r2_from_counts(
+            np.zeros((3, 3), dtype=np.int64), counts, 8
+        )
+        assert np.isfinite(r2).all()
+
+    def test_variance_counts_is_exact_int(self):
+        assert variance_counts(np.array([3]), 7).dtype == np.int64
+
+
+# ---------------------------------------------------------- sitewriter
+
+
+class TestSiteOutputWriter:
+    def test_atomic_publish(self, tmp_path):
+        path = str(tmp_path / "out.tsv")
+        writer = SiteOutputWriter(path, header=("a", "b"))
+        writer.write_rows([(1, 2), (3, 4)])
+        assert not os.path.exists(path)  # nothing visible until close
+        writer.close()
+        assert open(path).read() == "a\tb\n1\t2\n3\t4\n"
+        assert writer.rows_written == 2
+        writer.close()  # idempotent
+
+    def test_abort_leaves_nothing(self, tmp_path):
+        path = str(tmp_path / "out.tsv")
+        writer = SiteOutputWriter(path, header=("a",))
+        writer.write_rows([(1,)])
+        writer.abort()
+        assert not os.path.exists(path)
+        assert not list(tmp_path.iterdir())
+
+    def test_context_manager_error_aborts(self, tmp_path):
+        path = str(tmp_path / "out.tsv")
+        with pytest.raises(RuntimeError):
+            with SiteOutputWriter(path, header=("a",)) as writer:
+                writer.write_rows([(1,)])
+                raise RuntimeError("boom")
+        assert not os.path.exists(path)
+
+    def test_closed_writer_rejects_rows(self, tmp_path):
+        writer = SiteOutputWriter(str(tmp_path / "x.tsv"), header=("a",))
+        writer.close()
+        with pytest.raises(ValueError, match="closed"):
+            writer.write_rows([(1,)])
+
+
+# ------------------------------------------------------- shared admission
+
+
+class TestAnalysisConf:
+    @pytest.mark.parametrize(
+        "extra, code",
+        [
+            (
+                ["--num-samples", "8,8", "--variant-set-id", "a,b"],
+                "analysis-variant-sets",
+            ),
+            (["--save-variants", "x"], "analysis-save-variants"),
+            (["--input-path", "x"], "analysis-input-path"),
+            (
+                ["--gramian-checkpoint-dir", "x"],
+                "analysis-checkpoint",
+            ),
+            (["--ingest", "wire"], "analysis-ingest"),
+            (["--stream-chunk-bytes", "1024"], "analysis-streaming"),
+        ],
+    )
+    def test_violations_catalogue(self, extra, code):
+        conf = _grm_conf(*extra)
+        codes = [c for c, _ in analysis_conf_violations(conf, "grm")]
+        assert code in codes
+        with pytest.raises(ValueError):
+            check_analysis_conf(conf, "grm")
+
+    def test_clean_conf_passes_every_kind(self):
+        conf = _grm_conf()
+        for kind in ANALYSIS_KINDS:
+            assert analysis_conf_violations(conf, kind) == []
+        with pytest.raises(ValueError, match="unknown analysis kind"):
+            check_analysis_conf(conf, "nope")
+
+
+# ------------------------------------------------------------------- GRM
+
+
+class TestGrm:
+    def test_moments_blocked_equals_single_pass(self):
+        rng = np.random.default_rng(1)
+        X = _rand_rows(rng, 50, 8)
+        blocked = GrmMoments(8)
+        for start in range(0, 50, 17):  # ragged tail: 17 + 17 + 16
+            blocked.add_block(X[start : start + 17])
+        whole = GrmMoments(8)
+        whole.add_block(X)
+        assert np.array_equal(blocked.U, whole.U)
+        assert (blocked.S2, blocked.C, blocked.sites) == (
+            whole.S2,
+            whole.C,
+            whole.sites,
+        )
+        assert np.array_equal(
+            grm_finalize(X.T.astype(np.int64) @ X, blocked),
+            grm_reference(X, 8),
+        )
+
+    def test_finalize_matches_direct_vanraden(self):
+        # The expanded integer formula == the textbook centered form.
+        rng = np.random.default_rng(2)
+        X = _rand_rows(rng, 40, 6).astype(np.float64)
+        p = X.mean(axis=1, keepdims=True)
+        direct = (X - p).T @ (X - p) / (p.squeeze() * (1 - p.squeeze())).sum()
+        oracle = grm_reference(X.astype(np.int64), 6)
+        np.testing.assert_allclose(oracle, direct, rtol=1e-12)
+
+    def test_finalize_all_monomorphic_raises(self):
+        moments = GrmMoments(4)
+        moments.add_block(np.ones((3, 4), dtype=np.uint8))
+        with pytest.raises(ValueError, match="monomorphic"):
+            grm_finalize(np.full((4, 4), 3, dtype=np.int64), moments)
+
+    def test_pipeline_matches_oracle_exactly(self, tmp_path):
+        out = str(tmp_path / "kin.tsv")
+        manifest = str(tmp_path / "m.json")
+        conf = _grm_conf(
+            "--grm-out", out, "--metrics-json", manifest
+        )
+        result = run_grm_pipeline(conf)
+        X = np.concatenate(_stream_rows(conf))
+        oracle = grm_reference(X, 8)
+        assert np.array_equal(result.matrix, oracle)  # byte-identical
+        names = _cohort_names(conf)
+        assert result.sample_names == names
+        expected = ["\t".join(["name", *names])] + [
+            "\t".join(str(f) for f in row)
+            for row in format_grm_rows(names, oracle)
+        ]
+        assert open(out).read().splitlines() == expected
+        assert result.manifest_path == manifest
+        assert result.manifest["analysis"] == {
+            "kind": "grm",
+            "sites_kept": None,
+            "sites_tested": len(X),
+        }
+        from spark_examples_tpu.obs.manifest import validate_manifest
+
+        assert validate_manifest(result.manifest) == []
+
+    def test_host_backend_parity(self):
+        tpu = run_grm_pipeline(_grm_conf())
+        host = run_grm_pipeline(_grm_conf("--pca-backend", "host"))
+        assert np.array_equal(tpu.matrix, host.matrix)
+
+    @pytest.mark.parametrize("pack", ["on", "off"])
+    def test_sharded_ring_parity(self, pack):
+        # 16 columns over a 4-wide samples axis: the packed ring pads the
+        # cohort to 32 (pack-width invariant); the GRM trims back to 16
+        # and must equal the dense oracle EXACTLY in both wire formats.
+        conf = GrmConf.parse(
+            [
+                "--num-samples", "16",
+                "--references", REFS,
+                "--mesh-shape", "1,4",
+                "--similarity-strategy", "sharded",
+                "--block-size", "32",
+                "--ring-pack-bits", pack,
+            ]
+        )
+        result = run_grm_pipeline(conf)
+        X = np.concatenate(_stream_rows(conf))
+        assert np.array_equal(result.matrix, grm_reference(X, 16))
+
+
+# -------------------------------------------------------------------- LD
+
+
+class TestLdKernels:
+    def test_window_stats_matches_reference(self):
+        rng = np.random.default_rng(3)
+        rows = _rand_rows(rng, 24, 8)
+        C_ref, k_ref = ld_window_stats_reference(rows)
+        C, k = build_ld_window_stats(None)(rows)
+        assert np.array_equal(np.asarray(C), C_ref)
+        assert np.array_equal(np.asarray(k), k_ref)
+
+    def test_window_stats_sharded_matches_reference(self):
+        import jax
+
+        from spark_examples_tpu.parallel.mesh import make_mesh
+
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 devices for a samples axis")
+        mesh = make_mesh({"data": 1, "samples": 4})
+        rng = np.random.default_rng(4)
+        rows = _rand_rows(rng, 16, 8)
+        C_ref, k_ref = ld_window_stats_reference(rows)
+        C, k = build_ld_window_stats(mesh)(rows)
+        assert np.array_equal(np.asarray(C), C_ref)
+        assert np.array_equal(np.asarray(k), k_ref)
+
+    def test_r2_self_correlation_and_guard(self):
+        rows = np.array(
+            [
+                [1, 0, 1, 0],  # polymorphic
+                [1, 0, 1, 0],  # identical -> r2 1 with row 0
+                [0, 1, 0, 1],  # complement -> also r2 1
+                [1, 1, 1, 1],  # monomorphic (k == n) -> guard: r2 0
+            ],
+            dtype=np.uint8,
+        )
+        C, k = ld_window_stats_reference(rows)
+        r2 = r2_from_counts(C, k, 4)
+        assert np.isfinite(r2).all()
+        assert r2[0, 0] == 1.0 and r2[0, 1] == 1.0 and r2[0, 2] == 1.0
+        assert (r2[3] == 0).all() and (r2[:, 3] == 0).all()
+
+    def test_greedy_prune_order_threshold_and_mask(self):
+        rows = np.array(
+            [
+                [1, 0, 1, 0],
+                [1, 0, 1, 0],  # duplicate of 0 -> pruned at any threshold
+                [1, 1, 0, 0],  # r2 vs row 0 is (4*1-2*2)^2/... = 0 -> kept
+            ],
+            dtype=np.uint8,
+        )
+        C, k = ld_window_stats_reference(rows)
+        kept = greedy_prune(C, k, 4, 0.2)
+        assert kept.tolist() == [True, False, True]
+        # Prune is STRICTLY above: r2 == threshold survives.
+        assert greedy_prune(C, k, 4, 1.0).tolist() == [True, True, True]
+        # Padding rows are never kept and never pruned against.
+        valid = np.array([True, False, True])
+        kept = greedy_prune(C, k, 4, 0.2, valid=valid)
+        assert kept.tolist() == [True, False, True]
+
+
+class TestLdPipeline:
+    def _conf(self, tmp_path, *extra):
+        return LdConf.parse(
+            [
+                "--num-samples", "8",
+                "--references", "1:0:20000,2:0:20000",
+                "--ld-window-sites", "32",
+                "--ld-out", str(tmp_path / "kept.tsv"),
+                "--metrics-json", str(tmp_path / "m.json"),
+                *extra,
+            ]
+        )
+
+    def test_matches_windowed_oracle(self, tmp_path):
+        conf = self._conf(tmp_path)
+        result = run_ld_pipeline(conf)
+        from spark_examples_tpu.pipeline.pca_driver import make_source
+
+        src = make_source(conf)
+        expected = ["contig\tpos\tkept"]
+        kept_total = 0
+        for contig in conf.get_contigs(src, conf.variant_set_id):
+            blocks = [
+                (block["positions"], block["has_variation"])
+                for block in src.genotype_blocks(
+                    conf.variant_set_id[0],
+                    contig,
+                    block_size=conf.block_size,
+                    min_allele_frequency=conf.min_allele_frequency,
+                )
+            ]
+            positions = np.concatenate([p for p, _ in blocks])
+            hv = np.concatenate([h for _, h in blocks])
+            W = conf.ld_window_sites
+            windows = [
+                (positions[i : i + W], hv[i : i + W])
+                for i in range(0, len(positions), W)
+            ]
+            for pos, kept in ld_prune_reference(
+                windows, conf.num_samples, conf.ld_r2_threshold
+            ):
+                expected.append(
+                    f"{contig.reference_name}\t{pos}\t{int(kept)}"
+                )
+                kept_total += int(kept)
+        assert open(conf.ld_out).read().splitlines() == expected
+        assert result.sites_kept == kept_total
+        assert result.sites_tested == len(expected) - 1
+        assert result.manifest["analysis"] == {
+            "kind": "ld",
+            "sites_kept": kept_total,
+            "sites_tested": result.sites_tested,
+        }
+
+    def test_threshold_extremes(self, tmp_path):
+        # Threshold 1.0 keeps everything but exact duplicates (r2 must be
+        # STRICTLY greater); threshold 0.0 prunes any correlated pair.
+        wide = run_ld_pipeline(self._conf(tmp_path, "--ld-r2-threshold", "1"))
+        tight_dir = tmp_path / "tight"
+        tight_dir.mkdir()
+        tight = run_ld_pipeline(
+            self._conf(tight_dir, "--ld-r2-threshold", "0")
+        )
+        assert tight.sites_kept < wide.sites_kept
+        assert wide.sites_tested == tight.sites_tested
+
+    def test_live_progress_gauges(self, tmp_path):
+        from spark_examples_tpu.obs.manifest import manifest_metric_value
+        from spark_examples_tpu.obs.metrics import (
+            ANALYSIS_SITES_KEPT,
+            ANALYSIS_SITES_TESTED,
+        )
+
+        conf = self._conf(tmp_path)
+        result = run_ld_pipeline(conf)
+        assert (
+            manifest_metric_value(result.manifest, ANALYSIS_SITES_TESTED)
+            == result.sites_tested
+        )
+        assert (
+            manifest_metric_value(result.manifest, ANALYSIS_SITES_KEPT)
+            == result.sites_kept
+        )
+
+    def test_parse_rejects_bad_flags(self):
+        with pytest.raises(ValueError, match="ld-r2-threshold"):
+            LdConf.parse(
+                ["--num-samples", "8", "--references", REFS,
+                 "--ld-r2-threshold", "1.5"]
+            )
+        with pytest.raises(ValueError, match="ld-window-sites"):
+            LdConf.parse(
+                ["--num-samples", "8", "--references", REFS,
+                 "--ld-window-sites", "1"]
+            )
+
+    def test_indivisible_samples_axis_rejected(self, tmp_path):
+        import jax
+
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 devices for a samples axis")
+        conf = LdConf.parse(
+            [
+                "--num-samples", "9",
+                "--references", REFS,
+                "--mesh-shape", "1,4",
+            ]
+        )
+        with pytest.raises(ValueError, match="does not divide"):
+            run_ld_pipeline(conf)
+
+
+# ------------------------------------------------------------------ assoc
+
+
+class TestPhenotypes:
+    def _write(self, tmp_path, text):
+        path = tmp_path / "p.tsv"
+        path.write_text(text)
+        return str(path)
+
+    def test_parse_good_file(self, tmp_path):
+        path = self._write(
+            tmp_path, "# comment\nA\t1\n\nB\t0\nC\t1\n"
+        )
+        assert load_phenotypes(path) == {"A": 1, "B": 0, "C": 1}
+
+    @pytest.mark.parametrize(
+        "text, match",
+        [
+            ("A\t2\n", "status"),
+            ("A\t1\nA\t0\n", "duplicate"),
+            ("A 1\n", "name<TAB>status"),
+            ("", "no phenotype rows"),
+            ("A\t1\nB\t1\n", "control"),
+            ("A\t0\nB\t0\n", "case"),
+        ],
+    )
+    def test_parse_rejects(self, tmp_path, text, match):
+        with pytest.raises(ValueError, match=match):
+            load_phenotypes(self._write(tmp_path, text))
+
+    def test_case_vector_strict_both_ways(self):
+        statuses = {"A": 1, "B": 0}
+        assert case_vector(statuses, ["B", "A"]).tolist() == [0, 1]
+        with pytest.raises(ValueError, match="missing"):
+            case_vector(statuses, ["A", "B", "C"])
+        with pytest.raises(ValueError, match="not in the"):
+            case_vector({"A": 1, "B": 0, "Z": 1}, ["A", "B"])
+
+
+class TestChi2:
+    def test_matches_textbook_2x2(self):
+        rng = np.random.default_rng(5)
+        n_cases, n_controls = 6, 10
+        n = n_cases + n_controls
+        t = rng.integers(1, n, size=50)
+        a = np.minimum(rng.integers(0, n_cases + 1, size=50), t)
+        # guard c <= n_controls
+        a = np.maximum(a, t - n_controls)
+        got = chi2_from_counts(a, t, n_cases, n_controls)
+        for i in range(50):
+            table = np.array(
+                [
+                    [a[i], n_cases - a[i]],
+                    [t[i] - a[i], n_controls - (t[i] - a[i])],
+                ],
+                dtype=np.float64,
+            )
+            total = table.sum()
+            expected_counts = (
+                table.sum(axis=1, keepdims=True)
+                * table.sum(axis=0, keepdims=True)
+                / total
+            )
+            if (expected_counts == 0).any():
+                assert got[i] == 0.0
+                continue
+            chi2 = ((table - expected_counts) ** 2 / expected_counts).sum()
+            np.testing.assert_allclose(got[i], chi2, rtol=1e-12)
+
+    def test_zero_variance_guard(self):
+        # t == 0 and t == n carry no genotype variance -> statistic 0.
+        out = chi2_from_counts(
+            np.array([0, 3]), np.array([0, 8]), 3, 5
+        )
+        assert out.tolist() == [0.0, 0.0]
+
+    def test_case_counts_kernel_matches_reference(self):
+        rng = np.random.default_rng(6)
+        rows = _rand_rows(rng, 20, 8)
+        case = (rng.random(8) < 0.5).astype(np.uint8)
+        a_ref, t_ref = case_counts_reference(rows, case)
+        a, t = build_case_counts()(rows, case)
+        assert np.array_equal(np.asarray(a), a_ref)
+        assert np.array_equal(np.asarray(t), t_ref)
+
+
+class TestAssocPipeline:
+    def _planted(self, tmp_path):
+        """Phenotypes = one polymorphic site's carrier vector: that site's
+        chi-square is the theoretical max (n) and must rank first."""
+        conf = AssocConf.parse(
+            ["--num-samples", "8", "--references", REFS,
+             "--phenotypes", "pending"]
+        )
+        from spark_examples_tpu.pipeline.pca_driver import make_source
+
+        src = make_source(conf)
+        names = _cohort_names(conf)
+        for contig in conf.get_contigs(src, conf.variant_set_id):
+            for block in src.genotype_blocks(
+                conf.variant_set_id[0],
+                contig,
+                block_size=conf.block_size,
+                min_allele_frequency=conf.min_allele_frequency,
+            ):
+                carriers = block["has_variation"].sum(axis=1)
+                hits = np.nonzero((carriers >= 2) & (carriers <= 6))[0]
+                if len(hits):
+                    i = int(hits[0])
+                    path = tmp_path / "pheno.tsv"
+                    path.write_text(
+                        "".join(
+                            f"{name}\t{int(s)}\n"
+                            for name, s in zip(
+                                names, block["has_variation"][i]
+                            )
+                        )
+                    )
+                    return str(path), (
+                        contig.reference_name,
+                        int(block["positions"][i]),
+                    )
+        raise AssertionError("no polymorphic site in the fixture stream")
+
+    def _conf(self, tmp_path, phenotypes, *extra):
+        return AssocConf.parse(
+            [
+                "--num-samples", "8",
+                "--references", REFS,
+                "--phenotypes", phenotypes,
+                "--assoc-out", str(tmp_path / "scan.tsv"),
+                "--metrics-json", str(tmp_path / "m.json"),
+                *extra,
+            ]
+        )
+
+    def test_planted_signal_top_ranked(self, tmp_path):
+        phenotypes, signal = self._planted(tmp_path)
+        result = run_assoc_pipeline(self._conf(tmp_path, phenotypes))
+        assert isinstance(result, AssocResult)
+        chi2, contig, pos, a, t = result.top[0]
+        assert (contig, pos) == signal
+        assert chi2 == float(result.n_cases + result.n_controls)
+        # Spilled rows: one per tested site, chi2 column matches the top.
+        lines = open(str(tmp_path / "scan.tsv")).read().splitlines()
+        assert len(lines) == result.sites_tested + 1
+        by_site = {
+            (l.split("\t")[0], int(l.split("\t")[1])): float(
+                l.split("\t")[4]
+            )
+            for l in lines[1:]
+        }
+        assert by_site[signal] == chi2
+        assert max(by_site.values()) == chi2
+        assert result.manifest["analysis"]["kind"] == "assoc"
+        assert (
+            result.manifest["analysis"]["sites_tested"]
+            == result.sites_tested
+        )
+
+    def test_device_matches_host_oracle_exactly(self, tmp_path):
+        phenotypes, _ = self._planted(tmp_path)
+        device = run_assoc_pipeline(self._conf(tmp_path, phenotypes))
+        host_dir = tmp_path / "host"
+        host_dir.mkdir()
+        host = run_assoc_pipeline(
+            self._conf(host_dir, phenotypes, "--pca-backend", "host")
+        )
+        assert device.top == host.top  # float64-exact parity
+        assert (
+            open(str(tmp_path / "scan.tsv")).read()
+            == open(str(host_dir / "scan.tsv")).read()
+        )
+
+    def test_requires_phenotypes(self):
+        conf = AssocConf.parse(
+            ["--num-samples", "8", "--references", REFS]
+        )
+        with pytest.raises(ValueError, match="phenotypes"):
+            run_assoc_pipeline(conf)
+
+    def test_bad_assoc_top_rejected_at_parse(self):
+        with pytest.raises(ValueError, match="assoc-top"):
+            AssocConf.parse(
+                ["--num-samples", "8", "--references", REFS,
+                 "--phenotypes", "x", "--assoc-top", "0"]
+            )
+
+
+# ----------------------------------------------------------- plan entries
+
+
+class TestPlanEntries:
+    def _run_plan(self, argv):
+        from spark_examples_tpu.check.plan import (
+            parse_plan_args,
+            validate_plan,
+        )
+
+        conf, devices, _json, budget, analysis = parse_plan_args(argv)
+        return validate_plan(
+            conf, devices, host_mem_budget=budget, analysis=analysis
+        )
+
+    def test_accepts_each_analysis(self, tmp_path):
+        pheno = tmp_path / "p.tsv"
+        pheno.write_text("A\t1\nB\t0\n")
+        base = ["--num-samples", "2", "--references", REFS,
+                "--variant-set-id", "tiny", "--num-samples", "2"]
+        # grm / ld accept a minimal conf; assoc needs a parseable TSV and
+        # a matching cohort, so its coverage runs against the synthetic
+        # names below.
+        for analysis in ("grm", "ld"):
+            report = self._run_plan(
+                ["--analysis", analysis, "--num-samples", "8",
+                 "--references", REFS]
+            )
+            assert report.ok, [i.message for i in report.issues]
+            assert report.geometry["analysis"] == analysis
+
+    def test_assoc_accepts_matching_cohort(self, tmp_path):
+        conf = AssocConf.parse(
+            ["--num-samples", "4", "--references", REFS,
+             "--phenotypes", "pending"]
+        )
+        names = _cohort_names(conf)
+        pheno = tmp_path / "p.tsv"
+        pheno.write_text(
+            "".join(f"{n}\t{i % 2}\n" for i, n in enumerate(names))
+        )
+        report = self._run_plan(
+            ["--analysis", "assoc", "--num-samples", "4",
+             "--references", REFS, "--phenotypes", str(pheno)]
+        )
+        assert report.ok, [i.message for i in report.issues]
+        assert report.geometry["assoc_cases"] == 2
+
+    @pytest.mark.parametrize(
+        "argv, code",
+        [
+            (
+                ["--analysis", "grm", "--num-samples", "8,8",
+                 "--variant-set-id", "a,b", "--references", REFS],
+                "analysis-variant-sets",
+            ),
+            (
+                ["--analysis", "ld", "--num-samples", "9",
+                 "--references", REFS, "--mesh-shape", "1,2",
+                 "--plan-devices", "2"],
+                "ld-cohort-not-divisible",
+            ),
+            (
+                ["--analysis", "assoc", "--num-samples", "8",
+                 "--references", REFS],
+                "assoc-phenotypes",
+            ),
+            (
+                ["--analysis", "assoc", "--num-samples", "8",
+                 "--references", REFS, "--phenotypes",
+                 "/nonexistent/p.tsv"],
+                "assoc-phenotypes",
+            ),
+            (
+                ["--analysis", "grm", "--num-samples", "8",
+                 "--references", REFS, "--grm-out",
+                 "/nonexistent/dir/kin.tsv"],
+                "grm-out",
+            ),
+        ],
+    )
+    def test_reject_matrix(self, argv, code):
+        report = self._run_plan(argv)
+        assert not report.ok
+        assert code in [i.code for i in report.issues]
+
+    def test_assoc_cohort_mismatch_rejected(self, tmp_path):
+        pheno = tmp_path / "p.tsv"
+        pheno.write_text("NOBODY\t1\nNOONE\t0\n")
+        report = self._run_plan(
+            ["--analysis", "assoc", "--num-samples", "8",
+             "--references", REFS, "--phenotypes", str(pheno)]
+        )
+        assert "assoc-cohort-mismatch" in [i.code for i in report.issues]
+
+    def test_num_pc_only_gates_pca(self):
+        # --num-pc > cohort is an eigensolve contract; the analyses never
+        # eigensolve, so only the pca surface rejects it.
+        pca = self._run_plan(
+            ["--num-samples", "2", "--references", REFS, "--num-pc", "5"]
+        )
+        assert "num-pc-exceeds-cohort" in [i.code for i in pca.issues]
+        grm = self._run_plan(
+            ["--analysis", "grm", "--num-samples", "2",
+             "--references", REFS, "--num-pc", "5"]
+        )
+        assert grm.ok, [i.message for i in grm.issues]
+
+    def test_ld_skips_gramian_hbm_rule(self):
+        # A cohort far past the dense-HBM bound is still a valid LD plan:
+        # LD never allocates the N x N accumulator.
+        argv = ["--num-samples", "60000", "--references", REFS,
+                "--similarity-strategy", "dense"]
+        pca = self._run_plan(argv)
+        assert "dense-exceeds-hbm" in [i.code for i in pca.issues]
+        ld = self._run_plan(["--analysis", "ld", *argv])
+        assert ld.ok, [i.message for i in ld.issues]
+        assert "ld_window_stats_bytes" in ld.geometry
+
+    def test_unknown_analysis_raises(self):
+        from spark_examples_tpu.check.plan import parse_plan_args
+
+        with pytest.raises(ValueError, match="--analysis"):
+            parse_plan_args(["--analysis", "nope", "--num-samples", "8"])
+        with pytest.raises(ValueError, match="--analysis"):
+            parse_plan_args(["--analysis"])
+
+    def test_plan_cli_exit_codes(self, capsys):
+        from spark_examples_tpu.check.cli import main
+
+        rc = main(
+            ["plan", "--analysis", "grm", "--num-samples", "8",
+             "--references", REFS]
+        )
+        assert rc == 0
+        # Parse-time contract violations (LdConf._from_namespace) surface
+        # as flag-contract plan rejections, exit 2.
+        rc = main(
+            ["plan", "--analysis", "ld", "--num-samples", "8",
+             "--references", REFS, "--ld-r2-threshold", "1.5"]
+        )
+        assert rc == 2
+        out = capsys.readouterr().out
+        assert "plan REJECTED" in out
+
+
+# ---------------------------------------------------------------- manifest
+
+
+class TestManifestAnalysisBlock:
+    def _doc(self, analysis):
+        from spark_examples_tpu.obs.manifest import build_manifest
+
+        return build_manifest(config={}, analysis=analysis)
+
+    def test_null_block_valid(self):
+        from spark_examples_tpu.obs.manifest import validate_manifest
+
+        assert validate_manifest(self._doc(None)) == []
+
+    def test_valid_block(self):
+        from spark_examples_tpu.obs.manifest import validate_manifest
+
+        doc = self._doc(
+            {"kind": "ld", "sites_kept": 3, "sites_tested": 10}
+        )
+        assert validate_manifest(doc) == []
+
+    @pytest.mark.parametrize(
+        "block, match",
+        [
+            ({"sites_kept": 1, "sites_tested": 1}, "analysis.kind"),
+            ({"kind": "", "sites_kept": 1, "sites_tested": 1},
+             "analysis.kind"),
+            ({"kind": "ld", "sites_tested": 1}, "sites_kept missing"),
+            ({"kind": "ld", "sites_kept": -1, "sites_tested": 1},
+             "sites_kept"),
+            ({"kind": "ld", "sites_kept": True, "sites_tested": 1},
+             "sites_kept"),
+            ("not-a-dict", "analysis"),
+        ],
+    )
+    def test_invalid_blocks(self, block, match):
+        from spark_examples_tpu.obs.manifest import validate_manifest
+
+        errors = validate_manifest(self._doc(block))
+        assert any(match in e for e in errors), errors
+
+
+# ------------------------------------------------------------------ serve
+
+
+class TestServeGrm:
+    def test_reserved_kinds_rejected(self):
+        from spark_examples_tpu.serve.protocol import (
+            ProtocolError,
+            parse_request,
+            request_doc,
+        )
+
+        for kind in ("ld", "assoc"):
+            with pytest.raises(ProtocolError) as err:
+                parse_request(request_doc(["--num-samples", "8"], kind=kind))
+            assert err.value.code == "reserved-kind"
+        with pytest.raises(ProtocolError) as err:
+            parse_request(request_doc([], kind="nope"))
+        assert err.value.code == "unknown-kind"
+
+    def test_submit_cli_kind_choices_track_protocol(self):
+        # The submit verb's --kind choices come from the protocol's own
+        # tables: served kinds submit; reserved kinds pass argparse so the
+        # server's structured reserved-kind 400 reaches the user.
+        from spark_examples_tpu.serve import client, protocol
+
+        assert client.SUBMIT_KIND_CHOICES == (
+            tuple(protocol.JOB_KINDS) + tuple(protocol.RESERVED_KINDS)
+        )
+        assert "grm" in client.SUBMIT_KIND_CHOICES
+
+    def test_grm_fingerprint_is_kind_keyed(self):
+        from spark_examples_tpu.utils.cache import compile_fingerprint
+
+        conf = _grm_conf()
+        assert compile_fingerprint(conf, kind="grm") != compile_fingerprint(
+            conf, kind="pca"
+        )
+
+    def test_classify_conf_handles_grm(self):
+        from spark_examples_tpu.serve.queue import classify_conf
+
+        assert classify_conf(_grm_conf()) == "small"
+
+    def _wait_terminal(self, svc, job_id, timeout=120.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            _s, doc = svc.job_status(job_id)
+            if doc["job"]["status"] in ("done", "failed", "cancelled"):
+                return doc["job"]
+            time.sleep(0.05)
+        raise AssertionError(f"job {job_id} never finished")
+
+    def test_grm_job_end_to_end(self, tmp_path):
+        from spark_examples_tpu.obs.manifest import (
+            read_manifest,
+            validate_manifest,
+        )
+        from spark_examples_tpu.serve.daemon import PcaService
+        from spark_examples_tpu.serve.protocol import request_doc
+
+        svc = PcaService(run_dir=str(tmp_path / "serve")).start()
+        try:
+            flags = ["--num-samples", "8", "--references", REFS]
+            # Reserved per-site output path: 400 before any device work.
+            status, doc = svc.submit(
+                request_doc(
+                    flags + ["--grm-out", str(tmp_path / "kin.tsv")],
+                    kind="grm",
+                )
+            )
+            assert status == 400
+            assert doc["error"]["code"] == "reserved-flag"
+            # A doomed grm conf rejects through the analysis plan entry.
+            status, doc = svc.submit(
+                request_doc(
+                    ["--num-samples", "8,8", "--variant-set-id", "a,b",
+                     "--references", REFS],
+                    kind="grm",
+                )
+            )
+            assert status == 400
+            codes = [i["code"] for i in doc["plan"]["issues"]]
+            assert "analysis-variant-sets" in codes
+            # The real job: done, kinship summary, valid per-job manifest
+            # with the analysis block.
+            status, doc = svc.submit(request_doc(flags, kind="grm"))
+            assert status == 202, doc
+            job = self._wait_terminal(svc, doc["job"]["id"])
+            assert job["status"] == "done", job
+            summary = job["result"]["grm"]
+            assert summary["shape"] == [8, 8]
+            assert summary["sites"] > 0
+            manifest = read_manifest(job["manifest_path"])
+            assert validate_manifest(manifest) == []
+            assert manifest["analysis"]["kind"] == "grm"
+            # Identical resubmit: the kind-keyed geometry is warm.
+            status, doc = svc.submit(request_doc(flags, kind="grm"))
+            assert status == 202
+            job2 = self._wait_terminal(svc, doc["job"]["id"])
+            assert job2["status"] == "done"
+            assert job2["compile_cache"] == "warm"
+        finally:
+            assert svc.stop(timeout=60.0)
+
+
+# -------------------------------------------------------------- heartbeat
+
+
+def test_heartbeat_analysis_segment():
+    from spark_examples_tpu.obs import MetricsRegistry
+    from spark_examples_tpu.obs.heartbeat import Heartbeat
+    from spark_examples_tpu.obs.metrics import (
+        ANALYSIS_SITES_KEPT,
+        ANALYSIS_SITES_TESTED,
+        well_known_gauge,
+    )
+
+    registry = MetricsRegistry()
+    beat = Heartbeat(60.0, registry, emit=lambda line: None)
+    assert "analysis kept" not in beat.line()
+    well_known_gauge(registry, ANALYSIS_SITES_TESTED).set(1000)
+    well_known_gauge(registry, ANALYSIS_SITES_KEPT).set(250)
+    assert "analysis kept 250/1,000 sites" in beat.line()
