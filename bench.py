@@ -545,6 +545,19 @@ def _run_config(name: str, device) -> dict:
     # manifest counter, so packed-vs-unpacked is visible per artifact.
     ring_bytes = manifest_metric_value(manifest, GRAMIAN_RING_BYTES)
 
+    # Predicted-vs-measured ring bytes from the manifest's schedule block
+    # (sharded runs): the STATIC per-flush projection next to the
+    # per-flush-accounted total — a nonzero delta means a counts-fallback
+    # flush or formula drift, and BENCH rounds catch it per artifact.
+    schedule = manifest.get("schedule") or {}
+    sched_predicted = schedule.get("predicted_ring_bytes")
+    sched_measured = schedule.get("measured_ring_bytes")
+    sched_delta = (
+        round(abs(sched_measured - sched_predicted) / sched_predicted, 6)
+        if sched_predicted
+        else None
+    )
+
     # Host-memory headroom (manifest schema v2): measured peak RSS next to
     # the static bound parallel/mesh.py:host_peak_bytes proves for bounded
     # ingest paths — BENCH artifacts record how much of the proven budget
@@ -579,6 +592,15 @@ def _run_config(name: str, device) -> dict:
             **(
                 {"gramian_ring_bytes": int(ring_bytes)}
                 if ring_bytes is not None
+                else {}
+            ),
+            **(
+                {
+                    "reduce_schedule": schedule.get("kind"),
+                    "sched_predicted_bytes": int(sched_predicted),
+                    "sched_ring_bytes_delta_fraction": sched_delta,
+                }
+                if sched_predicted is not None
                 else {}
             ),
             **(
@@ -697,6 +719,18 @@ def main() -> None:
             **(
                 {"gramian_ring_bytes": r["details"]["gramian_ring_bytes"]}
                 if "gramian_ring_bytes" in r["details"]
+                else {}
+            ),
+            **(
+                {
+                    "sched_predicted_bytes": r["details"][
+                        "sched_predicted_bytes"
+                    ],
+                    "sched_ring_bytes_delta_fraction": r["details"][
+                        "sched_ring_bytes_delta_fraction"
+                    ],
+                }
+                if "sched_predicted_bytes" in r["details"]
                 else {}
             ),
             **(

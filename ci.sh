@@ -18,6 +18,15 @@
 #      coverage, conversion-trigger conservativeness) across the full
 #      mesh/dtype audit matrix — the Gramian dtype ladder is PROVEN on
 #      every build, not asserted.
+#   2c2. sched — graftcheck sched (device-free collective-schedule prover:
+#      the schedule extracted from the traced kernel jaxprs is simulated
+#      per link class over the topology matrix incl. the 32x8 pod —
+#      per-level traffic == the closed forms, overlap clean, liveness in
+#      budget) + the 4-virtual-device hier-vs-flat smoke: the same sharded
+#      run through --reduce-schedule flat and hier (2 "hosts" x 2 devices
+#      via SPARK_EXAMPLES_TPU_HIER_HOSTS) must produce byte-identical
+#      result rows, valid manifest schedule blocks with predicted ==
+#      measured ring bytes, and hier DCN bytes strictly below flat's.
 #   2d. hostmem — graftcheck hostmem (AST host-memory audit: the tree must
 #      be clean, every O(file) site a justified hostmem(unbounded)
 #      declaration) + the --host-mem-budget smoke on the 4-virtual-device
@@ -104,6 +113,67 @@ fi
 echo "== ranges stage (graftcheck ranges) =="
 rg_rc=0
 env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck ranges || rg_rc=$?
+
+echo "== sched stage (graftcheck sched + hier-vs-flat smoke) =="
+sched_rc=0
+env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck sched || sched_rc=$?
+SCHED_TMP=$(mktemp -d)
+# Hier-vs-flat parity on 4 virtual devices: the same sharded run through
+# the flat ring and the two-level schedule (2 "hosts" x 2 devices via the
+# rehearsal override) must produce BYTE-IDENTICAL result rows, and both
+# manifests must carry a valid schedule block whose predicted bytes match
+# the per-flush accounting (delta 0 on an all-packed run).
+sched_flags="--num-samples 64 --references 1:0:400000 --mesh-shape 1,4 \
+  --similarity-strategy sharded --block-size 64 --ingest packed"
+for mode in flat hier; do
+  env JAX_PLATFORMS=cpu SPARK_EXAMPLES_TPU_PLATFORM=cpu \
+      SPARK_EXAMPLES_TPU_NO_CACHE=1 SPARK_EXAMPLES_TPU_HIER_HOSTS=2 \
+      XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    python -m spark_examples_tpu variants-pca $sched_flags \
+      --reduce-schedule "$mode" --metrics-json "$SCHED_TMP/$mode.json" \
+      > "$SCHED_TMP/$mode.out" 2> "$SCHED_TMP/$mode.err" || sched_rc=$?
+done
+if [ "$sched_rc" -eq 0 ]; then
+  grep -P "\t" "$SCHED_TMP/flat.out" > "$SCHED_TMP/flat.tsv"
+  grep -P "\t" "$SCHED_TMP/hier.out" > "$SCHED_TMP/hier.tsv"
+  if ! cmp -s "$SCHED_TMP/flat.tsv" "$SCHED_TMP/hier.tsv"; then
+    echo "hier result rows DIFFER from the flat-ring oracle"
+    sched_rc=1
+  fi
+fi
+if [ "$sched_rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python - "$SCHED_TMP/flat.json" "$SCHED_TMP/hier.json" <<'PYEOF' || sched_rc=$?
+import sys
+from spark_examples_tpu.obs.manifest import read_manifest, validate_manifest
+docs = {}
+for path in sys.argv[1:3]:
+    doc = read_manifest(path)
+    errors = validate_manifest(doc)
+    if errors:
+        print("schedule manifest INVALID:\n  " + "\n  ".join(errors))
+        sys.exit(1)
+    docs[path] = doc["schedule"]
+flat, hier = docs[sys.argv[1]], docs[sys.argv[2]]
+for name, blk in (("flat", flat), ("hier", hier)):
+    if blk is None:
+        print(f"{name} run carries no schedule block"); sys.exit(1)
+    if blk["predicted_ring_bytes"] != blk["measured_ring_bytes"]:
+        print(f"{name} predicted != measured ring bytes: {blk}"); sys.exit(1)
+if flat["kind"] != "flat" or hier["kind"] != "hier":
+    print(f"schedule kinds wrong: {flat['kind']}/{hier['kind']}"); sys.exit(1)
+if not (0 < hier["predicted_dcn_bytes"] < flat["predicted_dcn_bytes"]):
+    print("hier DCN bytes not strictly below flat DCN bytes: "
+          f"hier={hier['predicted_dcn_bytes']} flat={flat['predicted_dcn_bytes']}")
+    sys.exit(1)
+print(f"sched smoke OK: hier==flat rows byte-identical, predicted==measured, "
+      f"DCN {flat['predicted_dcn_bytes']} -> {hier['predicted_dcn_bytes']} B "
+      f"({flat['predicted_dcn_bytes'] / hier['predicted_dcn_bytes']:.1f}x less "
+      "on the slow link)")
+PYEOF
+else
+  echo "sched smoke failed (rc=$sched_rc):"; tail -20 "$SCHED_TMP"/*.err
+fi
+rm -rf "$SCHED_TMP"
 
 echo "== hostmem stage (graftcheck hostmem + host-memory budget) =="
 hm_rc=0
@@ -672,6 +742,7 @@ if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 if [ "$lint_rc" -ne 0 ]; then exit "$lint_rc"; fi
 if [ "$ir_rc" -ne 0 ]; then exit "$ir_rc"; fi
 if [ "$rg_rc" -ne 0 ]; then exit "$rg_rc"; fi
+if [ "$sched_rc" -ne 0 ]; then exit "$sched_rc"; fi
 if [ "$hm_rc" -ne 0 ]; then exit "$hm_rc"; fi
 if [ "$obs_rc" -ne 0 ]; then exit "$obs_rc"; fi
 if [ "$ring_rc" -ne 0 ]; then exit "$ring_rc"; fi

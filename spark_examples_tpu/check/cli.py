@@ -5,10 +5,15 @@ Dispatched from the package CLI (``python -m spark_examples_tpu graftcheck
 on them:
 
     graftcheck lint [PATH...] [--json]        0 clean / 1 findings
-    graftcheck ir [--json] [--mesh D,S ...] [--num-samples N]
+    graftcheck ir [--json] [--mesh D,S ...] [--topology H,D ...]
+                  [--num-samples N]
                   [--block-size B]           0 clean / 1 findings
-    graftcheck ranges [--json] [--mesh D,S ...] [--num-samples N]
+    graftcheck ranges [--json] [--mesh D,S ...] [--topology H,D ...]
+                  [--num-samples N]
                   [--block-size B]           0 proven / 1 findings
+    graftcheck sched [--json] [--topology H,D ...] [--num-samples N]
+                  [--block-size B] [--reduce-schedule auto|flat|hier]
+                  [--sched-budget-seconds S] 0 proven / 1 findings
     graftcheck lockgraph [PATH...] [--json] [--dot FILE]
                                               0 acyclic+clean / 1 findings
     graftcheck hostmem [PATH...] [--json]     0 clean (declared sites
@@ -68,11 +73,15 @@ def _cmd_lint(argv: Sequence[str]) -> int:
     return 1 if findings else 0
 
 
-def _parse_audit_args(prog: str, argv: Sequence[str]):
-    """The shared ``--json/--mesh/--num-samples/--block-size`` surface of
-    the kernel-audit subcommands (``ir`` and ``ranges``) — ONE parser and
-    ONE mesh-pair validation, so the two cannot drift. Returns
-    ``(ns, meshes)`` or ``None`` after printing the mesh grammar error."""
+def _parse_audit_args(prog: str, argv: Sequence[str], extra=None):
+    """The shared ``--json/--mesh/--topology/--num-samples/--block-size``
+    surface of the kernel-audit subcommands (``ir``, ``ranges``, and
+    ``sched``) — ONE parser, ONE mesh-pair validation, and ONE
+    ``--topology hosts,devices_per_host`` spelling, so the three cannot
+    drift. ``extra`` (a callback receiving the parser) registers
+    subcommand-specific flags before parsing. Returns
+    ``(ns, meshes, topologies)`` or ``None`` after printing the grammar
+    error."""
     parser = argparse.ArgumentParser(prog=prog)
     parser.add_argument(
         "--json", action="store_true", help="Emit the machine-readable report."
@@ -88,6 +97,19 @@ def _parse_audit_args(prog: str, argv: Sequence[str]):
         ),
     )
     parser.add_argument(
+        "--topology",
+        action="append",
+        default=None,
+        metavar="H,D",
+        help=(
+            "Declared topology (hosts,devices_per_host — repeatable, e.g. "
+            "--topology 32,8) to audit the hierarchical two-level schedule "
+            "on; the topology never has to exist. ir/ranges append the "
+            "hierarchical kernel per topology; sched audits its full "
+            "matrix (default: (1,2), (1,4), (2,4), (4,8), (32,8))."
+        ),
+    )
+    parser.add_argument(
         "--num-samples",
         type=int,
         default=64,
@@ -99,6 +121,8 @@ def _parse_audit_args(prog: str, argv: Sequence[str]):
         default=8,
         help="Variant block size for the audit geometry (default 8).",
     )
+    if extra is not None:
+        extra(parser)
     ns = parser.parse_args(list(argv))
     meshes = None
     if ns.mesh:
@@ -115,7 +139,20 @@ def _parse_audit_args(prog: str, argv: Sequence[str]):
                 file=sys.stderr,
             )
             return None
-    return ns, meshes
+    topologies = None
+    if ns.topology:
+        from spark_examples_tpu.parallel.mesh import parse_topology
+
+        topologies = []
+        for spec in ns.topology:
+            try:
+                topo = parse_topology(spec)
+            except ValueError as e:
+                print(f"{prog}: {e}", file=sys.stderr)
+                return None
+            topologies.append((topo.hosts, topo.devices_per_host))
+        topologies = tuple(topologies)
+    return ns, meshes, topologies
 
 
 def _cmd_ir(argv: Sequence[str]) -> int:
@@ -124,12 +161,13 @@ def _cmd_ir(argv: Sequence[str]) -> int:
     parsed = _parse_audit_args("graftcheck ir", argv)
     if parsed is None:
         return 2
-    ns, meshes = parsed
+    ns, meshes, topologies = parsed
     specs = default_specs(
         num_samples=ns.num_samples,
         ragged_samples=ns.num_samples + 36,
         block_size=ns.block_size,
         **({"meshes": meshes} if meshes is not None else {}),
+        **({"topologies": topologies} if topologies is not None else {}),
     )
     report = run_audit(specs)
     print(report.to_json() if ns.json else report.format())
@@ -142,13 +180,76 @@ def _cmd_ranges(argv: Sequence[str]) -> int:
     parsed = _parse_audit_args("graftcheck ranges", argv)
     if parsed is None:
         return 2
-    ns, meshes = parsed
+    ns, meshes, topologies = parsed
     specs = default_specs(
         num_samples=ns.num_samples,
         block_size=ns.block_size,
         **({"meshes": meshes} if meshes is not None else {}),
+        **({"topologies": topologies} if topologies is not None else {}),
     )
     report = run_audit(specs)
+    print(report.to_json() if ns.json else report.format())
+    return 0 if report.ok else 1
+
+
+def _cmd_sched(argv: Sequence[str]) -> int:
+    from spark_examples_tpu.check.sched import run_audit
+
+    def extra(parser):
+        parser.add_argument(
+            "--reduce-schedule",
+            choices=["auto", "flat", "hier"],
+            default="auto",
+            help=(
+                "Which schedule selection to prove per topology (the "
+                "runtime flag's resolution rule; auto = hier iff hosts "
+                "> 1). Forcing flat on a multi-host topology demonstrates "
+                "GS001."
+            ),
+        )
+        parser.add_argument(
+            "--sched-budget-seconds",
+            type=float,
+            default=None,
+            metavar="S",
+            help=(
+                "Declared critical-path budget per flush: a topology "
+                "whose predicted schedule-limited time exceeds it is a "
+                "GS005 finding."
+            ),
+        )
+
+    parsed = _parse_audit_args("graftcheck sched", argv, extra=extra)
+    if parsed is None:
+        return 2
+    ns, meshes, topologies = parsed
+    if meshes is not None:
+        # A silently-ignored flag would let the user believe they
+        # constrained the audit matrix; sched audits topologies, not
+        # data x samples meshes.
+        print(
+            "graftcheck sched: --mesh does not apply here — the schedule "
+            "matrix is selected with --topology hosts,devices_per_host",
+            file=sys.stderr,
+        )
+        return 2
+    if ns.sched_budget_seconds is not None and ns.sched_budget_seconds <= 0:
+        # Same positivity contract graftcheck plan enforces for the flag:
+        # a non-positive budget is a usage error, not a GS005 finding on
+        # every topology.
+        print(
+            f"graftcheck sched: --sched-budget-seconds must be positive, "
+            f"got {ns.sched_budget_seconds}",
+            file=sys.stderr,
+        )
+        return 2
+    report = run_audit(
+        topologies=topologies,
+        num_samples=ns.num_samples,
+        block_size=ns.block_size,
+        reduce_schedule=ns.reduce_schedule,
+        budget_seconds=ns.sched_budget_seconds,
+    )
     print(report.to_json() if ns.json else report.format())
     return 0 if report.ok else 1
 
@@ -230,9 +331,15 @@ def _cmd_plan(argv: Sequence[str]) -> int:
     from spark_examples_tpu.check.plan import parse_plan_args, validate_plan
 
     try:
-        conf, plan_devices, json_out, host_mem_budget, analysis = (
-            parse_plan_args(argv)
-        )
+        (
+            conf,
+            plan_devices,
+            json_out,
+            host_mem_budget,
+            analysis,
+            topology,
+            sched_budget_seconds,
+        ) = parse_plan_args(argv)
     except ValueError as e:
         # Cross-flag contract violations from PcaConf._from_namespace are
         # plan rejections in their own right (e.g. --blocks-per-dispatch 0).
@@ -240,7 +347,12 @@ def _cmd_plan(argv: Sequence[str]) -> int:
         print("plan REJECTED")
         return 2
     report = validate_plan(
-        conf, plan_devices, host_mem_budget=host_mem_budget, analysis=analysis
+        conf,
+        plan_devices,
+        host_mem_budget=host_mem_budget,
+        analysis=analysis,
+        topology=topology,
+        sched_budget_seconds=sched_budget_seconds,
     )
     print(report.to_json() if json_out else report.format())
     return 0 if report.ok else 2
@@ -287,6 +399,7 @@ _SUBCOMMANDS = {
     "lint": _cmd_lint,
     "ir": _cmd_ir,
     "ranges": _cmd_ranges,
+    "sched": _cmd_sched,
     "lockgraph": _cmd_lockgraph,
     "hostmem": _cmd_hostmem,
     "plan": _cmd_plan,

@@ -853,6 +853,81 @@ def ring_kernel_spec(
     )
 
 
+def hier_kernel_spec(
+    data: int,
+    hosts: int,
+    devices_per_host: int,
+    num_samples: int,
+    block_size: int,
+    pack: bool,
+    exact_int: bool = False,
+) -> KernelSpec:
+    """The hierarchical two-level ring update over an abstract
+    ``data x hosts x samples`` mesh — ``ops/gramian.py:
+    build_hierarchical_update``, the runtime's own constructor. The ring
+    contracts hold UNCHANGED with ``samples_axis = hosts x
+    devices_per_host``: total permutes are ``(H-1) + H x (D-1) = S - 1``
+    (GI006) and total bytes equal ``ring_traffic_bytes`` (GI005) — the
+    schedule moves the same data as the flat ring, split across link
+    classes (which ``check/sched.py`` proves per level)."""
+    from spark_examples_tpu.parallel.mesh import padded_cohort
+
+    samples = hosts * devices_per_host
+    padded = padded_cohort(num_samples, samples, pack=pack)
+    n_local = padded // samples
+
+    def build() -> Tuple[Callable[..., Any], Tuple[Any, ...]]:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import AbstractMesh
+
+        from spark_examples_tpu.ops.gramian import build_hierarchical_update
+        from spark_examples_tpu.parallel.mesh import (
+            DATA_AXIS,
+            HOST_AXIS,
+            RING_PACK_MULTIPLE,
+            SAMPLES_AXIS,
+        )
+
+        mesh = AbstractMesh(
+            (
+                (DATA_AXIS, data),
+                (HOST_AXIS, hosts),
+                (SAMPLES_AXIS, devices_per_host),
+            )
+        )
+        operand = np.int8 if exact_int else np.float32
+        accum = jnp.int32 if exact_int else jnp.float32
+        update = build_hierarchical_update(mesh, operand, pack)
+        G = jax.ShapeDtypeStruct((data, padded, padded), accum)
+        X = jax.ShapeDtypeStruct(
+            (data, block_size,
+             padded // RING_PACK_MULTIPLE if pack else padded),
+            jnp.uint8,
+        )
+        return update, (G, X)
+
+    wire = "on" if pack else "off"
+    return KernelSpec(
+        name=(
+            f"hier[data={data},hosts={hosts},devices={devices_per_host},"
+            f"N={num_samples},B={block_size},pack={wire}]"
+        ),
+        build=build,
+        samples_axis=samples,
+        total_devices=data * samples,
+        packed=pack,
+        ring=True,
+        ring_passes=1,
+        rows_per_call=data * block_size,
+        n_local=n_local,
+        packed_invars=(1,) if pack else (),
+        acc_invar=0,
+        donation=DonationSite(_gramian_file(), "update", "ops/gramian.py"),
+        liveness_scope="per-device",
+    )
+
+
 def devicegen_ring_spec(
     data: int,
     samples: int,
@@ -936,10 +1011,14 @@ def default_specs(
     ragged_samples: int = 100,
     block_size: int = 8,
     meshes: Sequence[Tuple[int, int]] = DEFAULT_MESHES,
+    topologies: Sequence[Tuple[int, int]] = (),
 ) -> List[KernelSpec]:
     """The shipped audit matrix: dense + counts kernels per data-axis size,
     the ring kernel over every mesh shape x {packed, unpacked} x
-    {aligned, ragged} cohort, and the device-generation ring."""
+    {aligned, ragged} cohort, and the device-generation ring.
+    ``topologies`` (``--topology hosts,devices_per_host`` pairs) append the
+    hierarchical two-level kernel per topology, packed + unpacked — the
+    same GI contracts proven on the pod-scale schedule."""
     specs: List[KernelSpec] = []
     for data in sorted({d for d, _ in meshes}):
         specs.append(dense_kernel_spec(data, num_samples, block_size))
@@ -960,6 +1039,15 @@ def default_specs(
         specs.append(
             devicegen_ring_spec(data, samples, num_samples, block_size, 2)
         )
+    for hosts, per_host in topologies:
+        if hosts * per_host < 2:
+            continue
+        for pack in (True, False):
+            specs.append(
+                hier_kernel_spec(
+                    1, hosts, per_host, num_samples, block_size, pack
+                )
+            )
     return specs
 
 
@@ -1046,6 +1134,7 @@ __all__ = [
     "dense_kernel_spec",
     "devicegen_ring_spec",
     "gc005_justified_functions",
+    "hier_kernel_spec",
     "peak_live_bytes",
     "ring_kernel_spec",
     "run_audit",

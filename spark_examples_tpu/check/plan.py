@@ -132,10 +132,12 @@ def parse_plan_args(argv: Sequence[str]):
     """Parse ``graftcheck plan`` argv: the analysis's full flag surface
     (``--analysis pca|grm|ld|assoc``, default pca — pre-scanned so the
     remaining flags parse through that verb's REAL parser) plus the
-    plan-only ``--plan-devices`` and ``--host-mem-budget``. Returns
-    ``(conf, plan_devices, json_out, host_mem_budget, analysis)``. Flag
-    errors raise ``ValueError`` (argparse's SystemExit is converted so the
-    caller reports them as plan rejections, not a CLI crash)."""
+    plan-only ``--plan-devices``, ``--host-mem-budget``, ``--topology``
+    and ``--sched-budget-seconds``. Returns ``(conf, plan_devices,
+    json_out, host_mem_budget, analysis, topology,
+    sched_budget_seconds)``. Flag errors raise ``ValueError`` (argparse's
+    SystemExit is converted so the caller reports them as plan
+    rejections, not a CLI crash)."""
     argv = list(argv)
     analysis = "pca"
     for index, arg in enumerate(argv):
@@ -194,11 +196,51 @@ def parse_plan_args(argv: Sequence[str]):
         ),
     )
     parser.add_argument(
+        "--topology",
+        default=None,
+        metavar="H,D",
+        help=(
+            "Declared pod topology (hosts,devices_per_host — e.g. 32,8) "
+            "to prove the reduction schedule against: the collective "
+            "schedule is extracted from the real kernel jaxprs and "
+            "simulated per link class (check/sched.py) — per-level "
+            "traffic, overlap, liveness, and the GS rules, for a pod "
+            "that need not exist. The samples axis it implies is "
+            "hosts x devices_per_host; an explicit --mesh-shape must "
+            "agree."
+        ),
+    )
+    parser.add_argument(
+        "--sched-budget-seconds",
+        type=float,
+        default=None,
+        metavar="S",
+        help=(
+            "Declared schedule-limited wall-clock budget for the whole "
+            "run's statically-known site count: a topology whose "
+            "predicted critical path exceeds it is a GS005 rejection "
+            "(exit 2). Needs --topology."
+        ),
+    )
+    parser.add_argument(
         "--json", action="store_true", help="Emit the machine-readable report."
     )
     ns = parser.parse_args(argv)
     conf = conf_cls._from_namespace(ns)
-    return conf, ns.plan_devices, ns.json, ns.host_mem_budget, analysis
+    topology = None
+    if ns.topology is not None:
+        from spark_examples_tpu.parallel.mesh import parse_topology
+
+        topology = parse_topology(ns.topology)  # ValueError -> rejection
+    return (
+        conf,
+        ns.plan_devices,
+        ns.json,
+        ns.host_mem_budget,
+        analysis,
+        topology,
+        ns.sched_budget_seconds,
+    )
 
 
 def _resolve_mesh_axes(
@@ -568,29 +610,7 @@ def _check_exactness(
         "int32": exactness_headroom_sites(np.int32, max_count),
     }
 
-    # Static site-count bound: the synthetic grid has one candidate site
-    # per DEFAULT_VARIANT_SPACING bases, so explicit --references windows
-    # bound the total variant rows statically (variant sets share the site
-    # grid — DESIGN.md §6; file/REST cohorts carry their counts in the
-    # data, so no static bound exists for them).
-    static_rows = None
-    if (
-        getattr(conf, "source", "synthetic") == "synthetic"
-        and not conf.all_references
-        and not conf.input_path
-    ):
-        try:
-            from spark_examples_tpu.sources.synthetic import (
-                DEFAULT_VARIANT_SPACING,
-            )
-
-            static_rows = sum(
-                (contig.end - contig.start) // DEFAULT_VARIANT_SPACING + 1
-                for contigs in conf.get_references()
-                for contig in contigs
-            )
-        except (ValueError, TypeError):
-            static_rows = None
+    static_rows = _static_site_rows(conf)
     if static_rows is None:
         report.geometry["gramian_entry_bound"] = None
         return
@@ -606,6 +626,135 @@ def _check_exactness(
             f"({int32_window}) — no dtype-ladder rung can hold the count "
             "exactly; shrink --references or split the cohort "
             "(graftcheck ranges GR001)",
+        )
+
+
+def _static_site_rows(conf: PcaConf) -> Optional[int]:
+    """Statically-known total variant rows, or None: the synthetic grid
+    has one candidate site per DEFAULT_VARIANT_SPACING bases, so explicit
+    ``--references`` windows bound the total statically (variant sets
+    share the site grid — DESIGN.md §6; file/REST cohorts carry their
+    counts in the data, so no static bound exists for them). Shared by the
+    exactness proof (``gramian_entry_bound``) and the schedule prover's
+    critical-path projection (GS005)."""
+    if (
+        getattr(conf, "source", "synthetic") != "synthetic"
+        or conf.all_references
+        or conf.input_path
+    ):
+        return None
+    try:
+        from spark_examples_tpu.sources.synthetic import (
+            DEFAULT_VARIANT_SPACING,
+        )
+
+        return sum(
+            (contig.end - contig.start) // DEFAULT_VARIANT_SPACING + 1
+            for contigs in conf.get_references()
+            for contig in contigs
+        )
+    except (ValueError, TypeError):
+        return None
+
+
+def _check_schedule(
+    report: PlanReport,
+    conf: PcaConf,
+    topology,
+    data: int,
+    samples: int,
+    sched_budget_seconds: Optional[float],
+    plan_devices: Optional[int] = None,
+) -> None:
+    """The collective-schedule proof for a DECLARED topology
+    (``check/sched.py`` over the configured kernel geometry): resolve the
+    schedule ``--reduce-schedule`` would build on that topology, extract
+    and simulate it from the traced kernel, and turn GS/GI findings into
+    plan rejections — a pod-scale run is schedule-proven before the pod
+    exists. ``--sched-budget-seconds`` projects the critical path over
+    the statically-known site count (GS005); a budget over an unknowable
+    site count is itself a rejection (the flag asks for a proof the
+    configuration cannot give — the ``--host-mem-budget`` rule)."""
+    from spark_examples_tpu.check.sched import audit_schedule
+    from spark_examples_tpu.ops.gramian import resolve_ring_pack
+    from spark_examples_tpu.parallel.mesh import resolve_reduce_schedule
+
+    if conf.mesh_shape and samples != topology.devices:
+        # An explicit mesh must span the declared pod's samples axis —
+        # including the data-only (samples=1) spelling, which pins a run
+        # that dispatches no ring at all; only the default-mesh case
+        # (no --mesh-shape) lets the topology imply the schedule mesh.
+        report.error(
+            "topology-mesh-mismatch",
+            f"--topology {topology.describe()} implies a samples axis of "
+            f"{topology.devices} but --mesh-shape {conf.mesh_shape} "
+            f"declares {samples}; the schedule would not span the "
+            "declared pod",
+        )
+        return
+    if plan_devices is not None and plan_devices != topology.devices:
+        # One report must describe ONE pod: the mesh/HBM/host-mem facts
+        # are computed against --plan-devices while the schedule proof
+        # spans the topology — a disagreement proves a plan no single
+        # run can execute.
+        report.error(
+            "topology-devices-mismatch",
+            f"--topology {topology.describe()} declares "
+            f"{topology.devices} devices but --plan-devices declares "
+            f"{plan_devices}; the geometry facts and the schedule proof "
+            "would describe different pods",
+        )
+        return
+    schedule = resolve_reduce_schedule(
+        getattr(conf, "reduce_schedule", "auto"), topology.hosts
+    )
+    static_rows = _static_site_rows(conf)
+    if sched_budget_seconds is not None and sched_budget_seconds <= 0:
+        report.error(
+            "sched-budget-seconds",
+            f"--sched-budget-seconds must be positive, got "
+            f"{sched_budget_seconds}",
+        )
+        return
+    if sched_budget_seconds is not None and static_rows is None:
+        report.error(
+            "sched-budget-unprovable",
+            "--sched-budget-seconds needs a statically-known site count "
+            "to project the schedule over (synthetic source with explicit "
+            "--references); this configuration's total rows are only "
+            "known at run time, so no critical-path proof exists",
+        )
+        return
+    audit = audit_schedule(
+        topology,
+        schedule,
+        num_samples=int(conf.num_samples),
+        block_size=int(conf.block_size),
+        data=data if conf.mesh_shape and samples == topology.devices else 1,
+        pack=resolve_ring_pack(getattr(conf, "ring_pack_bits", "auto")),
+        exact_int=bool(getattr(conf, "exact_similarity", False)),
+        rows=static_rows,
+        budget_seconds=sched_budget_seconds,
+        selected=True,
+    )
+    for finding in audit.findings:
+        report.error(f"sched-{finding.rule_id}", finding.detail)
+    report.geometry["sched_topology"] = topology.describe()
+    report.geometry["sched_schedule"] = schedule
+    report.geometry["sched_ici_bytes"] = audit.facts.get("ici_bytes")
+    report.geometry["sched_dcn_bytes"] = audit.facts.get("dcn_bytes")
+    report.geometry["sched_rows"] = audit.facts.get("sim_rows")
+    report.geometry["sched_critical_path_seconds"] = audit.facts.get(
+        "critical_path_seconds"
+    )
+    if audit.ok:
+        report.shape_checks.append(
+            f"schedule audit on {topology.describe()}: {schedule} "
+            f"schedule, ici {audit.facts.get('ici_bytes')} B / dcn "
+            f"{audit.facts.get('dcn_bytes')} B per flush == formula, "
+            "overlap clean, predicted critical path "
+            f"{audit.facts.get('critical_path_seconds'):.3g} s over "
+            f"{audit.facts.get('sim_rows')} rows"
         )
 
 
@@ -921,6 +1070,8 @@ def validate_plan(
     plan_devices: Optional[int] = None,
     host_mem_budget: Optional[int] = None,
     analysis: str = "pca",
+    topology=None,
+    sched_budget_seconds: Optional[float] = None,
 ) -> PlanReport:
     """Statically validate one pipeline configuration. Pure flag/geometry
     arithmetic plus abstract kernel traces — no device is queried.
@@ -996,6 +1147,30 @@ def validate_plan(
         resolve_ring_pack(getattr(conf, "ring_pack_bits", "auto"))
     except ValueError as e:
         report.error("ring-pack-bits", str(e))
+    try:
+        from spark_examples_tpu.parallel.mesh import resolve_reduce_schedule
+
+        resolve_reduce_schedule(getattr(conf, "reduce_schedule", "auto"), 1)
+    except ValueError as e:
+        report.error("reduce-schedule", str(e))
+    if (
+        getattr(conf, "reduce_schedule", "auto") == "hier"
+        and conf.ingest == "device"
+    ):
+        # Mirrors the runtime reject in pca_driver.get_similarity_device_gen:
+        # the fused generation ring pins the flat schedule.
+        report.error(
+            "reduce-schedule-device-ingest",
+            "--reduce-schedule hier is not available for --ingest device "
+            "(the fused generation ring runs the flat schedule); use "
+            "--ingest packed or wire, or leave the schedule on auto",
+        )
+    if sched_budget_seconds is not None and topology is None:
+        report.error(
+            "sched-budget-seconds",
+            "--sched-budget-seconds needs --topology: a critical-path "
+            "budget is a claim about a specific pod's link bandwidths",
+        )
 
     # Robustness flags (pipeline/checkpoint.py + utils/faults.py): a
     # checkpointed whole-genome run that only discovers its resume flags
@@ -1146,6 +1321,57 @@ def validate_plan(
             )
     if conf.pca_backend == "tpu" and not gramian_like and report.ok:
         _eval_analysis_kernels(report, conf, analysis, data, samples)
+
+    # ----------------------------------------- schedule proof (if declared)
+    if topology is not None and report.ok:
+        if (
+            conf.pca_backend == "tpu"
+            and gramian_like
+            and conf.similarity_strategy != "dense"
+        ):
+            _check_schedule(
+                report,
+                conf,
+                topology,
+                data,
+                samples,
+                sched_budget_seconds,
+                plan_devices,
+            )
+        else:
+            # No collective reduction exists to prove: host backend and
+            # the per-site analyses dispatch no ring, and an EXPLICIT
+            # dense strategy pins the replicated accumulator even on the
+            # pod (auto would resolve sharded there, so auto still
+            # proves).
+            why = (
+                "--pca-backend host"
+                if conf.pca_backend != "tpu"
+                else (
+                    f"--analysis {analysis}"
+                    if not gramian_like
+                    else "--similarity-strategy dense"
+                )
+            )
+            if sched_budget_seconds is not None:
+                # A declared budget the configuration cannot prove is a
+                # rejection, never a silent pass (the --host-mem-budget
+                # rule).
+                report.error(
+                    "sched-budget-unprovable",
+                    "--sched-budget-seconds declares a schedule-limited "
+                    "budget, but this configuration dispatches no "
+                    f"collective reduction to prove ({why} has no ring "
+                    "schedule); drop the budget or validate a ring-"
+                    "bearing tpu configuration",
+                )
+            else:
+                report.warn(
+                    "sched-not-applicable",
+                    f"--topology {topology.describe()} declared, but "
+                    f"this configuration dispatches no collective "
+                    f"reduction ({why}) — no schedule facts to prove",
+                )
 
     # --------------------------------------------------- memory feasibility
     from spark_examples_tpu.ops.gramian import (

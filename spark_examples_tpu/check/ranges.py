@@ -873,37 +873,115 @@ class Interpreter:
         meqn = mframe.producers.get(mvar) if _is_var(mvar) else None
         if meqn is None:
             return None
+        if meqn.primitive.name == "add":
+            # The hierarchical ring's TWO-RADIX owner index:
+            # ((h + k) mod H) * D + ((d + j) mod D), multiplied by the tile
+            # width outside. Same disjointness structure, two loop levels.
+            two_radix = self._peel_two_radix(mframe, meqn)
+            if two_radix is None:
+                return None
+            modulus, base_key, k_values = two_radix
+            return modulus, width, base_key, k_values
+        single = self._peel_rem(mframe, mvar)
+        if single is None:
+            return None
+        modulus, base_ids, k_values = single
+        return modulus, width, tuple(sorted(base_ids)), tuple(sorted(k_values))
+
+    def _peel_rem(
+        self, frame: _Frame, var: Any
+    ) -> Optional[Tuple[int, Set[int], Set[int]]]:
+        """Peel one ``(base + k) mod M`` radix: returns ``(modulus,
+        base ids, k values)`` or None. The shared recognizer of the
+        single-radix (flat ring) and two-radix (hierarchical ring)
+        disjointness patterns."""
+        f, v = self._peel(frame, var)
+        eqn = f.producers.get(v) if _is_var(v) else None
+        if eqn is None:
+            return None
         modulus = None
         dividend = None
-        if meqn.primitive.name == "rem":
+        if eqn.primitive.name == "rem":
             div = (
-                mframe.read(meqn.invars[1])
-                if _is_var(meqn.invars[1])
-                else _from_concrete(meqn.invars[1].val)
+                f.read(eqn.invars[1])
+                if _is_var(eqn.invars[1])
+                else _from_concrete(eqn.invars[1].val)
             )
             if div.point is not None:
-                modulus, dividend = int(div.point), meqn.invars[0]
-        elif meqn.primitive.name == "pjit" and meqn.params.get("name") in (
+                modulus, dividend = int(div.point), eqn.invars[0]
+        elif eqn.primitive.name == "pjit" and eqn.params.get("name") in (
             "remainder",
             "mod",
             "floormod",
         ):
             div = (
-                mframe.read(meqn.invars[1])
-                if _is_var(meqn.invars[1])
-                else _from_concrete(meqn.invars[1].val)
+                f.read(eqn.invars[1])
+                if _is_var(eqn.invars[1])
+                else _from_concrete(eqn.invars[1].val)
             )
             if div.point is not None:
-                modulus, dividend = int(div.point), meqn.invars[0]
+                modulus, dividend = int(div.point), eqn.invars[0]
         if modulus is None or modulus <= 0 or dividend is None:
             return None
-        terms = self._peel_add_terms(mframe, dividend)
-        if terms is None:
+        terms = self._peel_add_terms(f, dividend)
+        if terms is None or terms[1] is None:
             return None
-        base_ids, k_values = terms
-        if k_values is None:
-            return None
-        return modulus, width, tuple(sorted(base_ids)), tuple(sorted(k_values))
+        return modulus, set(terms[0]), set(terms[1])
+
+    def _peel_two_radix(
+        self, frame: _Frame, add_eqn: Any
+    ) -> Optional[Tuple[int, Tuple[int, ...], Tuple[int, ...]]]:
+        """Prove the hierarchical owner index ``((h + k) mod H) * D +
+        ((d + j) mod D)``: a two-level scan's flat owner, pairwise
+        distinct over the (k, j) double loop exactly when the per-level
+        residues are. Returns ``(H * D, base_key, flat k values)`` with
+        the flat values ``(k mod H) * D + (j mod D)`` — distinct iff the
+        (k, j) pairs are, so the group check in ``_refined_increment``
+        applies unchanged. A collision WITHIN the site (fewer flat values
+        than k x j combinations) means one entry is updated twice per
+        pass: the proof fails rather than under-counts."""
+        for a, b in (
+            (add_eqn.invars[0], add_eqn.invars[1]),
+            (add_eqn.invars[1], add_eqn.invars[0]),
+        ):
+            fa, va = self._peel(frame, a)
+            ea = fa.producers.get(va) if _is_var(va) else None
+            if ea is None or ea.primitive.name != "mul":
+                continue
+            low_radix = None
+            rem_var = None
+            for x, y in (
+                (ea.invars[0], ea.invars[1]),
+                (ea.invars[1], ea.invars[0]),
+            ):
+                yv = fa.read(y) if _is_var(y) else _from_concrete(y.val)
+                if yv.point is not None:
+                    low_radix = int(yv.point)
+                    rem_var = x
+                    break
+            if low_radix is None or low_radix <= 0 or rem_var is None:
+                continue
+            high = self._peel_rem(fa, rem_var)
+            low = self._peel_rem(frame, b)
+            if high is None or low is None:
+                continue
+            h_mod, h_base, h_ks = high
+            l_mod, l_base, l_ks = low
+            if l_mod != low_radix:
+                continue
+            flat = {
+                (kh % h_mod) * l_mod + (kl % l_mod)
+                for kh in h_ks
+                for kl in l_ks
+            }
+            if len(flat) != len(h_ks) * len(l_ks):
+                return None
+            return (
+                h_mod * l_mod,
+                tuple(sorted(h_base | l_base)),
+                tuple(sorted(flat)),
+            )
+        return None
 
     def _peel_add_terms(
         self, frame: _Frame, var: Any
@@ -1119,9 +1197,18 @@ def _refined_increment(interp: Interpreter) -> Optional[float]:
             hi = max(ev.t_hi for ev in events)
             if not math.isfinite(hi):
                 return None
-            # One update per entry per RING PASS; the enclosing context may
-            # run the pass more than once per call (an outer scan).
-            total += hi * max(ev.passes for ev in events)
+            # One update per entry per RING PASS. A site's k values
+            # enumerate exactly the scan iterations the pattern consumed
+            # (one per proven-disjoint slice), so executions / |k values|
+            # is the pass count of the scans OUTSIDE the pattern — the
+            # enclosing block loop for the flat ring, the top level for
+            # the two-radix hierarchical ring (whose k values already
+            # span BOTH loop levels; multiplying by the outer scan's
+            # trips would double-count its iterations).
+            passes = max(
+                -(-ev.trips // max(1, len(ev.pattern[3]))) for ev in events
+            )
+            total += hi * passes
         else:
             loose.extend(events)
     for ev in loose:
@@ -1398,14 +1485,63 @@ def ring_range_spec(
     )
 
 
+def hier_range_spec(
+    hosts: int,
+    devices_per_host: int,
+    num_samples: int,
+    block_size: int,
+    pack: bool,
+    exact_int: bool,
+    data: int = 1,
+) -> RangeKernelSpec:
+    """The hierarchical two-level ring under the same contracts as the
+    flat ring (``graftcheck ranges --topology H,D``). The per-dispatch
+    entry increment is refined by the TWO-RADIX disjoint-slice proof
+    (``Interpreter._peel_two_radix``): every update slice's owner index is
+    ``((h + k) mod H) * D + ((d + j) mod D)`` with the (k, j) pairs
+    pairwise distinct across the double loop, so one entry still takes
+    exactly ONE dot partial per pass and GR005 holds with the same
+    runtime projection the flat ring uses."""
+    from spark_examples_tpu.check.ir import hier_kernel_spec
+    from spark_examples_tpu.parallel.mesh import (
+        DATA_AXIS,
+        HOST_AXIS,
+        SAMPLES_AXIS,
+    )
+
+    ir_spec = hier_kernel_spec(
+        data, hosts, devices_per_host, num_samples, block_size, pack,
+        exact_int=exact_int,
+    )
+    contract = PACKED_BYTE if pack else HAS_VARIATION
+    flavor = "int8" if exact_int else "bf16"
+    return RangeKernelSpec(
+        name=f"ranges:{ir_spec.name}[{flavor}]",
+        build=ir_spec.build,
+        input_contracts=(None, contract),
+        axis_sizes={
+            DATA_AXIS: data,
+            HOST_AXIS: hosts,
+            SAMPLES_AXIS: devices_per_host,
+        },
+        rows_per_flush=data * block_size,
+        max_count=HAS_VARIATION.hi,
+        operand_window_dtype="int8" if exact_int else "bfloat16",
+        accum_dtype="int32" if exact_int else "float32",
+    )
+
+
 def default_specs(
     num_samples: int = 64,
     block_size: int = 8,
     meshes: Sequence[Tuple[int, int]] = DEFAULT_MESHES,
+    topologies: Sequence[Tuple[int, int]] = (),
 ) -> List[RangeKernelSpec]:
     """The shipped matrix: dense + counts per data-axis size, the ring
     kernel over every mesh shape × {packed, unpacked} × {int8, bf16}, and
-    the count-valued (same-set-join) unpacked ring per mesh shape."""
+    the count-valued (same-set-join) unpacked ring per mesh shape.
+    ``topologies`` append the hierarchical two-level kernel per declared
+    ``hosts,devices_per_host`` pair (packed × {int8, bf16})."""
     specs: List[RangeKernelSpec] = []
     for data in sorted({d for d, _ in meshes}):
         specs.append(dense_range_spec(data, num_samples, block_size))
@@ -1426,6 +1562,15 @@ def default_specs(
                 counts=True,
             )
         )
+    for hosts, per_host in topologies:
+        if hosts * per_host < 2:
+            continue
+        for exact_int in (True, False):
+            specs.append(
+                hier_range_spec(
+                    hosts, per_host, num_samples, block_size, True, exact_int
+                )
+            )
     return specs
 
 
@@ -1499,6 +1644,7 @@ __all__ = [
     "counts_range_spec",
     "default_specs",
     "dense_range_spec",
+    "hier_range_spec",
     "ring_range_spec",
     "run_audit",
 ]

@@ -386,6 +386,66 @@ HOSTMEM_RULES: Dict[str, Rule] = {
 }
 
 
+#: ``graftcheck sched`` rule catalogue (``check/sched.py``): schedule-level
+#: audits of the collective reduction on a DECLARED topology
+#: (``parallel/mesh.py:Topology`` — hosts x devices_per_host + per-link
+#: bandwidths, proven against before the pod exists). The schedule is
+#: extracted from the TRACED kernel jaxprs (every ppermute site with its
+#: bytes, trip counts, mesh axis, and overlap flag) and simulated per link
+#: class. GS findings anchor to a schedule subject name (line 0), like
+#: the GI rules.
+SCHED_RULES: Dict[str, Rule] = {
+    rule.id: rule
+    for rule in [
+        Rule(
+            "GS001",
+            "flat-ring-on-dcn",
+            "A flat ring is SELECTED on a multi-host topology: a ppermute "
+            "over one flat mesh axis carries no host-boundary structure, "
+            "so no hop is provably intra-host and the whole circulation "
+            "rides the slow inter-host link — past the hierarchical "
+            "schedule's proven DCN bound. Use --reduce-schedule hier (or "
+            "auto) when the samples axis spans hosts.",
+        ),
+        Rule(
+            "GS002",
+            "schedule-formula-mismatch",
+            "The per-level traffic simulated from the traced kernel's "
+            "schedule disagrees with the audited closed forms "
+            "(parallel/mesh.py:ring_traffic_bytes / "
+            "hierarchical_traffic_bytes) — telemetry, the manifest's "
+            "schedule block, and the plan validator no longer describe "
+            "the schedule the kernel executes.",
+        ),
+        Rule(
+            "GS003",
+            "overlap-hole",
+            "A link-bound schedule step has no concurrent compute proven "
+            "dependency-free of it in the jaxpr: the transfer adds to the "
+            "critical path instead of hiding behind the MXU — the "
+            "schedule-level generalization of GI001, applied to BOTH "
+            "levels of the hierarchical ring.",
+        ),
+        Rule(
+            "GS004",
+            "schedule-liveness-past-hbm",
+            "The schedule's static per-device peak liveness (buffer-"
+            "lifetime walk over the per-device shard_map body) exceeds "
+            "the HBM fraction budget — the schedule cannot run at this "
+            "geometry regardless of its traffic profile.",
+        ),
+        Rule(
+            "GS005",
+            "critical-path-past-budget",
+            "The predicted schedule-limited critical path (per-level link "
+            "time over the declared topology's bandwidths, overlap-aware) "
+            "exceeds the declared --sched-budget-seconds — the plan "
+            "cannot be proven to fit its time budget on this topology.",
+        ),
+    ]
+}
+
+
 #: ``graftcheck lockgraph`` rule catalogue (``check/lockgraph.py``): static
 #: lock-acquisition-order analysis of the threaded ingest/telemetry layer.
 #: GL findings anchor to real source lines, so the standard
@@ -433,6 +493,7 @@ ALL_RULES: Dict[str, Rule] = {
     **RULES,
     **IR_RULES,
     **RANGES_RULES,
+    **SCHED_RULES,
     **LOCK_RULES,
     **HOSTMEM_RULES,
 }
@@ -528,6 +589,7 @@ __all__ = [
     "RULES",
     "IR_RULES",
     "RANGES_RULES",
+    "SCHED_RULES",
     "LOCK_RULES",
     "HOSTMEM_RULES",
     "ALL_RULES",

@@ -35,16 +35,23 @@ traffic/liveness facts), ``graftcheck ranges`` (abstract-interpretation
 overflow & exactness prover over the same traced kernels: bf16/f32
 partials < 2^24, int32 accumulation < 2^31, lossy casts, declared input
 contracts from ``ops/contracts.py``, conversion-trigger conservativeness),
+``graftcheck sched`` (device-free collective-schedule prover: the
+communication schedule extracted from the traced kernel jaxprs, simulated
+per link class over a declared ``--topology hosts,devices_per_host`` —
+flat-ring vs hierarchical two-level ring traffic, overlap, liveness,
+critical-path budgets, for a pod that need not exist),
 ``graftcheck lockgraph`` (static lock-acquisition-order graph of the
 threaded ingest layer, DOT artifact), ``graftcheck hostmem`` (host-memory
 bound audit of the staging layers: O(file) paths must carry justified
 ``hostmem(unbounded)`` declarations), ``graftcheck plan`` (device-free
 flag/geometry/kernel-shape validation; ``--host-mem-budget`` enforces the
-static host-RAM bound, and exactness-window facts/rejections come from the
-ranges prover), ``graftcheck sanitize`` / ``graftcheck typecheck``:
+static host-RAM bound, exactness-window facts/rejections come from the
+ranges prover, and ``--topology``/``--sched-budget-seconds`` add the
+schedule proof), ``graftcheck sanitize`` / ``graftcheck typecheck``:
 
     python -m spark_examples_tpu graftcheck ir --json
     python -m spark_examples_tpu graftcheck ranges --json
+    python -m spark_examples_tpu graftcheck sched --topology 32,8
     python -m spark_examples_tpu graftcheck hostmem --json
     python -m spark_examples_tpu graftcheck lockgraph --dot lockorder.dot
 

@@ -469,6 +469,22 @@ def build_pca_parser(
         ),
     )
     parser.add_argument(
+        "--reduce-schedule",
+        choices=["auto", "flat", "hier"],
+        default="auto",
+        help=(
+            "Sharded-ring reduction schedule: 'flat' circulates tiles "
+            "around ONE ring over the whole samples axis; 'hier' runs the "
+            "two-level schedule (packed intra-host ring over ICI, "
+            "inter-host ring over DCN — one DCN hop hides behind a whole "
+            "inner ring) over the host-major factorization of the samples "
+            "axis. 'auto' (default) = hier iff the samples axis spans "
+            "more than one host. Same bytes, same results (byte-identical"
+            ", CI-asserted); the split of bytes across link classes is "
+            "what `graftcheck sched` proves per topology."
+        ),
+    )
+    parser.add_argument(
         "--check-ranges",
         action="store_true",
         help=(
@@ -548,6 +564,7 @@ class PcaConf(GenomicsConf):
     ingest: str = "auto"
     blocks_per_dispatch: Optional[int] = None
     ring_pack_bits: str = "auto"
+    reduce_schedule: str = "auto"
     check_ranges: bool = False
     exact_similarity: bool = False
     similarity_strategy: str = "auto"

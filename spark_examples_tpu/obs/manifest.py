@@ -39,7 +39,16 @@ so their manifests are self-describing next to PCA's: ``kind`` names the
 analysis, ``sites_tested`` the per-site rows it consumed, ``sites_kept``
 the surviving count for pruning analyses (null where keeping is not the
 analysis's question). Null on PCA runs, so existing consumers are
-untouched.
+untouched. Still v2 (additive): the optional ``schedule`` block —
+``{kind, hosts, devices_per_host, predicted_ring_bytes,
+measured_ring_bytes, predicted_ici_bytes, predicted_dcn_bytes}`` —
+present on sharded-strategy runs: which reduction schedule ran
+(``--reduce-schedule``: ``flat`` or ``hier``), its host-major topology
+factorization, and the STATIC ring-byte projection next to the
+per-flush-accounted total — the predicted-vs-measured pair ``bench.py``
+reports so BENCH rounds catch formula drift (``graftcheck sched`` proves
+the same formulas against the traced kernel jaxprs). Null on dense/host
+runs.
 
 Multi-host: under ``jax.distributed`` each process carries per-process
 I/O counters. :func:`build_run_manifest` aggregates them across processes
@@ -183,6 +192,7 @@ def build_manifest(
     gramian_exactness: Optional[Dict] = None,
     resume: Optional[Dict] = None,
     analysis: Optional[Dict] = None,
+    schedule: Optional[Dict] = None,
 ) -> Dict:
     """Assemble a manifest from already-snapshotted parts (the low-level
     form; :func:`build_run_manifest` snapshots a live driver). The
@@ -207,6 +217,7 @@ def build_manifest(
         "gramian_exactness": gramian_exactness,
         "resume": resume,
         "analysis": analysis,
+        "schedule": schedule,
         "compile_cache": _compile_cache_block(),
         "process": _process_block(),
         "multihost": multihost,
@@ -214,7 +225,8 @@ def build_manifest(
 
 
 def build_run_manifest(conf=None, spans=None, registry=None, io_stats=None,
-                       overlap=None, resume=None, analysis=None) -> Dict:
+                       overlap=None, resume=None, analysis=None,
+                       schedule=None) -> Dict:
     """Snapshot a live run: ``conf`` (dataclass or mapping), a
     :class:`~spark_examples_tpu.obs.spans.SpanRecorder`, a
     :class:`~spark_examples_tpu.obs.metrics.MetricsRegistry`, the driver's
@@ -253,6 +265,7 @@ def build_run_manifest(conf=None, spans=None, registry=None, io_stats=None,
         gramian_exactness=_gramian_exactness_block(registry),
         resume=resume,
         analysis=analysis,
+        schedule=schedule,
     )
 
 
@@ -405,6 +418,36 @@ def validate_manifest(doc) -> List[str]:
                     errors.append(
                         f"analysis.{field} is neither null nor a "
                         f"non-negative int: {value!r}"
+                    )
+
+    schedule = doc.get("schedule")
+    if schedule is not None:
+        if not isinstance(schedule, Mapping):
+            errors.append("'schedule' is neither null nor an object")
+        else:
+            kind = schedule.get("kind")
+            if kind not in ("flat", "hier"):
+                errors.append(
+                    f"schedule.kind is neither 'flat' nor 'hier': {kind!r}"
+                )
+            for field in (
+                "hosts",
+                "devices_per_host",
+                "predicted_ring_bytes",
+                "measured_ring_bytes",
+                "predicted_ici_bytes",
+                "predicted_dcn_bytes",
+            ):
+                value = schedule.get(field, "absent")
+                if (
+                    value == "absent"
+                    or not isinstance(value, int)
+                    or isinstance(value, bool)
+                    or value < 0
+                ):
+                    errors.append(
+                        f"schedule.{field} missing or not a non-negative "
+                        f"int: {value!r}"
                     )
 
     host_memory = doc.get("host_memory")

@@ -1240,6 +1240,33 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
             self.sites_capacity, self.samples_parallel, self.n_local, self.pack
         )
 
+    def schedule_block(self) -> dict:
+        """The manifest ``schedule`` block for the fused device-generation
+        ring — always the FLAT schedule (the hierarchical two-level
+        schedule currently serves the host-fed accumulators;
+        ``ops/gramian.py:build_hierarchical_update``). Unlike the
+        host-fed accumulator, this path has no independent per-flush
+        accounting: ``ring_bytes_total`` IS the closed-form projection
+        over dispatched capacity, so predicted == measured here by
+        construction and the pair's drift signal lives on the host-fed
+        side (``ShardedGramianAccumulator.schedule_block``)."""
+        from spark_examples_tpu.parallel.mesh import resolve_hier_hosts
+
+        try:
+            hosts = resolve_hier_hosts(self.samples_parallel)
+        except ValueError:
+            hosts = 1
+        predicted = int(self.ring_bytes_total)
+        return {
+            "kind": "flat",
+            "hosts": int(hosts),
+            "devices_per_host": int(self.samples_parallel // hosts),
+            "predicted_ring_bytes": predicted,
+            "measured_ring_bytes": predicted,
+            "predicted_ici_bytes": predicted if hosts == 1 else 0,
+            "predicted_dcn_bytes": 0 if hosts == 1 else predicted,
+        }
+
     def finalize_sharded(self) -> jax.Array:
         """(padded, padded) Gramian, row-sharded over ``samples`` — feeds
         the sharded centering/eigensolve without ever gathering N×N.

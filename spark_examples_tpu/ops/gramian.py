@@ -40,6 +40,17 @@ pattern; see DESIGN.md §7.4). ``--ring-pack-bits off`` keeps the unpacked
 wire as the bit-exact parity oracle. Host staging packs the same way before
 ``device_put``, so host→device transfer shrinks 8× too (the dense path's
 ``np.packbits`` trick, applied to the sharded staging buffer).
+
+At pod scale the ring grows a second SCHEDULE (``--reduce-schedule``): the
+hierarchical two-level ring (:func:`build_hierarchical_update`) factors
+the samples axis host-major into ``hosts x devices`` and runs a packed
+intra-host ring over ICI inside an inter-host ring over DCN, so one slow
+DCN hop hides behind a whole inner ring of ICI + MXU work and each host's
+columns cross DCN exactly once per pass — same bytes, same results
+(byte-identical, CI-asserted), provably-placed links. The schedule-level
+contracts (per-link traffic, overlap, liveness, critical path) are
+machine-proven device-free by ``graftcheck sched`` (``check/sched.py``,
+DESIGN.md §8.8) on declared topologies up to 32x8 — no pod required.
 """
 
 from __future__ import annotations
@@ -63,9 +74,14 @@ from spark_examples_tpu.ops.contracts import (
 )
 from spark_examples_tpu.parallel.mesh import (
     DATA_AXIS,
+    HOST_AXIS,
     SAMPLES_AXIS,
     device_put_global,
+    hierarchical_mesh,
+    hierarchical_traffic_bytes,
     padded_cohort,
+    resolve_hier_hosts,
+    resolve_reduce_schedule,
     ring_traffic_bytes,
 )
 
@@ -662,6 +678,138 @@ def _ring_tiles(G_local, X_cols, samples_axis: str, operand_dtype, packed=False)
     return dot_into(G_local, last, D - 1)
 
 
+def _hier_ring_tiles(
+    G_local, X_cols, host_axis: str, device_axis: str, operand_dtype,
+    packed=False,
+):
+    """One block's TWO-LEVEL ring update, executed per device inside
+    shard_map — the pod-scale sibling of :func:`_ring_tiles`.
+
+    The samples axis is factored host-major into ``hosts x devices``
+    (``parallel/mesh.py:hierarchical_mesh``), so the inner ring's
+    ``ppermute`` neighbors are intra-host (ICI) BY CONSTRUCTION and only
+    the outer ring crosses hosts (DCN):
+
+    - **inner ring** (per outer step): circulate the currently-held tile
+      around the host's ``D`` devices over ICI, double-buffered exactly
+      like the flat ring (permute for step j+1 issued before step j's dot);
+    - **outer ring**: circulate each device's OWN tile around the ``H``
+      hosts over DCN — issued BEFORE the inner ring consumes the current
+      host block, so the slow DCN transfer overlaps a whole inner ring's
+      ICI + MXU work, not one dot. Each host's columns cross DCN to every
+      other host exactly once (``H - 1`` outer permutes), against the flat
+      ring's ``S - 1`` lockstep steps each gated on its slowest edge.
+
+    Total permutes stay ``S - 1`` (``(H-1) + H x (D-1)``) and total bytes
+    stay ``ring_traffic_bytes``'s — the schedule moves the same data, it
+    just proves which link every byte rides (``check/sched.py``). At the
+    step (k, j) of the double loop this device holds the tile of device
+    ``((h + k) mod H, (d + j) mod D)``; the flat owner index drives the
+    same disjoint-slice accumulation the flat ring uses (one update per
+    Gramian entry per pass — the two-radix form ``graftcheck ranges``
+    proves disjoint).
+    """
+    H = axis_size(host_axis)
+    D = axis_size(device_axis)
+    h = lax.axis_index(host_axis)
+    d = lax.axis_index(device_axis)
+    n_local = X_cols.shape[1] * 8 if packed else X_cols.shape[1]
+
+    def unpack(tile):
+        return _unpack_bits(tile, n_local) if packed else tile
+
+    x_mine_t = unpack(X_cols).astype(operand_dtype).T  # (N_local, B)
+    if packed:
+        # One materialization feeding all S dots (see _ring_tiles).
+        x_mine_t = lax.optimization_barrier(x_mine_t)
+
+    def dot_into(G, tile, k, j):
+        # Owner of `tile`'s sample columns after k outer + j inner steps.
+        owner = ((h + k) % H) * D + ((d + j) % D)
+        t = jnp.matmul(
+            x_mine_t, unpack(tile).astype(operand_dtype),
+            preferred_element_type=G.dtype,
+        )  # (N_local, N_local)
+        # range: owner < H*D and owner * n_local < padded cohort << 2^31;
+        # explicit int32 so x64 tracing cannot promote the slice indices.
+        col = (owner * n_local).astype(jnp.int32)
+        zero = jnp.int32(0)
+        return lax.dynamic_update_slice(
+            G,
+            lax.dynamic_slice(G, (zero, col), (n_local, n_local)) + t,
+            (zero, col),
+        )
+
+    perm_d = [((p + 1) % D, p) for p in range(D)]
+
+    def inner_ring(G, block, k):
+        if D == 1:
+            return dot_into(G, block, k, 0)
+
+        def body(j, carry):
+            G, cur = carry
+            # Step j+1's ICI transfer first; the dot shares no dependency.
+            nxt = lax.ppermute(cur, device_axis, perm_d)
+            return dot_into(G, cur, k, j), nxt
+
+        G, last = lax.fori_loop(0, D - 1, body, (G, block))
+        return dot_into(G, last, k, D - 1)
+
+    if H == 1:
+        # Degenerate topology: the two-level schedule IS the flat ring.
+        return inner_ring(G_local, X_cols, 0)
+    perm_h = [((p + 1) % H, p) for p in range(H)]
+
+    def outer_body(k, carry):
+        G, cur = carry
+        # Host block k+1's DCN transfer is issued before the inner ring
+        # consumes block k — the whole inner ring hides one DCN hop.
+        nxt = lax.ppermute(cur, host_axis, perm_h)
+        return inner_ring(G, cur, k), nxt
+
+    G_local, last = lax.fori_loop(0, H - 1, outer_body, (G_local, X_cols))
+    return inner_ring(G_local, last, H - 1)
+
+
+def build_hierarchical_update(mesh, operand_dtype, packed: bool = False,
+                              g_spec=None, x_spec=None):
+    """The jitted two-level (ICI ring + DCN ring) Gramian update for a
+    hierarchical ``data x hosts x samples`` mesh
+    (``parallel/mesh.py:hierarchical_mesh``) — the runtime constructor the
+    schedule prover (``check/sched.py``), the IR auditor, and the range
+    prover all trace, exactly like :func:`build_sharded_update` for the
+    flat ring. Works with a concrete ``Mesh`` or an ``AbstractMesh``.
+
+    The default specs shard G rows (and X columns) over ``(hosts,
+    samples)`` jointly — the SAME per-device layout as the flat ring's
+    ``samples`` sharding over ``H x D`` devices, so a flat-ring
+    accumulator can swap schedules without touching its staging,
+    checkpoint, or finalize paths (byte-identical results, CI-asserted).
+    """
+    data_axis = DATA_AXIS if DATA_AXIS in mesh.shape else None
+    if g_spec is None:
+        g_spec = P(data_axis, (HOST_AXIS, SAMPLES_AXIS), None)
+    if x_spec is None:
+        x_spec = P(data_axis, None, (HOST_AXIS, SAMPLES_AXIS))
+
+    @jax.jit
+    def update(G, X):  # graftcheck: disable=GC005 -- same non-donation policy as build_sharded_update's update (measured ~10x throughput loss from donated-buffer serialization); graftcheck ir cross-checks this disable against the traced donated_invars (GI002).
+        def per_slice(G_local, X_local):
+            return _hier_ring_tiles(
+                G_local[0], X_local[0], HOST_AXIS, SAMPLES_AXIS,
+                operand_dtype, packed=packed,
+            )[None]
+
+        return shard_map(
+            per_slice,
+            mesh=mesh,
+            in_specs=(g_spec, x_spec),
+            out_specs=g_spec,
+        )(G, X)
+
+    return update
+
+
 def build_sharded_update(mesh, operand_dtype, packed: bool = False,
                          g_spec=None, x_spec=None):
     """The jitted ring-exchange Gramian update for ``mesh``.
@@ -726,6 +874,8 @@ class ShardedGramianAccumulator:
         spans=None,
         pack_bits: str = "auto",
         check_ranges: bool = False,
+        reduce_schedule: str = "auto",
+        hier_hosts: Optional[int] = None,
     ):
         self.telemetry = _AccumulatorTelemetry(registry, spans, "sharded")
         self.check_ranges = bool(check_ranges)
@@ -737,6 +887,33 @@ class ShardedGramianAccumulator:
         self.pack = resolve_ring_pack(pack_bits)
         self.samples_parallel = mesh.shape[SAMPLES_AXIS]
         self.data_parallel = mesh.shape.get(DATA_AXIS, 1)
+        # --reduce-schedule: the flat ring, or the two-level hierarchical
+        # schedule over the host-major factorization (auto = hier iff the
+        # samples axis spans more than one host). Everything OUTSIDE the
+        # update kernel — G, staging, checkpointing, finalize — is
+        # schedule-independent: the hierarchical mesh shards the same rows
+        # over the same devices in the same order, so swapping schedules
+        # changes which links the tiles ride and nothing else
+        # (byte-identical results, CI-asserted).
+        resolve_reduce_schedule(reduce_schedule, 1)  # validate the spelling
+        try:
+            self.hier_hosts = resolve_hier_hosts(
+                self.samples_parallel, hier_hosts
+            )
+        except ValueError:
+            if reduce_schedule == "hier":
+                raise  # an explicit hier request must not silently degrade
+            # auto/flat: a non-dividing host factor just means no
+            # hierarchical factorization exists — the flat ring runs.
+            self.hier_hosts = 1
+        self.reduce_schedule = resolve_reduce_schedule(
+            reduce_schedule, self.hier_hosts
+        )
+        self._hier_mesh = (
+            hierarchical_mesh(mesh, self.hier_hosts)
+            if self.reduce_schedule == "hier"
+            else None
+        )
         # Cohort padding: a multiple of the samples axis (equal column tiles
         # per device) and, under the packed wire format, of 8× that (every
         # device's tile a whole number of bytes — the pack-width invariant).
@@ -782,9 +959,65 @@ class ShardedGramianAccumulator:
         )
 
     def _build_update(self, operand_dtype, packed: bool = False):
+        if self._hier_mesh is not None:
+            # The hierarchical specs name the factored axes; G/X keep their
+            # flat-mesh shardings (identical device layout — the jit sees
+            # the same HloSharding, so no reshard happens at the boundary).
+            data_axis = (
+                DATA_AXIS if DATA_AXIS in self._hier_mesh.shape else None
+            )
+            return build_hierarchical_update(
+                self._hier_mesh,
+                operand_dtype,
+                packed,
+                P(data_axis, (HOST_AXIS, SAMPLES_AXIS), None),
+                P(data_axis, None, (HOST_AXIS, SAMPLES_AXIS)),
+            )
         return build_sharded_update(
             self.mesh, operand_dtype, packed, self._g_spec, self._x_spec
         )
+
+    def schedule_block(self) -> dict:
+        """The run manifest's ``schedule`` block: which reduction schedule
+        ran, its topology factorization, the STATIC per-flush projection of
+        ring bytes next to the per-flush-accounted total — the
+        predicted-vs-measured pair ``bench.py`` reports so BENCH rounds
+        catch formula drift (a counts-fallback flush or a wire-format
+        change moves ``measured`` away from ``predicted``)."""
+        capacity_rows = self.data_parallel * self.block_size
+        per_flush = ring_traffic_bytes(
+            capacity_rows, self.samples_parallel, self.n_local, self.pack
+        )
+        predicted = per_flush * self._flushes
+        if self.reduce_schedule == "hier":
+            level = hierarchical_traffic_bytes(
+                capacity_rows,
+                self.hier_hosts,
+                self.samples_parallel // self.hier_hosts,
+                self.n_local,
+                self.pack,
+            )
+            ici, dcn = (
+                level.ici_bytes * self._flushes,
+                level.dcn_bytes * self._flushes,
+            )
+        elif self.hier_hosts == 1:
+            ici, dcn = predicted, 0
+        else:
+            # Flat ring spanning hosts: no byte is provably intra-host
+            # (parallel/mesh.py:flat_traffic_split) — the GS001 premise.
+            ici, dcn = 0, predicted
+        return {
+            "kind": self.reduce_schedule,
+            "hosts": int(self.hier_hosts),
+            "devices_per_host": int(
+                self.samples_parallel // self.hier_hosts
+            ),
+            "predicted_ring_bytes": int(predicted),
+            "measured_ring_bytes": int(self.ring_bytes_total),
+            "predicted_ici_bytes": int(ici),
+            "predicted_dcn_bytes": int(dcn),
+        }
 
     def add_rows(self, rows: np.ndarray) -> None:
         rows = np.asarray(rows, dtype=np.uint8)
@@ -875,6 +1108,10 @@ class ShardedGramianAccumulator:
             "num_samples": self.num_samples,
             "data_parallel": self.data_parallel,
             "padded": self._padded,
+            # Ring accounting rides along so a resumed run's manifest
+            # schedule block keeps predicted == measured (both count the
+            # pre-crash flushes); absent in old artifacts -> 0.
+            "ring_bytes_total": self.ring_bytes_total,
         }
 
     def restore_state(self, checkpoint: dict) -> None:
@@ -910,6 +1147,7 @@ class ShardedGramianAccumulator:
         self._entry_bound = int(meta["entry_bound"])
         self.rows_seen = int(meta["rows_seen"])
         self._flushes = int(meta["flushes"])
+        self.ring_bytes_total = int(meta.get("ring_bytes_total", 0))
 
     def finalize(self) -> np.ndarray:
         self._flush()
@@ -984,6 +1222,7 @@ def gramian_reference(rows: np.ndarray) -> np.ndarray:
 __all__ = [
     "GramianAccumulator",
     "ShardedGramianAccumulator",
+    "build_hierarchical_update",
     "build_sharded_update",
     "data_axis_sum",
     "gramian_reference",

@@ -44,7 +44,8 @@ from __future__ import annotations
 
 import functools
 import os
-from typing import Dict, Optional, Sequence
+from dataclasses import dataclass
+from typing import Dict, NamedTuple, Optional, Sequence
 
 import jax
 import numpy as np
@@ -52,8 +53,20 @@ from jax.sharding import Mesh
 
 DATA_AXIS = "data"
 SAMPLES_AXIS = "samples"
+#: Outer axis of the hierarchical (two-level) reduction mesh: the samples
+#: axis factored host-major into ``hosts x samples``, so the inner ring's
+#: ``ppermute`` neighbors are intra-host (ICI) BY CONSTRUCTION and only the
+#: outer ring crosses hosts (DCN). See :func:`hierarchical_mesh`.
+HOST_AXIS = "hosts"
 
 PLATFORM_ENV = "SPARK_EXAMPLES_TPU_PLATFORM"
+
+#: Test/rehearsal override for the hierarchical schedule's host factor
+#: (``resolve_hier_hosts``): lets a single-process run with virtual CPU
+#: devices exercise a REAL two-level schedule (e.g. 2 "hosts" x 2 devices
+#: on 4 virtual devices — the ci.sh hier smoke), the same trick
+#: ``SPARK_EXAMPLES_TPU_PLATFORM`` plays for the multihost rehearsal.
+HIER_HOSTS_ENV = "SPARK_EXAMPLES_TPU_HIER_HOSTS"
 
 #: Genotypes per byte on the packed ring wire (np.packbits bit order). The
 #: pack-width invariant follows from it: every device's local column width
@@ -101,6 +114,203 @@ def ring_traffic_bytes(
         int(n_local) // RING_PACK_MULTIPLE if packed else int(n_local)
     )
     return int(rows) * int(samples_parallel) * (int(samples_parallel) - 1) * width
+
+
+# --------------------------------------------------------------------------
+# Topology & the hierarchical (two-level) reduction schedule.
+# --------------------------------------------------------------------------
+
+#: Default per-link bandwidths for the device-free schedule simulator
+#: (``check/sched.py``). ICI: one v5e ring link sustains ~100 GB/s/chip
+#: bidirectional (the packed ring moves one tile per step per link); DCN:
+#: a v5e host NIC is ~25 GB/s aggregate and is SHARED by the host's chips.
+#: Deliberately round, clearly-labeled planning numbers — the simulator's
+#: job is comparing schedules and proving budgets, not cycle accuracy; a
+#: ~2x bandwidth error never flips the flat-vs-hier ordering the GS rules
+#: enforce (the byte SPLIT is exact, only seconds scale).
+DEFAULT_ICI_BYTES_PER_S = 100 * 10**9
+DEFAULT_DCN_BYTES_PER_S = 25 * 10**9
+
+
+@dataclass(frozen=True)
+class Topology:
+    """A pod-shaped device fleet the schedule prover plans against:
+    ``hosts`` machines x ``devices_per_host`` chips, intra-host links at
+    ``ici_bytes_per_s`` per chip, one shared ``dcn_bytes_per_s`` NIC per
+    host. Entirely declarative — a topology is proven against BEFORE the
+    pod exists (``graftcheck sched --topology 32,8``), exactly like
+    ``--plan-devices`` declares a device count the validator never
+    queries."""
+
+    hosts: int
+    devices_per_host: int
+    ici_bytes_per_s: int = DEFAULT_ICI_BYTES_PER_S
+    dcn_bytes_per_s: int = DEFAULT_DCN_BYTES_PER_S
+
+    def __post_init__(self) -> None:
+        if self.hosts < 1 or self.devices_per_host < 1:
+            raise ValueError(
+                f"topology needs hosts >= 1 and devices_per_host >= 1, got "
+                f"{self.hosts}x{self.devices_per_host}"
+            )
+        if self.ici_bytes_per_s <= 0 or self.dcn_bytes_per_s <= 0:
+            raise ValueError("topology link bandwidths must be positive")
+
+    @property
+    def devices(self) -> int:
+        return self.hosts * self.devices_per_host
+
+    def describe(self) -> str:
+        return f"{self.hosts}x{self.devices_per_host}"
+
+
+def parse_topology(spec: str) -> Topology:
+    """Parse the ``--topology`` flag: ``'hosts,devices_per_host'``
+    (e.g. ``'32,8'`` for a v5e-256-class pod)."""
+    parts = [p for p in spec.split(",") if p.strip()]
+    if len(parts) != 2:
+        raise ValueError(
+            f"--topology expects 'hosts,devices_per_host', got {spec!r}"
+        )
+    try:
+        hosts, per_host = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"--topology expects integer 'hosts,devices_per_host', got "
+            f"{spec!r}"
+        ) from None
+    return Topology(hosts, per_host)
+
+
+class LevelTraffic(NamedTuple):
+    """Per-link-class bytes of one reduction schedule (whole mesh, one
+    pass over ``rows``). ``ici_bytes`` ride intra-host links; ``dcn_bytes``
+    ride the inter-host network. The split is the schedule's PROVABLE
+    placement: bytes the schedule structure pins to a link class."""
+
+    ici_bytes: int
+    dcn_bytes: int
+
+    @property
+    def total(self) -> int:
+        return self.ici_bytes + self.dcn_bytes
+
+
+def hierarchical_traffic_bytes(
+    rows: int,
+    hosts: int,
+    devices_per_host: int,
+    n_local: int,
+    packed: bool,
+) -> LevelTraffic:
+    """Per-level bytes of the two-level schedule — the sibling of
+    :func:`ring_traffic_bytes`, split by link class.
+
+    Per device and flush of ``rows`` rows: the inner packed ring sends the
+    currently-held tile ``devices_per_host - 1`` times per outer step over
+    ICI (``hosts`` outer steps, the seed included), and the outer ring
+    sends it ``hosts - 1`` times over DCN — each host's columns cross DCN
+    to every other host exactly ONCE, the information-theoretic floor for
+    an all-to-all tile exchange. Total bytes equal the flat ring's
+    (``S x (S-1)`` sends of the same tile, ``S = hosts x
+    devices_per_host``): the hierarchical schedule moves the SAME bytes,
+    it just proves where they ride. ``graftcheck sched`` (GS002)
+    cross-validates both numbers against the bytes the traced kernel
+    jaxprs actually move, per axis."""
+    h, d = int(hosts), int(devices_per_host)
+    width = int(n_local) // RING_PACK_MULTIPLE if packed else int(n_local)
+    per_send = int(rows) * width
+    devices = h * d
+    return LevelTraffic(
+        ici_bytes=per_send * devices * h * (d - 1),
+        dcn_bytes=per_send * devices * (h - 1),
+    )
+
+
+def flat_traffic_split(
+    rows: int, topology: Topology, n_local: int, packed: bool
+) -> LevelTraffic:
+    """The flat ring's provable per-level split on ``topology``.
+
+    A flat ``ppermute`` over ONE mesh axis carries no host-boundary
+    structure: which of its ``S - 1`` lockstep hops cross hosts is a
+    property of the runtime device assignment, not of the schedule — so on
+    a multi-host topology NO byte can be proven intra-host, and the sound
+    bound attributes the whole circulation to the slow link. That
+    unprovability is exactly what GS001 flags (and the hierarchical
+    schedule fixes by construction: its inner axis is intra-host by the
+    host-major mesh factorization). On one host everything is ICI."""
+    total = ring_traffic_bytes(
+        rows, topology.devices, n_local, packed
+    )
+    if topology.hosts == 1:
+        return LevelTraffic(ici_bytes=total, dcn_bytes=0)
+    return LevelTraffic(ici_bytes=0, dcn_bytes=total)
+
+
+def resolve_reduce_schedule(spec: str, hosts: int) -> str:
+    """``--reduce-schedule`` -> the schedule the run builds: ``flat`` (one
+    ring over the whole samples axis), ``hier`` (packed intra-host ring
+    over ICI + inter-host ring over DCN), or ``auto`` = ``hier`` iff the
+    samples axis spans more than one host (single-host rings have no slow
+    link to avoid — the flat ring IS the hierarchical schedule at
+    hosts=1). ONE resolution rule, shared by the accumulator, the plan
+    validator, and ``graftcheck sched``."""
+    if spec not in ("auto", "flat", "hier"):
+        raise ValueError(
+            f"--reduce-schedule must be one of auto/flat/hier, got {spec!r}"
+        )
+    if spec == "auto":
+        return "hier" if int(hosts) > 1 else "flat"
+    return spec
+
+
+def resolve_hier_hosts(
+    samples_parallel: int, explicit: Optional[int] = None
+) -> int:
+    """The host factor of the hierarchical mesh factorization: explicit
+    argument, else the :data:`HIER_HOSTS_ENV` rehearsal override, else the
+    real process count. Must divide the samples axis (each host contributes
+    an equal slice of the ring — the host-major factorization's invariant);
+    a non-dividing factor fails loudly instead of silently skewing the
+    schedule."""
+    if explicit is None:
+        env = os.environ.get(HIER_HOSTS_ENV)
+        if env:
+            explicit = int(env)
+    hosts = int(explicit) if explicit is not None else jax.process_count()
+    hosts = max(1, hosts)
+    if int(samples_parallel) % hosts:
+        raise ValueError(
+            f"hierarchical schedule needs the host factor ({hosts}) to "
+            f"divide the samples axis ({samples_parallel}); choose a mesh "
+            "whose samples axis is a multiple of the host count"
+        )
+    return hosts
+
+
+def hierarchical_mesh(mesh: Mesh, hosts: int) -> Mesh:
+    """Factor a ``data x samples`` run mesh into the host-major
+    ``data x hosts x samples`` hierarchical mesh (same devices, same
+    order). The samples axis is the FAST axis of every run mesh
+    (:func:`make_mesh` reshapes device-id order, which is process-major),
+    so consecutive samples-axis slots are co-hosted and the reshape's
+    outer factor groups whole hosts — the inner ring's neighbors stay
+    intra-host by construction, which is the property the schedule prover
+    certifies (``check/sched.py``)."""
+    if SAMPLES_AXIS not in mesh.shape:
+        raise ValueError(f"mesh must have a {SAMPLES_AXIS!r} axis")
+    samples = mesh.shape[SAMPLES_AXIS]
+    hosts = int(hosts)
+    if samples % hosts:
+        raise ValueError(
+            f"host factor {hosts} does not divide samples axis {samples}"
+        )
+    data = mesh.shape.get(DATA_AXIS, 1)
+    grid = np.asarray(mesh.devices).reshape(
+        data, hosts, samples // hosts
+    )
+    return Mesh(grid, (DATA_AXIS, HOST_AXIS, SAMPLES_AXIS))
 
 
 #: Fixed host-RSS overhead of the process itself — interpreter, jax/jaxlib
@@ -430,12 +640,24 @@ def resolve_run_mesh(
 
 __all__ = [
     "DATA_AXIS",
+    "HOST_AXIS",
     "SAMPLES_AXIS",
     "PLATFORM_ENV",
+    "HIER_HOSTS_ENV",
     "RING_PACK_MULTIPLE",
     "HOST_RUNTIME_BASELINE_BYTES",
+    "DEFAULT_ICI_BYTES_PER_S",
+    "DEFAULT_DCN_BYTES_PER_S",
+    "LevelTraffic",
+    "Topology",
+    "parse_topology",
     "padded_cohort",
     "ring_traffic_bytes",
+    "hierarchical_traffic_bytes",
+    "flat_traffic_split",
+    "resolve_reduce_schedule",
+    "resolve_hier_hosts",
+    "hierarchical_mesh",
     "host_peak_bytes",
     "apply_platform_override",
     "distributed_init",

@@ -161,6 +161,10 @@ class VariantsPcaDriver:
         # milliseconds instead of after a re-ingest pass. The feeder is
         # created lazily around the run's accumulator (_wrap_accumulator).
         self.feeder = None
+        # The manifest's ``schedule`` block (reduction-schedule kind +
+        # predicted-vs-measured ring bytes), stashed from the sharded
+        # accumulator when one runs; None on dense/host runs.
+        self._sched_block: Optional[Dict] = None
         self._gramian_resume: Optional[Dict] = None
         self._ckpt_fingerprint = ""
         if getattr(conf, "gramian_checkpoint_dir", None) or getattr(
@@ -495,6 +499,9 @@ class VariantsPcaDriver:
                 registry=self.registry, spans=self.spans,
                 pack_bits=getattr(self.conf, "ring_pack_bits", "auto"),
                 check_ranges=check_ranges,
+                reduce_schedule=getattr(
+                    self.conf, "reduce_schedule", "auto"
+                ),
             )
         else:
             acc = GramianAccumulator(
@@ -521,6 +528,7 @@ class VariantsPcaDriver:
         # remains row-tile-sharded (padded) for the sharded PCA stage.
         if isinstance(acc, GramianAccumulator):
             return acc.finalize_device()
+        self._sched_block = acc.schedule_block()
         return acc.finalize_sharded()
 
     def get_similarity_rows(
@@ -555,6 +563,9 @@ class VariantsPcaDriver:
                 registry=self.registry, spans=self.spans,
                 pack_bits=getattr(self.conf, "ring_pack_bits", "auto"),
                 check_ranges=check_ranges,
+                reduce_schedule=getattr(
+                    self.conf, "reduce_schedule", "auto"
+                ),
             )
         else:
             acc = GramianAccumulator(
@@ -573,6 +584,7 @@ class VariantsPcaDriver:
         self._finish_checkpointing()
         if isinstance(acc, GramianAccumulator):
             return acc.finalize_device()
+        self._sched_block = acc.schedule_block()
         return acc.finalize_sharded()
 
     def get_similarity_device_gen(self, contigs) -> "object":
@@ -608,6 +620,17 @@ class VariantsPcaDriver:
             else auto_blocks_per_dispatch(len(self.indexes), conf.block_size)
         )
         use_ring = self._resolve_sharded(None, mesh)
+        if use_ring and getattr(conf, "reduce_schedule", "auto") == "hier":
+            # The fused device-generation ring pins the flat schedule (the
+            # hierarchical kernel serves the host-fed accumulators today —
+            # ROADMAP item 2); an explicit hier request must not silently
+            # degrade, same policy as the accumulator's host-factor check.
+            raise ValueError(
+                "--reduce-schedule hier is not available for --ingest "
+                "device (the fused generation ring runs the flat "
+                "schedule); use --ingest packed or wire, or leave the "
+                "schedule on auto"
+            )
         if use_ring and len(conf.variant_set_id) > 1:
             # Sharded multi-set: the joint cohort's concatenated per-set
             # column blocks ride the same ring kernel (the join/merge
@@ -738,6 +761,7 @@ class VariantsPcaDriver:
         if use_ring:
             # Row-sharded (padded) result; compute_pca routes to the sharded
             # centering/eigensolve from its NamedSharding.
+            self._sched_block = acc.schedule_block()
             result = acc.finalize_sharded()
         else:
             result = acc.finalize_device()
@@ -1200,6 +1224,7 @@ def run_pipeline(conf: PcaConf, similarity_only: bool = False) -> PipelineResult
             io_stats=driver.io_stats,
             overlap=driver._overlap,
             resume=resume_block,
+            schedule=driver._sched_block,
         )
         if conf.metrics_json:
             try:

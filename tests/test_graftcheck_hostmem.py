@@ -319,7 +319,10 @@ def test_shipped_tree_audits_clean_with_declared_inventory():
     # paths ISSUE/ROADMAP name must be on it.
     declared_paths = {d.path for d in report.declared}
     assert "sources/files.py" in declared_paths
-    assert "pipeline/checkpoint.py" in declared_paths
+    # The checkpoint resume path's O(part) compute list was RETIRED
+    # (CheckpointDataset.compute streams through iter_part's bounded
+    # window) — a regression re-adding an O(file) site there must fail.
+    assert "pipeline/checkpoint.py" not in declared_paths
     assert all(d.justification for d in report.declared)
 
 

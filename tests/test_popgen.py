@@ -670,9 +670,12 @@ class TestPlanEntries:
             validate_plan,
         )
 
-        conf, devices, _json, budget, analysis = parse_plan_args(argv)
+        conf, devices, _json, budget, analysis, topology, sched_budget = (
+            parse_plan_args(argv)
+        )
         return validate_plan(
-            conf, devices, host_mem_budget=budget, analysis=analysis
+            conf, devices, host_mem_budget=budget, analysis=analysis,
+            topology=topology, sched_budget_seconds=sched_budget,
         )
 
     def test_accepts_each_analysis(self, tmp_path):
