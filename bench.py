@@ -161,6 +161,132 @@ ANALYSIS_CONFIGS = {
 }
 
 
+# ------------------------------------------------------------ serve bench
+# The serving layer under mixed traffic: an in-process resident service
+# (serve/) with executor slices, driven through the REAL HTTP API by
+# concurrent submitters. Reports P50/P99 per admission class in two
+# phases — small jobs alone (unloaded), then small jobs while a large job
+# holds the large slice (loaded) — so the number that matters to users
+# ("does a cheap query stall behind a whole-genome run?") is measured,
+# not argued. ci.sh asserts loaded small P99 <= ~2x unloaded.
+
+SERVE_LOAD_SMALL_FLAGS = ["--num-samples", "8", "--references", "1:0:50000"]
+SERVE_LOAD_LARGE_FLAGS = [
+    "--num-samples",
+    "16",
+    "--references",
+    "1:0:2500000",
+]
+#: Classify the large-phase job as LARGE without waiting minutes on CPU:
+#: the limit sits between the small (~500 sites) and large (~25k sites)
+#: shapes above.
+SERVE_LOAD_SITE_LIMIT = 5_000
+SERVE_LOAD_SMALL_JOBS = 12
+
+
+def _percentile(values, fraction: float) -> float:
+    ordered = sorted(values)
+    index = min(len(ordered) - 1, int(round(fraction * (len(ordered) - 1))))
+    return ordered[index]
+
+
+def _serve_load_phase(client, jobs: int) -> list:
+    """Submit ``jobs`` small jobs one after another (a poller's view:
+    submit -> terminal), returning per-job wall seconds."""
+    latencies = []
+    for _ in range(jobs):
+        t0 = time.perf_counter()
+        doc = client.submit(SERVE_LOAD_SMALL_FLAGS)
+        job = client.wait(doc["job"]["id"], timeout=300, poll_cap_seconds=0.1)
+        if job["job"]["status"] != "done":
+            raise RuntimeError(f"serve-load small job failed: {job}")
+        latencies.append(time.perf_counter() - t0)
+    return latencies
+
+
+def _run_serve_load_config(device) -> dict:
+    import tempfile
+
+    import jax
+
+    from spark_examples_tpu.serve.client import ServeClient
+    from spark_examples_tpu.serve.daemon import PcaService
+    from spark_examples_tpu.serve.http import start_server
+
+    device_count = len(jax.devices())
+    run_dir = tempfile.mkdtemp(prefix="serve_load_")
+    service = PcaService(
+        run_dir=run_dir,
+        small_slices=None,  # auto: 1 small slice when a device is spare
+        small_site_limit=SERVE_LOAD_SITE_LIMIT,
+    ).start()
+    server = start_server(service)
+    client = ServeClient(server.url)
+    sliced = len(service._workers) > 1
+    try:
+        # Warmup: compile the small geometry once (cold compile is the
+        # daemon's startup cost, not a steady-state latency).
+        warm = client.submit(SERVE_LOAD_SMALL_FLAGS)
+        client.wait(warm["job"]["id"], timeout=300, poll_cap_seconds=0.1)
+
+        unloaded = _serve_load_phase(client, SERVE_LOAD_SMALL_JOBS)
+
+        large_doc = client.submit(SERVE_LOAD_LARGE_FLAGS)
+        large_id = large_doc["job"]["id"]
+        if large_doc["job"]["class"] != "large":
+            raise RuntimeError(
+                f"serve-load large job classified {large_doc['job']['class']}"
+            )
+        t_large = time.perf_counter()
+        loaded = _serve_load_phase(client, SERVE_LOAD_SMALL_JOBS)
+        large = client.wait(large_id, timeout=600, poll_cap_seconds=0.2)
+        large_seconds = time.perf_counter() - t_large
+        if large["job"]["status"] != "done":
+            raise RuntimeError(f"serve-load large job failed: {large}")
+        health = client.healthz()
+    finally:
+        server.shutdown()
+        service.stop(timeout=60)
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+    unloaded_p99 = _percentile(unloaded, 0.99)
+    loaded_p99 = _percentile(loaded, 0.99)
+    ratio = loaded_p99 / unloaded_p99 if unloaded_p99 > 0 else None
+    return {
+        "metric": (
+            "small-job P99 under concurrent large-job load vs unloaded "
+            "(resident service, executor slices)"
+        ),
+        "value": round(ratio, 3) if ratio is not None else None,
+        "unit": "x",
+        "vs_baseline": None,
+        "details": {
+            "devices": device_count,
+            "slices": [
+                {"name": s["name"], "devices": s["devices"]}
+                for s in health["slices"]
+            ],
+            "sliced": sliced,
+            "small_jobs_per_phase": SERVE_LOAD_SMALL_JOBS,
+            "small_unloaded_seconds": {
+                "p50": round(_percentile(unloaded, 0.5), 4),
+                "p99": round(unloaded_p99, 4),
+            },
+            "small_loaded_seconds": {
+                "p50": round(_percentile(loaded, 0.5), 4),
+                "p99": round(loaded_p99, 4),
+            },
+            "large_job_seconds": round(
+                large["job"]["seconds"] or large_seconds, 3
+            ),
+            "loaded_over_unloaded_p99": (
+                round(ratio, 3) if ratio is not None else None
+            ),
+            "device": str(device),
+        },
+    }
+
+
 def _write_bench_phenotypes(path: str, conf) -> None:
     """A balanced case/control TSV over the synthetic cohort's real
     callset names (the assoc verb's strict both-ways coverage check)."""
@@ -653,7 +779,9 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--config",
-        choices=sorted(CONFIGS) + ["ingest"] + sorted(ANALYSIS_CONFIGS),
+        choices=sorted(CONFIGS)
+        + ["ingest", "serve-load"]
+        + sorted(ANALYSIS_CONFIGS),
         default=None,
         help=(
             "Run ONE benchmark config (PCA device configs, 'ingest', or an "
@@ -677,6 +805,8 @@ def main() -> None:
         with contextlib.redirect_stdout(sys.stderr):
             if args.config == "ingest":
                 payload = _run_ingest_config(device)
+            elif args.config == "serve-load":
+                payload = _run_serve_load_config(device)
             elif args.config in ANALYSIS_CONFIGS:
                 payload = _run_analysis_config(args.config, device)
             else:

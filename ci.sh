@@ -63,6 +63,18 @@
 #      compile-cache hit (hit counter >= 1 in /metrics), and SIGTERM
 #      drains gracefully: the in-flight job finishes, new jobs get 503,
 #      the daemon exits 0.
+#   5b. serve concurrency smoke — the executor-slice daemon on 4 virtual
+#      CPU devices (--executor-slices 1): a small job (via the
+#      `submit --wait` verb) completes WHILE a large job is still on the
+#      large slice (no head-of-line blocking); a second large job queued
+#      mid-run survives `kill -9` of the daemon — the restarted daemon
+#      replays the job journal, finishes the queued job, fails the
+#      mid-device job with a structured daemon-restarted error, and
+#      serves a repeat-geometry job warm from the run-dir persistent
+#      state. Then the serve-load harness (bench.py --config serve-load)
+#      drives mixed traffic through the HTTP API and asserts small-job
+#      P99 under concurrent large-job load stays within ~2x its unloaded
+#      P99 and below the large job's wall-clock.
 #   6. faults — the robustness smoke, CPU-pinned: an oracle run, the same
 #      run SIGKILLed by a deterministic fault plan at the
 #      checkpoint.post-save kill-point (exit must be 137), then
@@ -627,6 +639,164 @@ if [ "$serve_rc" -ne 0 ]; then
 fi
 rm -rf "$SERVE_TMP"
 
+echo "== serve concurrency smoke (slices, journal replay, warm restart, load) =="
+sc_rc=0
+SC_TMP=$(mktemp -d)
+sc_daemon() {
+  rm -f "$SC_TMP/endpoint"
+  env JAX_PLATFORMS=cpu SPARK_EXAMPLES_TPU_NO_CACHE=1 \
+      XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    python -m spark_examples_tpu serve --port 0 \
+      --run-dir "$SC_TMP/run" --endpoint-file "$SC_TMP/endpoint" \
+      --executor-slices 1 --serve-small-site-limit 5000 \
+      >> "$SC_TMP/daemon.out" 2>> "$SC_TMP/daemon.err" &
+  SC_PID=$!
+  for _ in $(seq 1 150); do [ -f "$SC_TMP/endpoint" ] && break; sleep 0.2; done
+  [ -f "$SC_TMP/endpoint" ]
+}
+if ! sc_daemon; then
+  echo "serve concurrency smoke: daemon never published its endpoint"; sc_rc=1
+  kill "$SC_PID" 2>/dev/null; wait "$SC_PID" 2>/dev/null
+else
+  # Phase 1: a large job in flight must NOT head-block a small job — the
+  # small job (via the `submit --wait` verb, Retry-After-paced) completes
+  # on its own slice while the large job is still on the devices. Then
+  # queue a second large job behind the first and SIGKILL the daemon
+  # mid-queue (the journal's moment of truth).
+  env JAX_PLATFORMS=cpu python - "$(cat "$SC_TMP/endpoint")" "$SC_TMP" <<'PYEOF' || sc_rc=$?
+import subprocess, sys, time
+from spark_examples_tpu.serve.client import ServeClient
+
+url, tmp = sys.argv[1], sys.argv[2]
+client = ServeClient(url)
+SMALL = ["--num-samples", "8", "--references", "1:0:50000"]
+LARGE = ["--num-samples", "512", "--references", "1:0:20000000"]
+
+# Warm the small geometry (its compile is the daemon's startup cost).
+first = client.wait(client.submit(SMALL)["job"]["id"], timeout=300)["job"]
+if first["status"] != "done" or first["slice"] != "small-0":
+    print(f"small job not served by the small slice: {first}"); sys.exit(1)
+
+large1 = client.submit(LARGE)["job"]
+if large1["class"] != "large":
+    print(f"large job misclassified: {large1}"); sys.exit(1)
+t0 = time.perf_counter()
+wait = subprocess.run(
+    [sys.executable, "-m", "spark_examples_tpu", "submit", "--url", url,
+     "--wait", "--json", "--"] + SMALL,
+    capture_output=True, text=True, timeout=300)
+small_seconds = time.perf_counter() - t0
+if wait.returncode != 0:
+    print(f"submit --wait failed: {wait.stdout}\n{wait.stderr}"); sys.exit(1)
+inflight = client.status(large1["id"])["job"]
+if inflight["status"] not in ("queued", "running"):
+    print(f"large job already {inflight['status']} after "
+          f"{small_seconds:.2f}s small job: no concurrency"); sys.exit(1)
+large1_done = client.wait(large1["id"], timeout=600)["job"]
+if large1_done["status"] != "done":
+    print(f"large job failed: {large1_done}"); sys.exit(1)
+
+# Mid-queue kill setup: large2 running, large3 queued behind it.
+large2 = client.submit(LARGE)["job"]
+deadline = time.monotonic() + 60
+while client.status(large2["id"])["job"]["status"] == "queued":
+    if time.monotonic() > deadline:
+        print("large2 never started"); sys.exit(1)
+    time.sleep(0.1)
+large3 = client.submit(LARGE)["job"]
+with open(tmp + "/ids", "w") as f:
+    f.write(f"{large2['id']}\n{large3['id']}\n")
+print(f"serve concurrency phase 1 OK: small {small_seconds:.2f}s beside "
+      f"large ({large1_done['seconds']:.2f}s), large2 running + "
+      "large3 queued for the kill")
+PYEOF
+  if [ "$sc_rc" -eq 0 ]; then
+    kill -9 "$SC_PID" 2>/dev/null
+    wait "$SC_PID" 2>/dev/null
+    # Phase 2: the restarted daemon must replay the journal — the queued
+    # job finishes, the mid-device job fails structurally, and a
+    # repeat-geometry job is warm from the run-dir persistent state.
+    if ! sc_daemon; then
+      echo "serve concurrency smoke: daemon did not restart"; sc_rc=1
+    else
+      env JAX_PLATFORMS=cpu python - "$(cat "$SC_TMP/endpoint")" "$SC_TMP" <<'PYEOF' || sc_rc=$?
+import sys
+from spark_examples_tpu.serve.client import ServeClient
+
+url, tmp = sys.argv[1], sys.argv[2]
+client = ServeClient(url)
+large2_id, large3_id = open(tmp + "/ids").read().split()
+
+health = client.healthz()
+if health["warm_state"]["journal_replayed"] < 2:
+    print(f"journal replayed too few jobs: {health['warm_state']}")
+    sys.exit(1)
+crashed = client.wait(large2_id, timeout=60)["job"]
+if crashed["status"] != "failed" or "daemon-restarted" not in (crashed["error"] or ""):
+    print(f"mid-device job not failed structurally: {crashed}"); sys.exit(1)
+replayed = client.wait(large3_id, timeout=600)["job"]
+if replayed["status"] != "done":
+    print(f"journaled queued job did not finish after restart: {replayed}")
+    sys.exit(1)
+SMALL = ["--num-samples", "8", "--references", "1:0:50000"]
+repeat = client.wait(client.submit(SMALL)["job"]["id"], timeout=300)["job"]
+if repeat["compile_cache"] != "warm":
+    print(f"repeat-geometry job not warm after restart: {repeat}")
+    sys.exit(1)
+print(f"serve concurrency phase 2 OK: {health['warm_state']['journal_replayed']} "
+      f"jobs replayed, queued job finished ({replayed['seconds']:.2f}s), "
+      "mid-device job failed structurally, repeat geometry warm from the "
+      "persistent run-dir state")
+PYEOF
+      kill -TERM "$SC_PID" 2>/dev/null
+      if ! wait "$SC_PID"; then
+        echo "serve concurrency smoke: restarted daemon exited nonzero"; sc_rc=1
+      fi
+    fi
+  else
+    kill -9 "$SC_PID" 2>/dev/null; wait "$SC_PID" 2>/dev/null
+  fi
+fi
+if [ "$sc_rc" -eq 0 ]; then
+  # Phase 3: the serve-load harness — mixed small/large traffic through
+  # the HTTP API; small-job P99 under concurrent large-job load must stay
+  # within ~2x its unloaded P99 (a 2 s absolute floor absorbs shared-CI
+  # scheduler noise on a 2-core container) and far below the large job's
+  # own wall-clock (the head-block detector).
+  env JAX_PLATFORMS=cpu SPARK_EXAMPLES_TPU_NO_CACHE=1 \
+      XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    python bench.py --config serve-load > "$SC_TMP/load.json" \
+      2> "$SC_TMP/load.err" || sc_rc=$?
+  if [ "$sc_rc" -eq 0 ]; then
+    env JAX_PLATFORMS=cpu python - "$SC_TMP/load.json" <<'PYEOF' || sc_rc=$?
+import json, sys
+doc = json.load(open(sys.argv[1]))
+d = doc["details"]
+if not d["sliced"]:
+    print(f"serve-load ran unsliced: {d['slices']}"); sys.exit(1)
+unloaded = d["small_unloaded_seconds"]["p99"]
+loaded = d["small_loaded_seconds"]["p99"]
+large = d["large_job_seconds"]
+if loaded > max(2.0 * unloaded, unloaded + 2.0):
+    print(f"small-job P99 degraded past 2x under load: "
+          f"{loaded:.3f}s vs {unloaded:.3f}s unloaded"); sys.exit(1)
+if loaded >= large:
+    print(f"small-job P99 {loaded:.3f}s >= large job {large:.3f}s: "
+          "head-of-line blocking"); sys.exit(1)
+print(f"serve-load OK: small P99 {unloaded:.3f}s unloaded -> "
+      f"{loaded:.3f}s beside a {large:.2f}s large job "
+      f"({doc['value']}x, bound 2x)")
+PYEOF
+  else
+    echo "serve-load bench failed:"; tail -10 "$SC_TMP/load.err"
+  fi
+fi
+if [ "$sc_rc" -ne 0 ]; then
+  echo "serve concurrency smoke failed (rc=$sc_rc):"
+  tail -20 "$SC_TMP/daemon.err" 2>/dev/null
+fi
+rm -rf "$SC_TMP"
+
 echo "== faults stage (kill/resume parity + serve watchdog) =="
 faults_rc=0
 FAULTS_TMP=$(mktemp -d)
@@ -748,5 +918,6 @@ if [ "$obs_rc" -ne 0 ]; then exit "$obs_rc"; fi
 if [ "$ring_rc" -ne 0 ]; then exit "$ring_rc"; fi
 if [ "$an_rc" -ne 0 ]; then exit "$an_rc"; fi
 if [ "$serve_rc" -ne 0 ]; then exit "$serve_rc"; fi
+if [ "$sc_rc" -ne 0 ]; then exit "$sc_rc"; fi
 if [ "$faults_rc" -ne 0 ]; then exit "$faults_rc"; fi
 exit "$san_rc"

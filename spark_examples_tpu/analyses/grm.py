@@ -147,17 +147,19 @@ def _summarize(matrix: np.ndarray, sites: int) -> Dict:
     }
 
 
-def run_grm_pipeline(conf: GrmConf) -> GrmResult:
+def run_grm_pipeline(conf: GrmConf, devices=None) -> GrmResult:
     """The GRM core, CLI-free: conf in, kinship + manifest out — the
     batch verb and the serve executor's ``grm`` kind both call this, so a
-    served job executes the identical analysis."""
+    served job executes the identical analysis. ``devices`` restricts the
+    run to an executor slice's devices (the serve daemon's sub-mesh),
+    exactly like ``run_pipeline``."""
     import jax
 
     check_analysis_conf(conf, "grm")
     from spark_examples_tpu.pipeline.pca_driver import VariantsPcaDriver
     from spark_examples_tpu.utils.tracing import StageTimes
 
-    driver = VariantsPcaDriver(conf)
+    driver = VariantsPcaDriver(conf, devices=devices)
     n = len(driver.indexes)
     moments = GrmMoments(n)
     times = StageTimes(recorder=driver.spans)
@@ -166,8 +168,17 @@ def run_grm_pipeline(conf: GrmConf) -> GrmResult:
         from spark_examples_tpu.obs.heartbeat import Heartbeat
 
         heartbeat = Heartbeat(conf.heartbeat_seconds, driver.registry).start()
+    import contextlib
+
+    # Slice placement, mirroring run_pipeline: without a mesh, jit'd work
+    # lands on the process default device — pin it to the slice's first
+    # device so a grm job on a 1-device small slice never contends with
+    # the large slice's device 0.
+    placement = (
+        jax.default_device(devices[0]) if devices else contextlib.nullcontext()
+    )
     try:
-        with times.stage("ingest+gramian"):
+        with placement, times.stage("ingest+gramian"):
 
             def rows():
                 for _contig, block in iter_site_blocks(

@@ -1090,6 +1090,12 @@ def validate_plan(
     report = PlanReport()
     if analysis != "pca":
         report.geometry["analysis"] = analysis
+    if plan_devices is not None:
+        # The device count every device-bound check below ran against —
+        # with executor slices (serve/daemon.py) this is the TARGET
+        # SLICE's count, not the whole pod's, so a rejection body says
+        # which budget the job actually failed.
+        report.geometry["plan_devices"] = int(plan_devices)
     if host_mem_budget is not None and host_mem_budget <= 0:
         report.error(
             "host-mem-budget",

@@ -56,10 +56,13 @@ schedule proof), ``graftcheck sanitize`` / ``graftcheck typecheck``:
     python -m spark_examples_tpu graftcheck lockgraph --dot lockorder.dot
 
 Serving (``serve/``; README "Serving"): ``serve`` starts the resident
-daemon — warm mesh, compile-once, admission-controlled — and ``submit``
-sends it jobs expressed as the same PCA flag namespace (everything after
-``--`` is forwarded verbatim); plan-invalid requests come back as
-structured 4xx bodies carrying the ``graftcheck plan`` facts:
+daemon — executor slices (small jobs concurrent beside a large one),
+continuous batching, compile-once with restart-persistent warm state,
+journaled job table, admission-controlled — and ``submit`` sends it
+jobs expressed as the same PCA flag namespace (everything after ``--``
+is forwarded verbatim; ``--wait`` polls with server-paced Retry-After +
+full-jitter backoff); plan-invalid requests come back as structured 4xx
+bodies carrying the ``graftcheck plan`` facts:
 
     python -m spark_examples_tpu serve --port 8765 --run-dir /tmp/serve
     python -m spark_examples_tpu submit --url http://127.0.0.1:8765 \\

@@ -38,6 +38,10 @@ Well-known metric names sampled (producers register them; see DESIGN.md §9):
 - ``serve_queue_depth`` / ``serve_jobs_inflight`` / ``serve_jobs_done``
   (gauges, resident service) — the admission-queue liveness the daemon's
   service heartbeat shows instead of ingest progress
+- ``serve_slices`` vs ``serve_slices_busy`` (gauges) — executor-slice
+  concurrency (busy == total reads as saturation), and
+  ``serve_batches_total``/``serve_batch_jobs_total`` (counters) — the
+  continuous-batching yield
 - ``compile_cache_geometry_hits`` / ``..._misses`` (function-backed
   gauges) — the warm-geometry ledger (``utils/cache.py``), the resident
   service's compile-once promise per tick
@@ -72,9 +76,13 @@ from spark_examples_tpu.obs.metrics import (
     MetricsRegistry,
     PREFETCH_QUEUE_DEPTH,
     PREFETCH_QUEUE_OCCUPANCY,
+    SERVE_BATCH_JOBS,
+    SERVE_BATCHES,
     SERVE_JOBS_DONE,
     SERVE_JOBS_INFLIGHT,
     SERVE_QUEUE_DEPTH,
+    SERVE_SLICES,
+    SERVE_SLICES_BUSY,
 )
 
 
@@ -261,6 +269,25 @@ class Heartbeat:
                 if done is not None and done == done:
                     segment += f", done {int(done)}"
                 segment += ")"
+            parts.append(segment)
+
+        # Executor-slice concurrency (serve/ per-slice workers): how many
+        # of the daemon's independent device slices are executing right
+        # now — saturation reads as busy == total.
+        slices = self.registry.value(SERVE_SLICES)
+        if slices is not None and slices == slices and slices > 0:
+            busy = self.registry.value(SERVE_SLICES_BUSY)
+            if busy is not None and busy == busy:
+                parts.append(f"slices {int(busy)}/{int(slices)} busy")
+
+        # Continuous-batching yield: dispatch groups that coalesced more
+        # than one compatible small job, and the jobs they carried.
+        batches = self.registry.value(SERVE_BATCHES)
+        if batches:
+            batch_jobs = self.registry.value(SERVE_BATCH_JOBS)
+            segment = f"batched {int(batches)} groups"
+            if batch_jobs:
+                segment += f" ({int(batch_jobs)} jobs)"
             parts.append(segment)
 
         # Warm-geometry compile-cache pair (utils/cache.py ledger): the

@@ -106,6 +106,26 @@ SERVE_QUEUE_DEPTH = "serve_queue_depth"
 SERVE_JOBS_INFLIGHT = "serve_jobs_inflight"
 SERVE_JOBS_DONE = "serve_jobs_done"
 
+#: Executor-slice topology gauges (``serve/daemon.py``): how many
+#: independent slices partition the daemon's devices, and how many are
+#: executing a job right now — the heartbeat's concurrency segment (a
+#: busy large slice with idle small slices is the healthy mixed-traffic
+#: picture; every slice busy is saturation).
+SERVE_SLICES = "serve_slices"
+SERVE_SLICES_BUSY = "serve_slices_busy"
+
+#: Continuous-batching counters (``serve/queue.py:pop_batch``): dispatch
+#: groups that coalesced more than one small job, and the total jobs that
+#: rode them — the throughput the admission queue recovered from
+#: fingerprint-compatible traffic.
+SERVE_BATCHES = "serve_batches_total"
+SERVE_BATCH_JOBS = "serve_batch_jobs_total"
+
+#: Jobs replayed from the on-disk job journal (``serve/journal.py``) at
+#: daemon startup — each one an admission a previous incarnation
+#: acknowledged and this one honored.
+SERVE_JOURNAL_REPLAYED = "serve_journal_replayed_total"
+
 #: Host-memory cross-validation pair (``graftcheck hostmem``'s runtime
 #: half): the measured peak process RSS (function-backed — every read
 #: samples the OS) next to the static bound from
@@ -181,12 +201,20 @@ _WELL_KNOWN_GAUGE_HELP = {
         "queue (both classes)."
     ),
     SERVE_JOBS_INFLIGHT: (
-        "Jobs the service worker is executing right now (0 or 1: one "
-        "serial worker owns the devices)."
+        "Jobs the service's slice workers are executing right now "
+        "(bounded by the executor-slice count)."
     ),
     SERVE_JOBS_DONE: (
         "Service jobs that reached a terminal state (done, failed, or "
         "cancelled) since the daemon started."
+    ),
+    SERVE_SLICES: (
+        "Executor slices partitioning the daemon's devices "
+        "(parallel/mesh.py:plan_executor_slices)."
+    ),
+    SERVE_SLICES_BUSY: (
+        "Executor slices currently executing a job (each slice runs its "
+        "dispatch group serially)."
     ),
     GRAMIAN_CHECKPOINT_SITES: (
         "Ingest cursor (rows of the deterministic stream) covered by the "
@@ -220,6 +248,18 @@ _WELL_KNOWN_COUNTER_HELP = {
     SERVE_WORKER_RESTARTS: (
         "Dead worker threads the serve watchdog replaced; each increment "
         "is one crash the daemon survived instead of wedging."
+    ),
+    SERVE_BATCHES: (
+        "Dispatch groups that coalesced more than one compatible small "
+        "job (continuous batching over the admission queue)."
+    ),
+    SERVE_BATCH_JOBS: (
+        "Small jobs that rode a multi-job dispatch group (continuous "
+        "batching over the admission queue)."
+    ),
+    SERVE_JOURNAL_REPLAYED: (
+        "Accepted-but-unfinished jobs replayed from the job journal at "
+        "daemon startup (serve/journal.py)."
     ),
 }
 
@@ -662,6 +702,11 @@ __all__ = [
     "SERVE_QUEUE_DEPTH",
     "SERVE_JOBS_INFLIGHT",
     "SERVE_JOBS_DONE",
+    "SERVE_SLICES",
+    "SERVE_SLICES_BUSY",
+    "SERVE_BATCHES",
+    "SERVE_BATCH_JOBS",
+    "SERVE_JOURNAL_REPLAYED",
     "HOST_PEAK_RSS_BYTES",
     "HOST_STATIC_BOUND_BYTES",
     "read_host_peak_rss_bytes",

@@ -45,7 +45,7 @@ from __future__ import annotations
 import functools
 import os
 from dataclasses import dataclass
-from typing import Dict, NamedTuple, Optional, Sequence
+from typing import Dict, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -626,16 +626,144 @@ def parse_mesh_shape(spec: str) -> Dict[str, int]:
 def resolve_run_mesh(
     mesh_shape: Optional[str] = None,
     num_reduce_partitions: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
 ):
     """The ONE run-mesh resolution rule (explicit ``--mesh-shape``, else
     all devices capped by ``--num-reduce-partitions``; ``None`` on one
     device) — shared by the PCA driver and the analyses so a change to
-    the rule can never leave them resolving different meshes."""
+    the rule can never leave them resolving different meshes. ``devices``
+    restricts the rule to a subset of the process's devices (an executor
+    slice of the resident service — :func:`plan_executor_slices`); the
+    default is every device, the historical behavior."""
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
     if mesh_shape:
-        return make_mesh(parse_mesh_shape(mesh_shape))
-    if len(jax.devices()) == 1:
+        return make_mesh(parse_mesh_shape(mesh_shape), devices)
+    if len(devices) == 1:
         return None
-    return default_mesh(num_reduce_partitions=num_reduce_partitions)
+    return default_mesh(
+        num_reduce_partitions=num_reduce_partitions, devices=devices
+    )
+
+
+# --------------------------------------------------------------------------
+# Executor slices: partitioning one process's devices into independent
+# sub-meshes (the resident service's concurrency unit).
+# --------------------------------------------------------------------------
+
+#: Job classes an executor slice may serve (the admission classes of
+#: ``serve/queue.py``; spelled here so the device math has no serve import).
+SLICE_SMALL = "small"
+SLICE_LARGE = "large"
+
+
+@dataclass(frozen=True)
+class ExecutorSlice:
+    """One independent executor: a contiguous range of the process's
+    device list, its own mesh, its own worker thread, its own warm jit
+    caches. Slices never share devices, so a whole-genome job on the
+    large slice cannot head-block (or poison) a small-slice query — the
+    isolation is by construction, not by scheduling discipline. Pure
+    index arithmetic: the device-free plan validator reasons about slices
+    without a backend, exactly like ``--plan-devices``."""
+
+    name: str
+    job_classes: Tuple[str, ...]
+    device_start: int
+    device_count: int
+
+    def __post_init__(self) -> None:
+        if self.device_count < 1:
+            raise ValueError(
+                f"slice {self.name!r} needs >= 1 device, got "
+                f"{self.device_count}"
+            )
+        if not self.job_classes:
+            raise ValueError(f"slice {self.name!r} serves no job class")
+
+    def device_indices(self) -> Tuple[int, ...]:
+        return tuple(
+            range(self.device_start, self.device_start + self.device_count)
+        )
+
+
+def resolve_small_slices(spec, device_count: int) -> int:
+    """The ``--executor-slices`` auto rule: ``'auto'`` (or ``None``) is one
+    small slice whenever a device can be spared (>= 2 devices), zero on a
+    single device (slicing one device buys nothing — the shared serial
+    worker IS the right schedule there); an explicit integer passes
+    through. ONE rule so the daemon and the load harness cannot drift."""
+    if spec is None or spec == "auto":
+        return 1 if int(device_count) >= 2 else 0
+    count = int(spec)
+    if count < 0:
+        raise ValueError(f"--executor-slices must be >= 0, got {spec!r}")
+    return count
+
+
+def plan_executor_slices(
+    device_count: int,
+    small_slices: int = 0,
+    small_slice_devices: int = 1,
+) -> Tuple[ExecutorSlice, ...]:
+    """Partition ``device_count`` devices into executor slices.
+
+    ``small_slices == 0`` is the shared (historical) topology: ONE slice
+    over every device serving both admission classes serially. Otherwise
+    ``small_slices`` slices of ``small_slice_devices`` devices each are
+    carved off the END of the device list for statically-bounded small
+    jobs, and the remaining devices (at least one — a topology that
+    starves the large class is an error, not a warning) form the large
+    slice. Deterministic index math shared by the daemon (which maps
+    indices onto ``jax.devices()``), admission (which validates each job
+    against ITS slice's device count, not the whole pod's), and tests."""
+    devices = int(device_count)
+    small = int(small_slices)
+    per_small = int(small_slice_devices)
+    if devices < 1:
+        raise ValueError(f"device_count must be >= 1, got {device_count}")
+    if small < 0:
+        raise ValueError(f"small_slices must be >= 0, got {small_slices}")
+    if per_small < 1:
+        raise ValueError(
+            f"small_slice_devices must be >= 1, got {small_slice_devices}"
+        )
+    if small == 0:
+        return (
+            ExecutorSlice(
+                name="shared",
+                job_classes=(SLICE_SMALL, SLICE_LARGE),
+                device_start=0,
+                device_count=devices,
+            ),
+        )
+    reserved = small * per_small
+    if devices - reserved < 1:
+        raise ValueError(
+            f"{small} small slice(s) x {per_small} device(s) reserve "
+            f"{reserved} of {devices} devices, leaving none for the large "
+            "slice; shrink --executor-slices/--small-slice-devices or add "
+            "devices"
+        )
+    slices = [
+        ExecutorSlice(
+            name="large",
+            job_classes=(SLICE_LARGE,),
+            device_start=0,
+            device_count=devices - reserved,
+        )
+    ]
+    for i in range(small):
+        slices.append(
+            ExecutorSlice(
+                name=f"small-{i}",
+                job_classes=(SLICE_SMALL,),
+                device_start=devices - reserved + i * per_small,
+                device_count=per_small,
+            )
+        )
+    return tuple(slices)
 
 
 __all__ = [
@@ -668,4 +796,9 @@ __all__ = [
     "default_mesh",
     "parse_mesh_shape",
     "resolve_run_mesh",
+    "SLICE_SMALL",
+    "SLICE_LARGE",
+    "ExecutorSlice",
+    "resolve_small_slices",
+    "plan_executor_slices",
 ]

@@ -143,9 +143,19 @@ def make_source(conf: PcaConf) -> GenomicsSource:
 class VariantsPcaDriver:
     """Reusable driver (``VariantsPca.scala:89-336``)."""
 
-    def __init__(self, conf: PcaConf, source: Optional[GenomicsSource] = None):
+    def __init__(
+        self,
+        conf: PcaConf,
+        source: Optional[GenomicsSource] = None,
+        devices=None,
+    ):
         self.conf = conf
         self.source = source if source is not None else make_source(conf)
+        # Executor-slice support (serve/): when given, every mesh this
+        # driver resolves is built over exactly these devices, so
+        # concurrent drivers on disjoint slices never contend for HBM or
+        # accumulator state. None = all devices (the historical rule).
+        self.devices = list(devices) if devices is not None else None
         # One telemetry namespace per run: every counter/gauge/span of this
         # driver's pipeline lands here, and the run manifest
         # (``--metrics-json``) snapshots exactly this registry+recorder —
@@ -231,7 +241,9 @@ class VariantsPcaDriver:
             # only caps the default mesh's data axis, so jax stays
             # uninitialized here unless a mesh decision truly needs it.
             device_count = None
-            if not getattr(self.conf, "mesh_shape", None):
+            if self.devices is not None:
+                device_count = len(self.devices)
+            elif not getattr(self.conf, "mesh_shape", None):
                 import jax
 
                 device_count = jax.device_count()
@@ -420,7 +432,9 @@ class VariantsPcaDriver:
 
     def _make_mesh(self):
         return resolve_run_mesh(
-            self.conf.mesh_shape, self.conf.num_reduce_partitions
+            self.conf.mesh_shape,
+            self.conf.num_reduce_partitions,
+            devices=self.devices,
         )
 
     def _resolve_sharded(self, sharded: Optional[bool], mesh) -> bool:
@@ -959,6 +973,14 @@ class PipelineResult:
     manifest_path: Optional[str] = None
 
 
+def jax_default_device(device):
+    """``jax.default_device(device)`` behind a lazy import (the driver
+    module must stay importable without initializing a backend)."""
+    import jax
+
+    return jax.default_device(device)
+
+
 def run(argv: Sequence[str]) -> List[str]:
     """``VariantsPcaDriver.main`` (``VariantsPca.scala:47-59``)."""
     conf = PcaConf.parse(argv)
@@ -966,14 +988,21 @@ def run(argv: Sequence[str]) -> List[str]:
     return run_pipeline(conf).lines
 
 
-def run_pipeline(conf: PcaConf, similarity_only: bool = False) -> PipelineResult:
+def run_pipeline(
+    conf: PcaConf, similarity_only: bool = False, devices=None
+) -> PipelineResult:
     """The run-an-analysis core, CLI-free: config in, result + manifest
     out. ``run`` (batch) and the resident service's executor
     (``serve/executor.py``) both call this, so a served job and a batch
     invocation execute the identical pipeline and produce the identical
     schema-v2 manifest. ``similarity_only`` stops after the
     ingest+similarity stage and returns a host-side summary of the
-    Gramian instead of PC rows (the service's similarity request kind)."""
+    Gramian instead of PC rows (the service's similarity request kind).
+    ``devices`` restricts the run to an executor slice's devices
+    (``parallel/mesh.py:plan_executor_slices``): meshes resolve over the
+    slice only, and mesh-less (dense, single-device) work is pinned to
+    the slice's first device so concurrent slices never contend for one
+    default device."""
     if getattr(conf, "fault_plan", None) is not None:
         # The flag wins over the SPARK_EXAMPLES_TPU_FAULTS environment
         # variable; configuring resets hit counts, so every run starts a
@@ -1124,7 +1153,7 @@ def run_pipeline(conf: PcaConf, similarity_only: bool = False) -> PipelineResult
                 f"--ingest packed needs a .vcf[.gz] input; got {selected!r} "
                 "(use --ingest wire for JSONL/checkpoint inputs)"
             )
-    driver = VariantsPcaDriver(conf, source)
+    driver = VariantsPcaDriver(conf, source, devices=devices)
     _export_compile_cache_gauges(driver.registry)
     from spark_examples_tpu.utils.tracing import StageTimes, device_trace
 
@@ -1139,8 +1168,19 @@ def run_pipeline(conf: PcaConf, similarity_only: bool = False) -> PipelineResult
 
         heartbeat = Heartbeat(conf.heartbeat_seconds, driver.registry).start()
     similarity_summary: Optional[Dict] = None
+    import contextlib
+
+    # Slice placement: without a mesh, jit'd work lands on the process
+    # default device — two concurrent slices would silently share device
+    # 0. Pinning the default to the slice's first device keeps mesh-less
+    # paths (dense accumulator, small cohorts) inside the slice too.
+    placement = (
+        jax_default_device(devices[0])
+        if devices
+        else contextlib.nullcontext()
+    )
     try:
-        with device_trace(conf.profile_dir):
+        with placement, device_trace(conf.profile_dir):
             # The device path already ends in a synchronous counter fetch
             # (the stats epilogue); packed/wire paths end in a one-scalar
             # fetch so the stage wall-clock is honest on asynchronous

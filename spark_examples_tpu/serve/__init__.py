@@ -1,22 +1,29 @@
-"""Resident PCA service: warm mesh, compile-once, admission-controlled.
+"""Resident PCA service: executor slices, compile-once, admission-controlled.
 
 The reference's ``VariantsPcaDriver`` is a batch job — every invocation
 pays full process startup plus seconds of XLA compile before touching
 data. This package keeps ONE process alive instead: a daemon that owns
-the device mesh and the warm compile caches (``serve/daemon.py``),
-validates every request device-free at admission time with the
-``graftcheck plan`` validator (rejections become structured 4xx bodies
-carrying the plan facts), runs admitted jobs serially through a bounded
-two-class queue (small-region queries batch ahead of whole-genome jobs,
-``serve/queue.py``), and exposes job submission/status/cancel, Prometheus
-metrics, and health over a thin stdlib HTTP API (``serve/http.py``).
+the devices in independent executor slices (``serve/daemon.py`` over
+``parallel/mesh.py:plan_executor_slices`` — small jobs run concurrently
+beside one large job, each slice on its own sub-mesh), validates every
+request device-free at admission time with the ``graftcheck plan``
+validator against the TARGET slice's device count (rejections become
+structured 4xx bodies carrying the plan facts), coalesces
+fingerprint-compatible small jobs into bounded dispatch groups
+(continuous batching, ``serve/queue.py``), journals every acknowledged
+admission so accepted jobs survive a daemon kill (``serve/journal.py``),
+keeps its warm compile state (XLA persistent cache + geometry ledger)
+under the run dir across restarts, and exposes job
+submission/status/cancel, Prometheus metrics, and health over a thin
+stdlib HTTP API (``serve/http.py``).
 
 Layout:
 
 - ``protocol.py`` — the versioned JSON request/response schema
-- ``queue.py``    — bounded two-class admission queue + job records
+- ``queue.py``    — bounded two-class admission queue + continuous batching
+- ``journal.py``  — append-only job journal (restart replay)
 - ``executor.py`` — per-job execution over ``pipeline.pca_driver.run_pipeline``
-- ``daemon.py``   — the service: mesh, worker thread, job table, metrics
+- ``daemon.py``   — the service: slices, workers, job table, metrics
 - ``http.py``     — stdlib HTTP front-end + the ``serve`` CLI verb
 - ``client.py``   — stdlib HTTP client + the ``submit`` CLI verb
 """

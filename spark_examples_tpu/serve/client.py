@@ -8,8 +8,11 @@
 
 Everything after ``--`` is the EXISTING PCA flag namespace, forwarded
 verbatim — a batch invocation becomes a served job by replacing
-``variants-pca`` with ``submit --url ... --``. Exit codes: 0 job done,
-1 job failed/cancelled/timed out, 2 rejected at admission (the rejection
+``variants-pca`` with ``submit --url ... --``. Waiting (``--wait``, the
+default) polls ``GET /v1/jobs/<id>`` honoring the server's
+``Retry-After`` hint with the shared ``utils/retry.py`` full-jitter
+backoff between polls. Exit codes: 0 job done, 1 job
+failed/cancelled/timed out, 2 rejected at admission (the rejection
 body, including the plan facts, prints as JSON).
 
 The client never imports jax: submitting from a laptop to a TPU-backed
@@ -104,7 +107,7 @@ class ServeClient:
 
     def _request(
         self, method: str, path: str, doc: Optional[Dict] = None
-    ) -> Tuple[int, object, str]:
+    ) -> Tuple[int, object, str, Optional[Dict]]:
         """One HTTP exchange. GETs (``status``/``/metrics``/``/healthz``)
         retry connection resets and 5xx responses with bounded backoff —
         they are idempotent, and a daemon mid-worker-recovery must not
@@ -126,6 +129,7 @@ class ServeClient:
                     status = resp.status
                     raw = resp.read(MAX_RESPONSE_BYTES + 1)
                     content_type = resp.headers.get("Content-Type", "")
+                    headers = dict(resp.headers)
             except urllib.error.HTTPError as e:
                 if e.code >= 500 and retryable:
                     self._backoff(attempt, e.headers)
@@ -135,6 +139,7 @@ class ServeClient:
                 content_type = (
                     e.headers.get("Content-Type", "") if e.headers else ""
                 )
+                headers = dict(e.headers) if e.headers else None
             except (urllib.error.URLError, OSError):
                 # Connection refused / reset (possibly mid-response): safe
                 # to resend only because GETs are idempotent.
@@ -156,13 +161,15 @@ class ServeClient:
         text = raw.decode("utf-8", errors="replace")
         if "application/json" in content_type:
             try:
-                return status, json.loads(text), text
+                return status, json.loads(text), text, headers
             except json.JSONDecodeError:
                 pass
-        return status, None, text
+        return status, None, text, headers
 
-    def _json(self, method: str, path: str, doc: Optional[Dict] = None) -> Dict:
-        status, body, text = self._request(method, path, doc)
+    def _json_with_headers(
+        self, method: str, path: str, doc: Optional[Dict] = None
+    ) -> Tuple[Dict, Optional[Dict]]:
+        status, body, text, headers = self._request(method, path, doc)
         if status >= 400:
             raise ServeError(status, body if body is not None else text)
         if not isinstance(body, dict):
@@ -175,7 +182,10 @@ class ServeClient:
                     }
                 },
             )
-        return body
+        return body, headers
+
+    def _json(self, method: str, path: str, doc: Optional[Dict] = None) -> Dict:
+        return self._json_with_headers(method, path, doc)[0]
 
     # ----------------------------------------------------------------- verbs
 
@@ -204,24 +214,43 @@ class ServeClient:
         return self._json("POST", f"/v1/jobs/{job_id}/cancel")
 
     def wait(
-        self, job_id: str, timeout: float = 600.0, poll_seconds: float = 0.2
+        self,
+        job_id: str,
+        timeout: float = 600.0,
+        poll_cap_seconds: float = 2.0,
     ) -> Dict:
-        """Poll until the job reaches a terminal status; raises
-        :class:`TimeoutError` past ``timeout``."""
+        """Poll ``GET /v1/jobs/<id>`` until the job reaches a terminal
+        status; raises :class:`TimeoutError` past ``timeout``.
+
+        Pacing is server-first: a ``Retry-After`` header on a non-terminal
+        response (``serve/http.py`` sends one) is honored exactly; without
+        one the shared ``utils/retry.py`` full-jitter backoff paces the
+        polls — both capped by ``poll_cap_seconds`` so a long job is
+        polled steadily, not hammered, and a thundering herd of waiting
+        clients decorrelates instead of synchronizing."""
         deadline = time.monotonic() + timeout
+        attempt = 0
         while True:
-            doc = self.status(job_id)
-            if doc["job"]["status"] in TERMINAL_STATUSES:
-                return doc
+            body, headers = self._json_with_headers(
+                "GET", f"/v1/jobs/{job_id}"
+            )
+            if body["job"]["status"] in TERMINAL_STATUSES:
+                return body
             if time.monotonic() > deadline:
                 raise TimeoutError(
-                    f"job {job_id} still {doc['job']['status']!r} after "
+                    f"job {job_id} still {body['job']['status']!r} after "
                     f"{timeout}s"
                 )
-            time.sleep(poll_seconds)
+            delay = retry_after_seconds(headers, poll_cap_seconds)
+            if delay is None:
+                delay = full_jitter_delay(
+                    attempt, self.backoff_base, poll_cap_seconds, self._rng
+                )
+            attempt += 1
+            self._sleep(delay)
 
     def metrics(self) -> str:
-        status, _body, text = self._request("GET", "/metrics")
+        status, _body, text, _headers = self._request("GET", "/metrics")
         if status >= 400:
             raise ServeError(status, text)
         return text
@@ -241,6 +270,17 @@ def submit_main(argv: Optional[Sequence[str]] = None) -> int:
     )
     parser.add_argument("--deadline-seconds", type=float, default=None)
     parser.add_argument("--tag", default=None)
+    parser.add_argument(
+        "--wait",
+        action="store_true",
+        help=(
+            "Poll until the job reaches a terminal state (the default; "
+            "spelled out for scripts that want the contract explicit). "
+            "Polling honors server Retry-After hints with full-jitter "
+            "backoff between them; the exit code mirrors the terminal "
+            "state (0 done, 1 failed/cancelled/timed out)."
+        ),
+    )
     parser.add_argument(
         "--no-wait",
         action="store_true",
@@ -263,6 +303,8 @@ def submit_main(argv: Optional[Sequence[str]] = None) -> int:
         help="PCA flag namespace after '--' (forwarded verbatim).",
     )
     ns = parser.parse_args(list(argv) if argv is not None else None)
+    if ns.wait and ns.no_wait:
+        parser.error("--wait and --no-wait are mutually exclusive")
     flags = list(ns.flags)
     if flags and flags[0] == "--":
         flags = flags[1:]

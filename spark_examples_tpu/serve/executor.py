@@ -96,6 +96,11 @@ def execute_job(job: Job, run_dir: str) -> ExecutionOutcome:
     conf.metrics_json = os.path.join(job_dir, "manifest.json")
     warm = geometry_seen(compile_fingerprint(conf, kind=job.request.kind))
 
+    # The claiming slice's devices (serve/daemon.py sets them just before
+    # execution): the run resolves its mesh over this subset only, so
+    # concurrent slices never contend for devices. None = all devices
+    # (embedders and the single-slice topology).
+    devices = getattr(job, "slice_devices", None)
     similarity_only = job.request.kind == "similarity"
     with open(
         os.path.join(job_dir, "stdout.log"), "w", encoding="utf-8"
@@ -112,13 +117,13 @@ def execute_job(job: Job, run_dir: str) -> ExecutionOutcome:
                 # records the kind-keyed warm-ledger geometry).
                 from spark_examples_tpu.analyses.grm import run_grm_pipeline
 
-                grm = run_grm_pipeline(conf)
+                grm = run_grm_pipeline(conf, devices=devices)
                 result: Dict = {"grm": grm.summary}
                 manifest_doc = grm.manifest
                 manifest_path = grm.manifest_path
             else:
                 pipeline = run_pipeline(
-                    conf, similarity_only=similarity_only
+                    conf, similarity_only=similarity_only, devices=devices
                 )
                 if similarity_only:
                     result = {"similarity": pipeline.similarity_summary}

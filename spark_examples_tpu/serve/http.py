@@ -38,14 +38,23 @@ from spark_examples_tpu.serve.daemon import (
 )
 from spark_examples_tpu.serve.protocol import error_doc
 from spark_examples_tpu.serve.queue import (
+    DEFAULT_BATCH_LINGER_SECONDS,
+    DEFAULT_BATCH_MAX_JOBS,
     DEFAULT_LARGE_CAPACITY,
     DEFAULT_SMALL_CAPACITY,
+    SMALL_JOB_MAX_SITES,
 )
 
 #: Largest accepted request body: a flag list is hundreds of bytes; one
 #: MiB of headroom keeps admission O(1) in host memory no matter what a
 #: client posts (oversized bodies are 413 without being read further).
 MAX_BODY_BYTES = 1 << 20
+
+#: ``Retry-After`` hint on non-terminal job-status responses: the poll
+#: cadence the server ASKS for (a small-job completion is sub-second
+#: warm; half a second keeps the client snappy without hammering a
+#: daemon mid-whole-genome-job).
+POLL_RETRY_AFTER_SECONDS = 0.5
 
 
 class ServeHandler(BaseHTTPRequestHandler):
@@ -62,11 +71,15 @@ class ServeHandler(BaseHTTPRequestHandler):
                 f"serve[{self.address_string()}]: {format % args}\n"
             )
 
-    def _send_json(self, status: int, doc) -> None:
+    def _send_json(
+        self, status: int, doc, retry_after: Optional[float] = None
+    ) -> None:
         body = (json.dumps(doc, indent=2) + "\n").encode("utf-8")
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if retry_after is not None:
+            self.send_header("Retry-After", f"{retry_after:g}")
         self.end_headers()
         self.wfile.write(body)
 
@@ -124,7 +137,20 @@ class ServeHandler(BaseHTTPRequestHandler):
             job_id = self.path[len("/v1/jobs/"):]
             if job_id and "/" not in job_id:
                 status, doc = service.job_status(job_id)
-                self._send_json(status, doc)
+                # A non-terminal job tells the poller WHEN to come back
+                # (the shared utils/retry.py client arithmetic honors it)
+                # — server-paced polling instead of client guesswork.
+                job_state = (doc.get("job") or {}).get("status")
+                self._send_json(
+                    status,
+                    doc,
+                    retry_after=(
+                        POLL_RETRY_AFTER_SECONDS
+                        if status == 200
+                        and job_state in ("queued", "running")
+                        else None
+                    ),
+                )
                 return
         self._send_json(
             404, error_doc("not-found", f"no route GET {self.path}")
@@ -269,6 +295,67 @@ def serve_main(argv: Optional[Sequence[str]] = None) -> int:
         ),
     )
     parser.add_argument(
+        "--executor-slices",
+        default="auto",
+        metavar="N|auto",
+        help=(
+            "Small executor slices to carve off the device set (each its "
+            "own mesh + worker, so small jobs run concurrently beside one "
+            "large job). 'auto' (default) = 1 when a device can be "
+            "spared, 0 on a single device; 0 = the shared serial worker."
+        ),
+    )
+    parser.add_argument(
+        "--small-slice-devices",
+        type=int,
+        default=1,
+        metavar="D",
+        help="Devices per small executor slice (default %(default)s).",
+    )
+    parser.add_argument(
+        "--serve-small-site-limit",
+        type=int,
+        default=SMALL_JOB_MAX_SITES,
+        metavar="SITES",
+        help=(
+            "Largest statically-bounded candidate-site count classified "
+            "as a small job (default %(default)s); larger or unbounded "
+            "configurations queue as large."
+        ),
+    )
+    parser.add_argument(
+        "--batch-max-jobs",
+        type=int,
+        default=DEFAULT_BATCH_MAX_JOBS,
+        metavar="N",
+        help=(
+            "Continuous batching: at most this many compatible small "
+            "jobs per dispatch group (default %(default)s; 1 disables "
+            "coalescing)."
+        ),
+    )
+    parser.add_argument(
+        "--batch-linger-seconds",
+        type=float,
+        default=DEFAULT_BATCH_LINGER_SECONDS,
+        metavar="S",
+        help=(
+            "Continuous batching: wait up to this long for more "
+            "compatible small jobs before dispatching a non-full group "
+            "(default %(default)s — dispatch what is queued now)."
+        ),
+    )
+    parser.add_argument(
+        "--no-persistent-cache",
+        action="store_true",
+        help=(
+            "Do not persist warm state under --run-dir (neither the XLA "
+            "compilation cache nor the warm-geometry ledger): a "
+            "restarted daemon then recompiles from scratch and honestly "
+            "reports every first geometry cold."
+        ),
+    )
+    parser.add_argument(
         "--endpoint-file",
         default=None,
         metavar="PATH",
@@ -279,6 +366,43 @@ def serve_main(argv: Optional[Sequence[str]] = None) -> int:
     )
     ns = parser.parse_args(list(argv) if argv is not None else None)
 
+    # Nonsense serving parameters must fail the daemon AT STARTUP with the
+    # argparse contract (exit 2), never surface as a crash-looping worker
+    # or a queue that silently misclassifies everything.
+    if ns.serve_small_site_limit < 1:
+        parser.error(
+            f"--serve-small-site-limit must be >= 1 site, got "
+            f"{ns.serve_small_site_limit}"
+        )
+    if ns.small_slice_devices < 1:
+        parser.error(
+            f"--small-slice-devices must be >= 1, got "
+            f"{ns.small_slice_devices}"
+        )
+    if ns.batch_max_jobs < 1:
+        parser.error(
+            f"--batch-max-jobs must be >= 1, got {ns.batch_max_jobs}"
+        )
+    if ns.batch_linger_seconds < 0:
+        parser.error(
+            f"--batch-linger-seconds must be >= 0, got "
+            f"{ns.batch_linger_seconds}"
+        )
+    if ns.executor_slices != "auto":
+        try:
+            slices_spec: Optional[int] = int(ns.executor_slices)
+        except ValueError:
+            parser.error(
+                f"--executor-slices must be an integer or 'auto', got "
+                f"{ns.executor_slices!r}"
+            )
+        if slices_spec < 0:
+            parser.error(
+                f"--executor-slices must be >= 0, got {slices_spec}"
+            )
+    else:
+        slices_spec = None
+
     service = PcaService(
         run_dir=ns.run_dir,
         small_capacity=ns.queue_small,
@@ -286,8 +410,21 @@ def serve_main(argv: Optional[Sequence[str]] = None) -> int:
         terminal_retention=ns.terminal_retention,
         host_mem_budget=ns.host_mem_budget,
         heartbeat_seconds=ns.heartbeat_seconds,
+        small_slices=slices_spec,
+        small_slice_devices=ns.small_slice_devices,
+        small_site_limit=ns.serve_small_site_limit,
+        batch_max_jobs=ns.batch_max_jobs,
+        batch_linger_seconds=ns.batch_linger_seconds,
+        persistent_cache=not ns.no_persistent_cache,
     )
-    service.start()
+    try:
+        service.start()
+    except ValueError as e:
+        # A slice topology the device set cannot satisfy (e.g. every
+        # device reserved for small slices) is a configuration error —
+        # the same exit-2 contract as the flag checks above.
+        print(f"serve: invalid configuration: {e}", file=sys.stderr)
+        return 2
     server = ServeServer((ns.host, ns.port), service, verbose=ns.verbose)
     if ns.endpoint_file:
         _write_endpoint_file(ns.endpoint_file, server.url)
@@ -311,10 +448,13 @@ def serve_main(argv: Optional[Sequence[str]] = None) -> int:
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
 
+    slices = ",".join(
+        f"{w.spec.name}:{w.spec.device_count}" for w in service._workers
+    )
     print(
         f"serve: listening on {server.url} "
         f"(devices={service.device_count} platform={service.platform} "
-        f"run_dir={service.run_dir})",
+        f"slices=[{slices}] run_dir={service.run_dir})",
         file=sys.stderr,
         flush=True,
     )
@@ -341,6 +481,7 @@ def serve_main(argv: Optional[Sequence[str]] = None) -> int:
 
 __all__ = [
     "MAX_BODY_BYTES",
+    "POLL_RETRY_AFTER_SECONDS",
     "ServeHandler",
     "ServeServer",
     "start_server",

@@ -1,0 +1,236 @@
+"""Append-only job-table journal: accepted jobs survive daemon restarts.
+
+PR 9's watchdog extended the crash story from "a job dies" to "a worker
+thread dies"; this journal extends it to "the PROCESS dies". Every
+admission decision the daemon acknowledges to a client is durably
+recorded BEFORE the 202 leaves the socket, so a SIGKILL'd daemon can be
+restarted against the same run directory and finish what it accepted:
+
+- ``accepted`` — the job's wire request document (the same versioned
+  protocol form the client posted; replay re-validates it through the
+  REAL parsers, never a pickled internal object), its admission class,
+  id, and timestamps;
+- ``began`` — device work started: the requeue-once boundary. A job
+  journaled ``began`` is NOT re-run after a restart (device state under
+  a crashed update cannot be trusted for a silent retry — the same
+  policy the in-process watchdog applies); it is failed with a
+  structured ``daemon-restarted`` error instead. A job accepted but not
+  begun replays into the queue with its one requeue consumed;
+- ``terminal`` — done/failed/cancelled: the record that lets replay drop
+  the job.
+
+Wire format: one JSON object per line, ``fsync``'d per record (atomic at
+the record level: a torn final line from a mid-write kill is detected and
+skipped at replay — the client of THAT job never received its 202, so
+nothing acknowledged is lost). On startup the daemon replays the journal
+and compacts it (atomic rewrite holding only still-pending records), so
+journal size is O(pending + jobs since restart), not O(jobs ever served).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+#: Journal filename under the service run directory.
+JOURNAL_BASENAME = "jobs.journal.jsonl"
+
+
+def journal_path(run_dir: str) -> str:
+    return os.path.join(run_dir, JOURNAL_BASENAME)
+
+
+@dataclass
+class PendingJob:
+    """One replayed accepted-but-unfinished job."""
+
+    job_id: str
+    request_doc: Dict
+    job_class: str
+    submitted_unix: float
+    deadline_unix: Optional[float]
+    device_began: bool = False
+    accepted_record: Dict = field(default_factory=dict)
+
+
+class JobJournal:
+    """Appender half: the daemon's durable admission log."""
+
+    def __init__(self, path: str):
+        self.path = path
+        # lock order: journal lock is a leaf — nothing else is acquired
+        # while holding it (machine-checked by `graftcheck lockgraph`);
+        # it serializes appends so records never interleave mid-line.
+        self._lock = threading.Lock()
+        self._file = None
+
+    def _append(self, record: Dict, fsync: bool = True) -> None:
+        line = json.dumps(record, sort_keys=True) + "\n"
+        with self._lock:
+            if self._file is None:
+                os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+                self._file = open(self.path, "a", encoding="utf-8")
+            self._file.write(line)
+            self._file.flush()
+            if fsync:
+                os.fsync(self._file.fileno())
+
+    # ------------------------------------------------------------- records
+
+    def accepted(
+        self,
+        job_id: str,
+        request_doc: Dict,
+        job_class: str,
+        submitted_unix: float,
+        deadline_unix: Optional[float],
+    ) -> None:
+        self._append(
+            {
+                "event": "accepted",
+                "id": job_id,
+                "request": request_doc,
+                "job_class": job_class,
+                "submitted_unix": submitted_unix,
+                "deadline_unix": deadline_unix,
+            }
+        )
+
+    def began(self, job_id: str) -> None:
+        self._append({"event": "began", "id": job_id})
+
+    def terminal(self, job_id: str, status: str) -> None:
+        # done/failed terminals flush without fsync — it is the worker's
+        # hot path (every batched job pays it), and losing one in a crash
+        # only downgrades a finished job's post-restart status to the
+        # `began`-pinned daemon-restarted failure (never a re-run, never
+        # a resurrection; the per-job manifest on disk keeps the truth).
+        # A lost CANCELLED record would be worse — the job would replay
+        # and RUN after the user cancelled it — so cancels stay fsync'd,
+        # as do the admission-path tombstones ("rejected").
+        self._append(
+            {"event": "terminal", "id": job_id, "status": status},
+            fsync=status not in ("done", "failed"),
+        )
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+# ---------------------------------------------------------------- replay
+
+
+def _iter_records(path: str):
+    """Yield parsed journal records; a torn/corrupt line (mid-write kill)
+    is skipped — by the write protocol it can only be the LAST line a
+    crashed appender produced, and its client never got the 202."""
+    try:
+        f = open(path, "r", encoding="utf-8")
+    except FileNotFoundError:
+        return
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(record, dict) and "event" in record:
+                yield record
+
+
+def replay_journal(path: str) -> Tuple[List[PendingJob], int]:
+    """Fold the journal into ``(pending_jobs, max_seq)``: every accepted
+    job without a terminal record, in admission order, with its
+    ``device_began`` flag; and the highest numeric job id seen (the
+    restarted daemon's id sequence must continue past it — replayed ids
+    stay stable for clients polling across the restart).
+
+    The fold is ORDER-INSENSITIVE across events of one job: ``began``/
+    ``terminal`` count even when they precede the ``accepted`` record in
+    the file (the appenders are concurrent threads serialized only per
+    record, so a fast worker's events can land first) — a job with any
+    terminal record is settled, and a ``began`` record always pins the
+    no-silent-re-run policy."""
+    pending: Dict[str, PendingJob] = {}
+    began: set = set()
+    settled: set = set()
+    max_seq = 0
+    for record in _iter_records(path):
+        job_id = record.get("id")
+        if not isinstance(job_id, str):
+            continue
+        if job_id.startswith("job-"):
+            try:
+                max_seq = max(max_seq, int(job_id[len("job-"):]))
+            except ValueError:
+                pass
+        event = record["event"]
+        if event == "accepted":
+            request = record.get("request")
+            job_class = record.get("job_class")
+            if not isinstance(request, dict) or not isinstance(
+                job_class, str
+            ):
+                continue
+            pending[job_id] = PendingJob(
+                job_id=job_id,
+                request_doc=request,
+                job_class=job_class,
+                submitted_unix=float(record.get("submitted_unix") or 0.0),
+                deadline_unix=(
+                    float(record["deadline_unix"])
+                    if record.get("deadline_unix") is not None
+                    else None
+                ),
+                accepted_record=record,
+            )
+        elif event == "began":
+            began.add(job_id)
+        elif event == "terminal":
+            settled.add(job_id)
+    survivors = []
+    for job in pending.values():
+        if job.job_id in settled:
+            continue
+        job.device_began = job.job_id in began
+        survivors.append(job)
+    return survivors, max_seq
+
+
+def compact_journal(path: str, pending: List[PendingJob]) -> None:
+    """Atomically rewrite the journal to hold only the still-pending
+    accepted records (+ their began flags): replay cost and journal size
+    stay bounded by the live job table, not the daemon's lifetime."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        for job in pending:
+            f.write(json.dumps(job.accepted_record, sort_keys=True) + "\n")
+            if job.device_began:
+                f.write(
+                    json.dumps(
+                        {"event": "began", "id": job.job_id}, sort_keys=True
+                    )
+                    + "\n"
+                )
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+__all__ = [
+    "JOURNAL_BASENAME",
+    "JobJournal",
+    "PendingJob",
+    "journal_path",
+    "replay_journal",
+    "compact_journal",
+]

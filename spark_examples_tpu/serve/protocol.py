@@ -221,8 +221,13 @@ def job_doc(
     manifest_path: Optional[str] = None,
     compile_cache: Optional[str] = None,
     plan_geometry: Optional[Mapping] = None,
+    slice_name: Optional[str] = None,
+    batch_size: Optional[int] = None,
 ) -> Dict:
-    """The job envelope (submit response and ``GET /v1/jobs/<id>``)."""
+    """The job envelope (submit response and ``GET /v1/jobs/<id>``).
+    ``slice``/``batch_size`` are execution attribution (which executor
+    slice ran the job, how many jobs rode its dispatch group) — additive
+    response fields; request-side strictness is unchanged."""
     return {
         "protocol": protocol_block(),
         "job": {
@@ -242,6 +247,8 @@ def job_doc(
             "plan_geometry": (
                 dict(plan_geometry) if plan_geometry is not None else None
             ),
+            "slice": slice_name,
+            "batch_size": batch_size,
         },
     }
 

@@ -1,15 +1,29 @@
 """Bounded two-class admission queue of the resident PCA service.
 
-One serial worker owns the devices, so scheduling is a pure ordering
-decision — and the ordering contract is: **small-region queries are never
-starved by whole-genome jobs**. Jobs are classified at admission
+Scheduling contract: **small-region queries are never starved by
+whole-genome jobs**. Jobs are classified at admission
 (:func:`classify_conf`) into ``small`` (statically-bounded synthetic site
-count at or under :data:`SMALL_JOB_MAX_SITES` — the 0.229 s BRCA1 shape)
-and ``large`` (everything else: whole-genome ``--all-references``, file
-and checkpoint cohorts whose size only the data knows). The worker drains
-every queued small job before starting the next large one, so a queued
-whole-genome run delays cheap queries by at most the job currently on
-the devices — never by other queued long jobs.
+count at or under the configured small-site limit, default
+:data:`SMALL_JOB_MAX_SITES` — the 0.229 s BRCA1 shape) and ``large``
+(everything else: whole-genome ``--all-references``, file and checkpoint
+cohorts whose size only the data knows). Each executor slice's worker
+pops only the classes its slice serves (``pop``'s ``classes`` filter);
+a shared single-slice worker drains every queued small job before the
+next large one, and a dedicated small slice never even sees large jobs
+— a queued whole-genome run delays cheap queries by at most the job
+currently on the SMALL slice's own devices.
+
+**Continuous batching** (:meth:`BoundedJobQueue.pop_batch`): when a
+worker frees, every queued small job whose batch fingerprint
+(``utils/cache.py:batch_compile_fingerprint`` — region-invariant compile
+geometry) matches the head job coalesces into one dispatch group, up to
+``max_batch`` jobs, optionally lingering up to ``linger_seconds`` for
+more compatible arrivals. Both bounds are hard: latency is traded for
+throughput only inside the declared window, never unboundedly. Jobs in
+a group execute back to back on the slice's warm jit caches and keep
+their individual results/manifests (byte-identical to serial execution
+— CI-asserted), so batching is a scheduling decision, not a semantics
+change.
 
 Both classes are bounded; an admission past capacity raises
 :class:`QueueFull`, which the HTTP layer surfaces as 429 backpressure
@@ -26,7 +40,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, Dict, Optional
+from typing import Deque, Dict, List, Optional, Sequence
 
 from spark_examples_tpu.serve.protocol import JobRequest
 
@@ -34,10 +48,11 @@ SMALL_CLASS = "small"
 LARGE_CLASS = "large"
 
 #: Largest statically-bounded candidate-site count still admitted as a
-#: small-region query. The synthetic grid has one candidate site per
-#: ``sources/synthetic.py:DEFAULT_VARIANT_SPACING`` (100) bases, so this
-#: is ~25 Mb of reference — two orders of magnitude above the BRCA1
-#: window (~812 sites) and two below a whole genome (~28.9 M sites).
+#: small-region query BY DEFAULT (``--serve-small-site-limit`` overrides,
+#: validated at daemon startup). The synthetic grid has one candidate
+#: site per ``sources/synthetic.py:DEFAULT_VARIANT_SPACING`` (100) bases,
+#: so this is ~25 Mb of reference — two orders of magnitude above the
+#: BRCA1 window (~812 sites) and two below a whole genome (~28.9 M sites).
 SMALL_JOB_MAX_SITES = 250_000
 
 #: Default class capacities: small queries are cheap to hold (they drain
@@ -45,6 +60,13 @@ SMALL_JOB_MAX_SITES = 250_000
 #: time so a short queue IS the honest backpressure.
 DEFAULT_SMALL_CAPACITY = 16
 DEFAULT_LARGE_CAPACITY = 4
+
+#: Continuous-batching bounds: at most this many small jobs per dispatch
+#: group, and by default no linger (a freed worker takes what is queued
+#: NOW; a positive ``--batch-linger-seconds`` trades that much latency
+#: for larger groups under bursty traffic).
+DEFAULT_BATCH_MAX_JOBS = 8
+DEFAULT_BATCH_LINGER_SECONDS = 0.0
 
 
 class QueueFull(Exception):
@@ -89,15 +111,31 @@ class Job:
     #: ``requeues`` bounds the one retry a not-yet-begun job may ride.
     device_began: bool = False
     requeues: int = 0
+    #: Continuous-batching compatibility key
+    #: (``utils/cache.py:batch_compile_fingerprint``), computed once at
+    #: admission; ``None`` never coalesces.
+    batch_key: Optional[str] = None
+    #: Execution attribution, set when a slice worker claims the job:
+    #: which executor slice ran it and how many jobs rode its dispatch
+    #: group (1 = unbatched).
+    slice: Optional[str] = None
+    batch_size: int = 1
+    #: The claiming slice's jax devices (set by the worker just before
+    #: execution; opaque here — this module must stay jax-free). The
+    #: executor passes them into ``run_pipeline(devices=...)`` so the job
+    #: runs on its slice's sub-mesh only.
+    slice_devices: Optional[object] = None
 
 
-def classify_conf(conf) -> str:
+def classify_conf(conf, small_site_limit: int = SMALL_JOB_MAX_SITES) -> str:
     """``small`` iff the configuration's candidate-site count is
     statically bounded (synthetic source, explicit ``--references``, no
-    checkpoint resume) at or under :data:`SMALL_JOB_MAX_SITES`; every
-    cohort whose size only the data knows is ``large`` — the conservative
-    direction: misclassifying a big job as small starves real small jobs,
-    misclassifying a small job as large only queues it fairly."""
+    checkpoint resume) at or under ``small_site_limit`` (default
+    :data:`SMALL_JOB_MAX_SITES`; the daemon's ``--serve-small-site-limit``
+    overrides); every cohort whose size only the data knows is ``large``
+    — the conservative direction: misclassifying a big job as small
+    starves real small jobs, misclassifying a small job as large only
+    queues it fairly."""
     if (
         getattr(conf, "source", "synthetic") != "synthetic"
         or getattr(conf, "all_references", False)
@@ -114,7 +152,7 @@ def classify_conf(conf) -> str:
         )
     except (ValueError, TypeError, AttributeError):
         return LARGE_CLASS
-    return SMALL_CLASS if sites <= SMALL_JOB_MAX_SITES else LARGE_CLASS
+    return SMALL_CLASS if sites <= int(small_site_limit) else LARGE_CLASS
 
 
 class BoundedJobQueue:
@@ -145,10 +183,15 @@ class BoundedJobQueue:
 
     # ------------------------------------------------------------ admission
 
-    def put(self, job: Job) -> None:
+    def put(self, job: Job, enforce_capacity: bool = True) -> None:
         """Admit one queued job; raises :class:`QueueClosed` after drain
         began and :class:`QueueFull` past the class capacity. Never
-        blocks — backpressure is the caller's 429, not a stalled socket."""
+        blocks — backpressure is the caller's 429, not a stalled socket.
+        ``enforce_capacity=False`` is for jobs that were ALREADY admitted
+        once — journal replay and a crashed worker's un-run dispatch-group
+        tail: their 202 was acknowledged, so capacity (which bounds NEW
+        admissions) must not drop them; the transient overshoot is bounded
+        by the previous incarnation's capacity + one dispatch group."""
         with self._nonempty:
             if self._closed:
                 raise QueueClosed("service is draining; no new jobs")
@@ -157,20 +200,44 @@ class BoundedJobQueue:
                 if job.job_class == SMALL_CLASS
                 else (self._large, self.large_capacity)
             )
-            if len(lane) >= capacity:
+            if enforce_capacity and len(lane) >= capacity:
                 raise QueueFull(job.job_class, capacity)
             lane.append(job)
-            self._nonempty.notify()
+            # notify_all, not notify: per-slice workers wait for DIFFERENT
+            # classes on this one condition, and waking only one could
+            # wake a worker whose classes stay empty while the right one
+            # sleeps.
+            self._nonempty.notify_all()
 
     # -------------------------------------------------------------- worker
 
-    def pop(self, timeout: Optional[float] = None) -> Optional[Job]:
-        """Next job for the worker — every queued small job ahead of any
-        large one. Returns ``None`` on timeout or when the queue is
-        closed and empty (check :meth:`drained` to distinguish)."""
+    def _lanes(self, classes: Optional[Sequence[str]]) -> List[Deque[Job]]:
+        """Lanes in pop priority order (small first) for a class filter;
+        ``None`` = both (the shared-slice worker)."""
+        if classes is None:
+            return [self._small, self._large]
+        lanes = []
+        if SMALL_CLASS in classes:
+            lanes.append(self._small)
+        if LARGE_CLASS in classes:
+            lanes.append(self._large)
+        if not lanes:
+            raise ValueError(f"no known job class in {classes!r}")
+        return lanes
+
+    def pop(
+        self,
+        timeout: Optional[float] = None,
+        classes: Optional[Sequence[str]] = None,
+    ) -> Optional[Job]:
+        """Next job for a worker serving ``classes`` (``None`` = both) —
+        every queued small job ahead of any large one. Returns ``None``
+        on timeout or when the queue is closed and empty of those classes
+        (check :meth:`drained_for` to distinguish)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._nonempty:
-            while not self._small and not self._large:
+            lanes = self._lanes(classes)
+            while not any(lanes):
                 if self._closed:
                     return None
                 remaining = (
@@ -179,8 +246,54 @@ class BoundedJobQueue:
                 if remaining is not None and remaining <= 0:
                     return None
                 self._nonempty.wait(remaining)
-            lane = self._small if self._small else self._large
-            return lane.popleft()
+            for lane in lanes:
+                if lane:
+                    return lane.popleft()
+            return None  # unreachable; keeps the type checker honest
+
+    def pop_batch(
+        self,
+        timeout: Optional[float] = None,
+        classes: Optional[Sequence[str]] = None,
+        max_batch: int = DEFAULT_BATCH_MAX_JOBS,
+        linger_seconds: float = DEFAULT_BATCH_LINGER_SECONDS,
+    ) -> List[Job]:
+        """One dispatch group: the next job plus, when it is a SMALL job
+        with a batch key, every queued small job with the SAME key — up to
+        ``max_batch`` jobs, lingering up to ``linger_seconds`` for more
+        compatible arrivals when the group is not yet full. Large jobs
+        never batch (group of one). Non-matching small jobs keep their
+        queue order untouched. Returns ``[]`` on timeout/closed-empty."""
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        first = self.pop(timeout=timeout, classes=classes)
+        if first is None:
+            return []
+        if (
+            first.job_class != SMALL_CLASS
+            or first.batch_key is None
+            or max_batch == 1
+        ):
+            return [first]
+        batch = [first]
+        linger_deadline = time.monotonic() + max(0.0, float(linger_seconds))
+        with self._nonempty:
+            while len(batch) < max_batch:
+                matched = [
+                    job
+                    for job in self._small
+                    if job.batch_key == first.batch_key
+                ]
+                for job in matched[: max_batch - len(batch)]:
+                    self._small.remove(job)
+                    batch.append(job)
+                if len(batch) >= max_batch or self._closed:
+                    break
+                remaining = linger_deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._nonempty.wait(remaining)
+        return batch
 
     # ---------------------------------------------------------- management
 
@@ -213,6 +326,13 @@ class BoundedJobQueue:
         with self._lock:
             return self._closed and not self._small and not self._large
 
+    def drained_for(self, classes: Optional[Sequence[str]] = None) -> bool:
+        """Closed AND empty of the given classes — a per-slice worker's
+        exit condition (a small-slice worker must not keep spinning for a
+        large backlog it will never pop)."""
+        with self._lock:
+            return self._closed and not any(self._lanes(classes))
+
     def depth(self) -> Dict[str, int]:
         with self._lock:
             return {
@@ -231,6 +351,8 @@ __all__ = [
     "SMALL_JOB_MAX_SITES",
     "DEFAULT_SMALL_CAPACITY",
     "DEFAULT_LARGE_CAPACITY",
+    "DEFAULT_BATCH_MAX_JOBS",
+    "DEFAULT_BATCH_LINGER_SECONDS",
     "QueueFull",
     "QueueClosed",
     "Job",

@@ -25,21 +25,36 @@ import threading
 from typing import Optional, Set, Tuple
 
 
-def enable_persistent_compile_cache() -> None:
-    """No-op when SPARK_EXAMPLES_TPU_NO_CACHE=1 (test/CI hygiene: no writes
-    outside the working tree); never raises."""
-    if os.environ.get("SPARK_EXAMPLES_TPU_NO_CACHE") == "1":
-        return
+def enable_persistent_compile_cache(cache_dir: Optional[str] = None) -> None:
+    """Point XLA's persistent compilation cache at ``cache_dir`` (the
+    resident daemon keys it under its run directory, so a restarted daemon
+    reloads the previous incarnation's compile artifacts instead of paying
+    the ~9.5 s whole-genome recompile) or, by default, the shared
+    per-user location the CLI and the benchmark use.
+
+    The default location is a write OUTSIDE the working tree, so
+    ``SPARK_EXAMPLES_TPU_NO_CACHE=1`` (test/CI hygiene) disables it; an
+    EXPLICIT ``cache_dir`` is caller-owned placement (the daemon's run
+    dir, a test's tmp dir) and is honored regardless. An explicit dir
+    also persists EVERY compile (min-compile-time 0): the daemon's
+    geometry ledger claims "warm" for every fingerprint it primes, which
+    is only honest if sub-second compiles left artifacts too — the
+    shared default location keeps the 1 s floor so ad-hoc CLI runs don't
+    churn it with trivia. Never raises."""
+    min_compile_seconds = 0.0 if cache_dir is not None else 1.0
+    if cache_dir is None:
+        if os.environ.get("SPARK_EXAMPLES_TPU_NO_CACHE") == "1":
+            return
+        cache_dir = os.path.join(
+            os.path.expanduser("~/.cache"), "spark_examples_tpu", "jax_cache"
+        )
     try:
         import jax
 
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update(
-            "jax_compilation_cache_dir",
-            os.path.join(
-                os.path.expanduser("~/.cache"), "spark_examples_tpu", "jax_cache"
-            ),
+            "jax_persistent_cache_min_compile_time_secs", min_compile_seconds
         )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception as e:  # never block the caller on cache configuration
         import sys
 
@@ -84,12 +99,39 @@ _NON_GEOMETRY_FIELDS = frozenset(
     }
 )
 
+#: Conf fields that select WHICH contig windows stream through the
+#: compiled programs without changing the programs themselves: blocks are
+#: shaped by (block_size, cohort width), not by the region list. Excluded
+#: from :func:`batch_compile_fingerprint` (the continuous-batching
+#: compatibility key) ON TOP of the non-geometry fields — two small-region
+#: queries over different windows of the same cohort dispatch through the
+#: same warm kernels and may coalesce into one dispatch group.
+_REGION_FIELDS = frozenset({"references", "all_references"})
+
 # lock order: geometry-ledger lock is a leaf — nothing else is acquired
 # while holding it (machine-checked by `graftcheck lockgraph`).
 _geometry_lock = threading.Lock()
 _seen_geometries: Set[str] = set()
 _geometry_hits = 0
 _geometry_misses = 0
+_ledger_path: Optional[str] = None
+
+
+def _fingerprint_doc(conf, kind: str, exclude: frozenset) -> str:
+    fields = getattr(conf, "__dataclass_fields__", None)
+    if fields is not None:
+        doc = {
+            name: getattr(conf, name)
+            for name in sorted(fields)
+            if name not in exclude
+        }
+    else:  # mapping-shaped confs (tests)
+        doc = {
+            k: v for k, v in sorted(dict(conf).items()) if k not in exclude
+        }
+    doc["__kind__"] = kind
+    blob = json.dumps(doc, sort_keys=True, default=repr)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
 
 
 def compile_fingerprint(conf, kind: str = "pca") -> str:
@@ -99,22 +141,21 @@ def compile_fingerprint(conf, kind: str = "pca") -> str:
     never compiles the center/eigh kernels, so it must not pre-warm the
     PCA fingerprint. Two equal fingerprints compile (and dispatch)
     identical programs."""
-    fields = getattr(conf, "__dataclass_fields__", None)
-    if fields is not None:
-        doc = {
-            name: getattr(conf, name)
-            for name in sorted(fields)
-            if name not in _NON_GEOMETRY_FIELDS
-        }
-    else:  # mapping-shaped confs (tests)
-        doc = {
-            k: v
-            for k, v in sorted(dict(conf).items())
-            if k not in _NON_GEOMETRY_FIELDS
-        }
-    doc["__kind__"] = kind
-    blob = json.dumps(doc, sort_keys=True, default=repr)
-    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+    return _fingerprint_doc(conf, kind, _NON_GEOMETRY_FIELDS)
+
+
+def batch_compile_fingerprint(conf, kind: str = "pca") -> str:
+    """The continuous-batching compatibility key (``serve/queue.py``):
+    :func:`compile_fingerprint` made region-invariant. Two requests with
+    equal batch fingerprints differ at most in WHICH contig windows they
+    scan — same cohort width, block size, mesh, strategy, dtype ladder,
+    ingest path — so they dispatch through the same compiled kernels and
+    can safely ride one dispatch group back to back. Strictly coarser
+    than the compile fingerprint, never coarser than the admission
+    class."""
+    return _fingerprint_doc(
+        conf, kind, _NON_GEOMETRY_FIELDS | _REGION_FIELDS
+    )
 
 
 def geometry_seen(key: str) -> bool:
@@ -127,7 +168,9 @@ def geometry_seen(key: str) -> bool:
 def record_geometry(key: str) -> bool:
     """Record one run of geometry ``key``; returns ``True`` when the
     geometry was already warm (hit) and ``False`` on first sight (miss).
-    The hit/miss counters move exactly once per call."""
+    The hit/miss counters move exactly once per call. With a persistent
+    ledger attached (:func:`attach_geometry_ledger`), a first-sight key is
+    appended to the ledger file so the NEXT process primes it back."""
     global _geometry_hits, _geometry_misses
     with _geometry_lock:
         if key in _seen_geometries:
@@ -135,7 +178,62 @@ def record_geometry(key: str) -> bool:
             return True
         _seen_geometries.add(key)
         _geometry_misses += 1
-        return False
+        ledger = _ledger_path
+    # Outside the leaf lock: an fsync'd file append must never extend the
+    # ledger lock's hold time (O_APPEND keeps concurrent writers whole).
+    if ledger is not None:
+        try:
+            with open(ledger, "a", encoding="utf-8") as f:
+                f.write(key + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as e:
+            import sys
+
+            print(
+                f"warning: geometry ledger append failed ({e}); the next "
+                "daemon incarnation will see this geometry cold",
+                file=sys.stderr,
+            )
+    return False
+
+
+def attach_geometry_ledger(path: str) -> int:
+    """Make the warm-geometry ledger survive process restarts: prime
+    ``_seen_geometries`` from ``path`` (one fingerprint per line; a torn
+    final line from a crashed append is skipped) and append every future
+    first-sight geometry there. Returns the number of primed geometries.
+
+    A primed fingerprint makes ``geometry_seen`` answer ``True`` in a
+    process that never compiled it — that is the POINT: paired with the
+    persistent XLA compilation cache keyed under the same run directory
+    (``enable_persistent_compile_cache``), a repeat-geometry job after a
+    daemon restart rebuilds its jit entries from disk artifacts instead of
+    recompiling, so "warm" honestly means "no from-scratch compile", not
+    only "in-process jit cache populated". Priming moves no hit/miss
+    counters — those stay the lifetime record of THIS process's jobs."""
+    global _ledger_path
+    primed = 0
+    keys = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                key = line.strip()
+                # A fingerprint is exactly 16 hex chars; anything else is
+                # a torn append from a killed writer — skip, don't raise.
+                if len(key) == 16 and all(
+                    c in "0123456789abcdef" for c in key
+                ):
+                    keys.append(key)
+    except FileNotFoundError:
+        pass
+    with _geometry_lock:
+        for key in keys:
+            if key not in _seen_geometries:
+                _seen_geometries.add(key)
+                primed += 1
+        _ledger_path = path
+    return primed
 
 
 def compile_cache_stats() -> Tuple[int, int]:
@@ -147,19 +245,22 @@ def compile_cache_stats() -> Tuple[int, int]:
 def reset_compile_cache_stats() -> None:
     """Clear the ledger and counters (tests and bench isolation only —
     the daemon never resets: its counters are the service's lifetime
-    warm-vs-cold record)."""
-    global _geometry_hits, _geometry_misses
+    warm-vs-cold record). Detaches any persistent ledger file too."""
+    global _geometry_hits, _geometry_misses, _ledger_path
     with _geometry_lock:
         _seen_geometries.clear()
         _geometry_hits = 0
         _geometry_misses = 0
+        _ledger_path = None
 
 
 __all__ = [
     "enable_persistent_compile_cache",
     "compile_fingerprint",
+    "batch_compile_fingerprint",
     "geometry_seen",
     "record_geometry",
+    "attach_geometry_ledger",
     "compile_cache_stats",
     "reset_compile_cache_stats",
 ]

@@ -1,0 +1,816 @@
+"""Executor slices, continuous batching, and the job journal (serve/).
+
+The PR-12 serving-concurrency contract:
+
+- ``parallel/mesh.py:plan_executor_slices`` — deterministic device-range
+  math: shared topology at 0 small slices, large slice never starved,
+  index ranges disjoint and covering.
+- ``serve/queue.py`` — class-filtered pops (a small-slice worker never
+  sees large jobs), fingerprint-keyed ``pop_batch`` coalescing with
+  max-batch and linger bounds.
+- ``serve/daemon.py`` — small jobs complete WHILE a large job holds the
+  large slice; a crashing large job never takes a small-slice worker
+  with it; N concurrent submitters lose no jobs and duplicate none.
+- ``serve/journal.py`` — accepted jobs survive a daemon "death"
+  (simulated: a second service over the same run dir, the exact replay
+  path a SIGKILL'd daemon takes — the ci.sh smoke kills a real process);
+  requeue-once preserved via the journaled ``device_began`` flag.
+- batching parity — a coalesced dispatch group's results are
+  byte-identical to serial execution of the same requests.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from spark_examples_tpu.parallel.mesh import (
+    ExecutorSlice,
+    plan_executor_slices,
+    resolve_small_slices,
+)
+from spark_examples_tpu.serve.daemon import PcaService
+from spark_examples_tpu.serve.executor import ExecutionOutcome
+from spark_examples_tpu.serve.journal import (
+    JobJournal,
+    compact_journal,
+    replay_journal,
+)
+from spark_examples_tpu.serve.protocol import request_doc
+from spark_examples_tpu.serve.queue import (
+    LARGE_CLASS,
+    SMALL_CLASS,
+    BoundedJobQueue,
+    Job,
+    classify_conf,
+)
+from spark_examples_tpu.utils import faults
+from spark_examples_tpu.utils.cache import (
+    batch_compile_fingerprint,
+    compile_fingerprint,
+)
+
+@pytest.fixture(autouse=True)
+def _reset_fault_plan():
+    """Every test starts and ends with no active fault plan (the crash
+    tests configure one; a leak would poison unrelated tests)."""
+    faults.configure(None)
+    yield
+    faults.configure(None)
+
+
+TINY_FLAGS = ["--num-samples", "8", "--references", "1:0:50000"]
+TINY_FLAGS_B = ["--num-samples", "8", "--references", "2:0:50000"]
+LARGE_FLAGS = ["--num-samples", "8", "--all-references"]
+
+
+def _job(job_id, job_class=SMALL_CLASS, batch_key=None):
+    return Job(
+        id=job_id,
+        request=None,
+        conf=None,
+        job_class=job_class,
+        submitted_unix=time.time(),
+        batch_key=batch_key,
+    )
+
+
+def _wait_status(service, job_id, statuses, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        _http, doc = service.job_status(job_id)
+        if doc.get("job", {}).get("status") in statuses:
+            return doc["job"]
+        time.sleep(0.02)
+    raise AssertionError(
+        f"job {job_id} never reached {statuses}: {service.job_status(job_id)}"
+    )
+
+
+# ----------------------------------------------------------- slice math
+
+
+def test_plan_executor_slices_shared_topology():
+    (shared,) = plan_executor_slices(8, small_slices=0)
+    assert shared.name == "shared"
+    assert set(shared.job_classes) == {SMALL_CLASS, LARGE_CLASS}
+    assert shared.device_indices() == tuple(range(8))
+
+
+def test_plan_executor_slices_partitions_disjoint_and_covering():
+    slices = plan_executor_slices(8, small_slices=2, small_slice_devices=2)
+    assert [s.name for s in slices] == ["large", "small-0", "small-1"]
+    assert slices[0].job_classes == (LARGE_CLASS,)
+    assert all(s.job_classes == (SMALL_CLASS,) for s in slices[1:])
+    covered = [i for s in slices for i in s.device_indices()]
+    assert sorted(covered) == list(range(8))  # disjoint + covering
+    assert slices[0].device_count == 4
+
+
+def test_plan_executor_slices_rejects_starved_large_slice():
+    with pytest.raises(ValueError, match="leaving none for the large"):
+        plan_executor_slices(2, small_slices=2, small_slice_devices=1)
+    with pytest.raises(ValueError, match="device_count"):
+        plan_executor_slices(0)
+    with pytest.raises(ValueError, match="small_slice_devices"):
+        plan_executor_slices(4, small_slices=1, small_slice_devices=0)
+
+
+def test_resolve_small_slices_auto_rule():
+    assert resolve_small_slices("auto", 8) == 1
+    assert resolve_small_slices(None, 1) == 0
+    assert resolve_small_slices(3, 8) == 3
+    with pytest.raises(ValueError):
+        resolve_small_slices(-1, 8)
+
+
+def test_executor_slice_validation():
+    with pytest.raises(ValueError, match=">= 1 device"):
+        ExecutorSlice("x", (SMALL_CLASS,), 0, 0)
+    with pytest.raises(ValueError, match="no job class"):
+        ExecutorSlice("x", (), 0, 1)
+
+
+# -------------------------------------------------- classify w/ limit
+
+
+def test_classify_conf_honors_small_site_limit():
+    from spark_examples_tpu.config import PcaConf
+
+    conf = PcaConf()
+    conf.references = "1:0:50000"  # ~500 candidate sites
+    assert classify_conf(conf) == SMALL_CLASS
+    assert classify_conf(conf, small_site_limit=100) == LARGE_CLASS
+    assert classify_conf(conf, small_site_limit=501) == SMALL_CLASS
+
+
+# -------------------------------------------------- class-filtered pops
+
+
+def test_pop_classes_filter_and_drained_for():
+    q = BoundedJobQueue()
+    q.put(_job("S1"))
+    q.put(_job("L1", LARGE_CLASS))
+    # A small-only worker never sees the large job.
+    assert q.pop(timeout=1, classes=(SMALL_CLASS,)).id == "S1"
+    assert q.pop(timeout=0.05, classes=(SMALL_CLASS,)) is None
+    q.close()
+    assert q.drained_for((SMALL_CLASS,))
+    assert not q.drained_for((LARGE_CLASS,))
+    assert not q.drained
+    assert q.pop(timeout=1, classes=(LARGE_CLASS,)).id == "L1"
+    assert q.drained_for((LARGE_CLASS,)) and q.drained
+
+
+def test_pop_unknown_class_rejected():
+    q = BoundedJobQueue()
+    with pytest.raises(ValueError):
+        q.pop(timeout=0.01, classes=("medium",))
+
+
+# ---------------------------------------------------- continuous batching
+
+
+def test_pop_batch_coalesces_same_key_small_jobs():
+    q = BoundedJobQueue()
+    for i in range(3):
+        q.put(_job(f"A{i}", batch_key="geomA"))
+    q.put(_job("B0", batch_key="geomB"))
+    q.put(_job("A3", batch_key="geomA"))
+    batch = q.pop_batch(timeout=1, max_batch=8)
+    assert [j.id for j in batch] == ["A0", "A1", "A2", "A3"]
+    # The non-matching job kept its queue position.
+    assert q.pop(timeout=1).id == "B0"
+
+
+def test_pop_batch_respects_max_batch():
+    q = BoundedJobQueue()
+    for i in range(5):
+        q.put(_job(f"A{i}", batch_key="geom"))
+    batch = q.pop_batch(timeout=1, max_batch=3)
+    assert [j.id for j in batch] == ["A0", "A1", "A2"]
+    assert [j.id for j in q.pop_batch(timeout=1, max_batch=3)] == [
+        "A3",
+        "A4",
+    ]
+
+
+def test_pop_batch_large_and_keyless_jobs_never_coalesce():
+    q = BoundedJobQueue()
+    q.put(_job("L1", LARGE_CLASS, batch_key="geom"))
+    q.put(_job("L2", LARGE_CLASS, batch_key="geom"))
+    assert [j.id for j in q.pop_batch(timeout=1)] == ["L1"]
+    q2 = BoundedJobQueue()
+    q2.put(_job("S1"))  # batch_key None
+    q2.put(_job("S2"))
+    assert [j.id for j in q2.pop_batch(timeout=1)] == ["S1"]
+
+
+def test_pop_batch_linger_collects_late_arrival():
+    q = BoundedJobQueue()
+    q.put(_job("A0", batch_key="geom"))
+
+    def late_put():
+        time.sleep(0.1)
+        q.put(_job("A1", batch_key="geom"))
+
+    t = threading.Thread(target=late_put)
+    t.start()
+    batch = q.pop_batch(timeout=1, max_batch=4, linger_seconds=1.0)
+    t.join()
+    assert [j.id for j in batch] == ["A0", "A1"]
+
+
+def test_pop_batch_no_linger_dispatches_immediately():
+    q = BoundedJobQueue()
+    q.put(_job("A0", batch_key="geom"))
+    started = time.monotonic()
+    batch = q.pop_batch(timeout=1, max_batch=4, linger_seconds=0.0)
+    assert [j.id for j in batch] == ["A0"]
+    assert time.monotonic() - started < 0.5
+
+
+# ----------------------------------------------------- batch fingerprint
+
+
+def test_batch_fingerprint_region_invariant_but_geometry_sensitive():
+    from spark_examples_tpu.config import PcaConf
+
+    a = PcaConf()
+    a.references = "1:0:50000"
+    b = PcaConf()
+    b.references = "2:100000:900000,3:0:50000"
+    # Different regions: different compile fingerprints, SAME batch key.
+    assert compile_fingerprint(a) != compile_fingerprint(b)
+    assert batch_compile_fingerprint(a) == batch_compile_fingerprint(b)
+    # Cohort width changes the compiled shapes: different batch key.
+    c = PcaConf()
+    c.references = "1:0:50000"
+    c.num_samples = a.num_samples + 1
+    assert batch_compile_fingerprint(a) != batch_compile_fingerprint(c)
+    # Kind is part of the key.
+    assert batch_compile_fingerprint(a, kind="pca") != (
+        batch_compile_fingerprint(a, kind="similarity")
+    )
+
+
+# ------------------------------------------------------------- journal
+
+
+def test_journal_round_trip_and_replay(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    journal = JobJournal(path)
+    doc1 = request_doc(TINY_FLAGS, tag="t1")
+    doc2 = request_doc(LARGE_FLAGS)
+    journal.accepted("job-000001", doc1, SMALL_CLASS, 1.0, None)
+    journal.accepted("job-000002", doc2, LARGE_CLASS, 2.0, 32.0)
+    journal.began("job-000002")
+    journal.accepted("job-000003", doc1, SMALL_CLASS, 3.0, None)
+    journal.terminal("job-000001", "done")
+    journal.close()
+    pending, max_seq = replay_journal(path)
+    assert max_seq == 3
+    assert [(p.job_id, p.device_began) for p in pending] == [
+        ("job-000002", True),
+        ("job-000003", False),
+    ]
+    assert pending[0].deadline_unix == 32.0
+    assert pending[1].request_doc == doc1
+
+
+def test_journal_torn_last_line_skipped(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    journal = JobJournal(path)
+    journal.accepted(
+        "job-000001", request_doc(TINY_FLAGS), SMALL_CLASS, 1.0, None
+    )
+    journal.close()
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"event": "terminal", "id": "job-0000')  # torn mid-write
+    pending, max_seq = replay_journal(path)
+    assert [p.job_id for p in pending] == ["job-000001"]
+    assert max_seq == 1
+
+
+def test_journal_compaction_drops_settled_records(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    journal = JobJournal(path)
+    for i in (1, 2, 3):
+        journal.accepted(
+            f"job-{i:06d}", request_doc(TINY_FLAGS), SMALL_CLASS, 1.0, None
+        )
+    journal.terminal("job-000001", "done")
+    journal.began("job-000002")
+    journal.close()
+    pending, _seq = replay_journal(path)
+    compact_journal(path, [p for p in pending if not p.device_began])
+    lines = [
+        json.loads(line)
+        for line in open(path, encoding="utf-8")
+        if line.strip()
+    ]
+    assert [r["id"] for r in lines] == ["job-000003"]
+    # Replay over the compacted file sees only the surviving job.
+    pending2, seq2 = replay_journal(path)
+    assert [p.job_id for p in pending2] == ["job-000003"]
+    assert seq2 == 3
+
+
+def test_journal_missing_file_is_empty(tmp_path):
+    pending, max_seq = replay_journal(str(tmp_path / "nope.jsonl"))
+    assert pending == [] and max_seq == 0
+
+
+def test_journal_replay_is_order_insensitive(tmp_path):
+    """began/terminal records landing BEFORE their accepted record (the
+    appenders are concurrent threads) still count: a settled job never
+    resurrects and a began job keeps the no-silent-re-run pin."""
+    path = str(tmp_path / "j.jsonl")
+    journal = JobJournal(path)
+    journal.began("job-000001")
+    journal.terminal("job-000001", "done")
+    journal.accepted(
+        "job-000001", request_doc(TINY_FLAGS), SMALL_CLASS, 1.0, None
+    )
+    journal.began("job-000002")
+    journal.accepted(
+        "job-000002", request_doc(TINY_FLAGS), SMALL_CLASS, 2.0, None
+    )
+    journal.close()
+    pending, _seq = replay_journal(path)
+    assert [(p.job_id, p.device_began) for p in pending] == [
+        ("job-000002", True)
+    ]
+
+
+def test_queue_put_capacity_exempt_for_readmissions():
+    q = BoundedJobQueue(small_capacity=1, large_capacity=1)
+    q.put(_job("S1"))
+    with pytest.raises(Exception):
+        q.put(_job("S2"))
+    # A replayed/requeued job was already admitted once: no 429.
+    q.put(_job("S2"), enforce_capacity=False)
+    assert q.pop(timeout=1).id == "S1"
+    assert q.pop(timeout=1).id == "S2"
+
+
+def test_rejected_admission_leaves_journal_tombstone(tmp_path):
+    """A 429'd submit must not replay on restart: the accepted record it
+    journaled before the put carries a terminal tombstone."""
+    from spark_examples_tpu.serve.journal import journal_path
+
+    gate = GateExecutor(block_classes=("small", "large"))
+    service = PcaService(
+        run_dir=str(tmp_path / "serve"),
+        executor=gate,
+        small_capacity=1,
+        small_slices=0,
+    ).start()
+    try:
+        assert service.submit(request_doc(TINY_FLAGS))[0] == 202
+        assert gate.started.wait(timeout=10)
+        assert service.submit(request_doc(TINY_FLAGS))[0] == 202  # fills lane
+        status, _body = service.submit(request_doc(TINY_FLAGS))
+        assert status == 429
+        pending, _seq = replay_journal(
+            journal_path(str(tmp_path / "serve"))
+        )
+        # Only the two genuinely admitted jobs are replayable.
+        assert len(pending) == 2
+    finally:
+        gate.release.set()
+        service.stop(timeout=30)
+
+
+# ------------------------------------------------ daemon: slice topology
+
+
+class GateExecutor:
+    """Stub executor recording (id, slice, batch_size); large jobs block
+    on the gate so the concurrency window is deterministic."""
+
+    def __init__(self, block_classes=("large",)):
+        self.order = []
+        self.release = threading.Event()
+        self.started = threading.Event()
+        self.block_classes = block_classes
+        self._lock = threading.Lock()  # lock order: test-local leaf
+
+    def __call__(self, job, run_dir):
+        with self._lock:
+            self.order.append((job.id, job.slice, job.batch_size))
+        self.started.set()
+        if job.job_class in self.block_classes:
+            assert self.release.wait(timeout=30), "gate never released"
+        return ExecutionOutcome(
+            result={"stub": True}, manifest_path=None, compile_cache="cold"
+        )
+
+
+@pytest.fixture
+def sliced_service(tmp_path):
+    gate = GateExecutor()
+    service = PcaService(
+        run_dir=str(tmp_path / "serve"),
+        executor=gate,
+        small_slices=1,
+    ).start()
+    yield service, gate
+    gate.release.set()
+    service.stop(timeout=30)
+
+
+def test_sliced_service_topology_and_admission_devices(sliced_service):
+    service, _gate = sliced_service
+    health = service.healthz()
+    names = [s["name"] for s in health["slices"]]
+    assert names == ["large", "small-0"]
+    # conftest forces 8 virtual devices: large gets 7, small slice 1.
+    assert service.admission_devices(SMALL_CLASS) == 1
+    assert service.admission_devices(LARGE_CLASS) == 7
+    assert health["queue"]["worker_alive"]
+
+
+def test_small_job_completes_while_large_job_runs(sliced_service):
+    service, gate = sliced_service
+    status, large = service.submit(request_doc(LARGE_FLAGS))
+    assert status == 202, large
+    assert gate.started.wait(timeout=10)
+    status, small = service.submit(request_doc(TINY_FLAGS))
+    assert status == 202, small
+    done = _wait_status(service, small["job"]["id"], {"done"})
+    assert done["slice"] == "small-0"
+    # The large job is still ON the devices: no head-of-line blocking.
+    _status, ldoc = service.job_status(large["job"]["id"])
+    assert ldoc["job"]["status"] == "running"
+    assert ldoc["job"]["slice"] == "large"
+    gate.release.set()
+    _wait_status(service, large["job"]["id"], {"done"})
+
+
+def test_small_admission_validates_against_small_slice_devices(
+    sliced_service,
+):
+    """A small job demanding a mesh bigger than its slice is rejected —
+    the SAME geometry as a large job passes against the large slice."""
+    service, gate = sliced_service
+    mesh_flags = ["--num-samples", "8", "--mesh-shape", "1,2"]
+    status, body = service.submit(
+        request_doc(mesh_flags + ["--references", "1:0:50000"])
+    )
+    assert status == 400, body
+    codes = [i["code"] for i in body["plan"]["issues"]]
+    assert "mesh-exceeds-devices" in codes
+    assert body["plan"]["geometry"]["plan_devices"] == 1
+    gate.release.set()
+    status, body = service.submit(request_doc(mesh_flags + ["--all-references"]))
+    assert status == 202, body
+
+
+def test_crashing_large_job_never_kills_small_slice(sliced_service):
+    """Per-slice isolation: an InjectedWorkerCrash escaping a LARGE job
+    kills only the large slice's worker; small jobs keep completing, the
+    watchdog replaces the large worker, and the crashed job fails with
+    the structured error."""
+    service, gate = sliced_service
+
+    crash_once = threading.Event()
+    original_call = gate.__call__
+
+    def crashing_call(job, run_dir):
+        if job.job_class == LARGE_CLASS and not crash_once.is_set():
+            crash_once.set()
+            gate.order.append((job.id, job.slice, job.batch_size))
+            raise faults.InjectedWorkerCrash("large job crashed")
+        return original_call(job, run_dir)
+
+    service._executor = crashing_call
+    status, large = service.submit(request_doc(LARGE_FLAGS))
+    assert status == 202
+    crashed = _wait_status(service, large["job"]["id"], {"failed"})
+    assert crashed["error"].startswith("worker-crashed:")
+    # Small slice untouched, still serving.
+    status, small = service.submit(request_doc(TINY_FLAGS))
+    assert status == 202
+    assert _wait_status(service, small["job"]["id"], {"done"})
+    health = service.healthz()
+    assert health["queue"]["worker_restarts"] == 1
+    assert all(s["worker_alive"] for s in health["slices"])
+    # And the replaced large worker serves large jobs again.
+    gate.release.set()
+    status, large2 = service.submit(request_doc(LARGE_FLAGS))
+    assert status == 202
+    assert _wait_status(service, large2["job"]["id"], {"done"})
+
+
+# --------------------------------------------------- stress: no lost jobs
+
+
+def test_concurrent_submitters_lose_and_duplicate_nothing(tmp_path):
+    """N submitter threads x mixed kinds: every 202'd job reaches exactly
+    one terminal state and the executor ran each at most once (exactly
+    once for done jobs) — no lost, no duplicated work under the per-slice
+    worker concurrency."""
+    executed = []
+    lock = threading.Lock()  # lock order: test-local leaf
+
+    def executor(job, run_dir):
+        with lock:
+            executed.append(job.id)
+        return ExecutionOutcome(
+            result={"ok": True}, manifest_path=None, compile_cache="cold"
+        )
+
+    service = PcaService(
+        run_dir=str(tmp_path / "serve"),
+        executor=executor,
+        small_slices=1,
+        small_capacity=64,
+        large_capacity=64,
+        terminal_retention=512,
+    ).start()
+    try:
+        accepted = []
+        accepted_lock = threading.Lock()  # lock order: test-local leaf
+        kinds = [
+            (TINY_FLAGS, "pca"),
+            (TINY_FLAGS_B, "pca"),
+            (TINY_FLAGS, "similarity"),
+            (LARGE_FLAGS, "pca"),
+        ]
+
+        def submitter(seed):
+            for i in range(6):
+                flags, kind = kinds[(seed + i) % len(kinds)]
+                status, doc = service.submit(request_doc(flags, kind=kind))
+                assert status == 202, doc
+                with accepted_lock:
+                    accepted.append(doc["job"]["id"])
+
+        threads = [
+            threading.Thread(target=submitter, args=(s,)) for s in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(accepted) == 24
+        assert len(set(accepted)) == 24  # no id reuse
+        for job_id in accepted:
+            job = _wait_status(service, job_id, {"done"}, timeout=60)
+            assert job["status"] == "done"
+        assert sorted(executed) == sorted(accepted)  # exactly once each
+    finally:
+        assert service.stop(timeout=30)
+
+
+# ------------------------------------------------- journal replay (daemon)
+
+
+def test_daemon_restart_replays_queued_job_and_fails_began_job(tmp_path):
+    """Process-death simulation over one run dir: the successor daemon
+    replays the journal — the queued job completes, the mid-device job
+    fails with `daemon-restarted`, ids stay stable, and the terminal
+    records of the previous life do NOT resurrect."""
+    run_dir = str(tmp_path / "serve")
+    gate = GateExecutor()
+    first = PcaService(run_dir=run_dir, executor=gate, small_slices=0).start()
+    status, done_doc = first.submit(request_doc(TINY_FLAGS))
+    assert status == 202
+    _wait_status(first, done_doc["job"]["id"], {"done"})
+    status, running_doc = first.submit(request_doc(LARGE_FLAGS))
+    assert status == 202
+    assert gate.started.wait(timeout=10)
+    _wait_status(first, running_doc["job"]["id"], {"running"})
+    status, queued_doc = first.submit(request_doc(LARGE_FLAGS))
+    assert status == 202
+    # "SIGKILL": abandon `first` without draining (its gate stays held;
+    # the ci.sh smoke does this against a real process with kill -9).
+
+    finisher = GateExecutor(block_classes=())
+    second = PcaService(
+        run_dir=run_dir, executor=finisher, small_slices=0
+    ).start()
+    try:
+        health = second.healthz()
+        assert health["warm_state"]["journal_replayed"] == 2
+        # The mid-device job: failed, never re-run.
+        crashed = _wait_status(
+            second, running_doc["job"]["id"], {"failed"}
+        )
+        assert "daemon-restarted" in crashed["error"]
+        # The queued job: replayed and finished by the successor.
+        replayed = _wait_status(second, queued_doc["job"]["id"], {"done"})
+        assert replayed["status"] == "done"
+        # The terminal job of the previous life did not resurrect.
+        _status, done_again = second.job_status(done_doc["job"]["id"])
+        assert done_again["error"]["code"] == "unknown-job"
+        # New admissions continue the id sequence past the replayed ids.
+        status, fresh = second.submit(request_doc(TINY_FLAGS))
+        assert status == 202
+        assert fresh["job"]["id"] > queued_doc["job"]["id"]
+        _wait_status(second, fresh["job"]["id"], {"done"})
+    finally:
+        gate.release.set()
+        first.stop(timeout=30)
+        second.stop(timeout=30)
+
+
+def test_replayed_job_rides_no_second_requeue(tmp_path):
+    """Requeue-once across lives: a replayed job whose worker then
+    crashes at claim is failed (the restart consumed its one retry)."""
+    run_dir = str(tmp_path / "serve")
+    gate = GateExecutor()
+    first = PcaService(run_dir=run_dir, executor=gate, small_slices=0).start()
+    status, running_doc = first.submit(request_doc(LARGE_FLAGS))
+    assert status == 202
+    assert gate.started.wait(timeout=10)
+    status, queued_doc = first.submit(request_doc(LARGE_FLAGS))
+    assert status == 202
+
+    faults.configure("crash@serve.worker.claim")
+    try:
+        second = PcaService(
+            run_dir=run_dir, executor=GateExecutor(block_classes=())
+        ).start()
+        try:
+            job = _wait_status(
+                second, queued_doc["job"]["id"], {"failed"}, timeout=30
+            )
+            assert "requeue" in job["error"]
+        finally:
+            second.stop(timeout=30)
+    finally:
+        faults.configure(None)
+        gate.release.set()
+        first.stop(timeout=30)
+
+
+# --------------------------------------------------- batching parity e2e
+
+
+def test_batched_results_byte_identical_to_serial(tmp_path):
+    """Real executor: three compatible small jobs coalesced into one
+    dispatch group return byte-identical PC rows to the same requests run
+    serially (and to each other where the request is identical)."""
+    from spark_examples_tpu.pipeline.pca_driver import run
+
+    serial = {
+        tuple(TINY_FLAGS): run(TINY_FLAGS),
+        tuple(TINY_FLAGS_B): run(TINY_FLAGS_B),
+    }
+    gate = GateExecutor(block_classes=("small", "large"))
+    service = PcaService(
+        run_dir=str(tmp_path / "serve"), small_slices=0
+    ).start()
+    try:
+        # Occupy the shared worker so the next three jobs coalesce.
+        service._executor = gate
+        status, blocker = service.submit(request_doc(TINY_FLAGS))
+        assert status == 202
+        assert gate.started.wait(timeout=10)
+        service._executor = __import__(
+            "spark_examples_tpu.serve.executor", fromlist=["execute_job"]
+        ).execute_job
+        docs = []
+        for flags in (TINY_FLAGS, TINY_FLAGS_B, TINY_FLAGS):
+            status, doc = service.submit(request_doc(flags))
+            assert status == 202, doc
+            docs.append((flags, doc))
+        gate.release.set()
+        _wait_status(service, blocker["job"]["id"], {"done"})
+        for flags, doc in docs:
+            job = _wait_status(service, doc["job"]["id"], {"done"}, 120)
+            assert job["batch_size"] == 3  # the group coalesced
+            assert job["result"]["pc_lines"] == serial[tuple(flags)]
+    finally:
+        gate.release.set()
+        service.stop(timeout=60)
+
+
+# ------------------------------------------------------ client + serve_main
+
+
+def test_client_wait_honors_retry_after(monkeypatch):
+    """The wait loop sleeps exactly what the server's Retry-After says
+    (capped), falling back to full-jitter when absent."""
+    from spark_examples_tpu.serve.client import ServeClient
+
+    sleeps = []
+    client = ServeClient("http://example.invalid", sleep=sleeps.append)
+    responses = [
+        (200, {"job": {"status": "running"}}, "", {"Retry-After": "0.25"}),
+        (200, {"job": {"status": "running"}}, "", {}),
+        (200, {"job": {"status": "done"}}, "", {}),
+    ]
+
+    def fake_request(method, path, doc=None):
+        assert method == "GET" and path == "/v1/jobs/j1"
+        return responses.pop(0)
+
+    monkeypatch.setattr(client, "_request", fake_request)
+    doc = client.wait("j1", timeout=10, poll_cap_seconds=0.5)
+    assert doc["job"]["status"] == "done"
+    assert sleeps[0] == 0.25  # server-paced
+    assert 0.0 <= sleeps[1] <= 0.5  # jittered fallback, capped
+
+
+def test_http_job_status_sends_retry_after(tmp_path):
+    """Non-terminal GET /v1/jobs/<id> carries the poll hint; terminal
+    responses do not."""
+    import urllib.request
+
+    from spark_examples_tpu.serve.http import (
+        POLL_RETRY_AFTER_SECONDS,
+        start_server,
+    )
+
+    gate = GateExecutor(block_classes=("small", "large"))
+    service = PcaService(
+        run_dir=str(tmp_path / "serve"), executor=gate
+    ).start()
+    server = start_server(service)
+    try:
+        status, doc = service.submit(request_doc(TINY_FLAGS))
+        assert status == 202
+        assert gate.started.wait(timeout=10)
+        job_id = doc["job"]["id"]
+        with urllib.request.urlopen(
+            f"{server.url}/v1/jobs/{job_id}", timeout=10
+        ) as resp:
+            assert resp.headers["Retry-After"] == (
+                f"{POLL_RETRY_AFTER_SECONDS:g}"
+            )
+        gate.release.set()
+        _wait_status(service, job_id, {"done"})
+        with urllib.request.urlopen(
+            f"{server.url}/v1/jobs/{job_id}", timeout=10
+        ) as resp:
+            assert resp.headers["Retry-After"] is None
+    finally:
+        gate.release.set()
+        server.shutdown()
+        service.stop(timeout=30)
+
+
+@pytest.mark.parametrize(
+    "flags",
+    [
+        ["--serve-small-site-limit", "0"],
+        ["--serve-small-site-limit", "-5"],
+        ["--small-slice-devices", "0"],
+        ["--batch-max-jobs", "0"],
+        ["--batch-linger-seconds", "-1"],
+        ["--executor-slices", "-1"],
+        ["--executor-slices", "many"],
+    ],
+)
+def test_serve_main_rejects_nonsense_flags_exit_2(flags):
+    from spark_examples_tpu.serve.http import serve_main
+
+    with pytest.raises(SystemExit) as excinfo:
+        serve_main(["--port", "0"] + flags)
+    assert excinfo.value.code == 2
+
+
+def test_service_ctor_validates_serving_parameters(tmp_path):
+    for kwargs in (
+        {"small_site_limit": 0},
+        {"batch_max_jobs": 0},
+        {"batch_linger_seconds": -0.1},
+        {"small_slices": -1},
+        {"small_slice_devices": 0},
+    ):
+        with pytest.raises(ValueError):
+            PcaService(run_dir=str(tmp_path), **kwargs)
+
+
+def test_stop_on_never_started_service_returns_immediately(tmp_path):
+    """A submit-before-start service has no worker to drain: stop() must
+    return at once (no spin-until-timeout on the queued job)."""
+    service = PcaService(run_dir=str(tmp_path / "serve"))
+    status, _doc = service.submit(request_doc(TINY_FLAGS))
+    assert status == 202  # admission does not require start()
+    started = time.monotonic()
+    assert service.stop(timeout=30)
+    assert time.monotonic() - started < 2.0
+
+
+def test_service_small_site_limit_reclassifies(tmp_path):
+    """A tiny limit pushes every bounded query into the large class —
+    the knob is live, not cosmetic."""
+    gate = GateExecutor(block_classes=())
+    service = PcaService(
+        run_dir=str(tmp_path / "serve"),
+        executor=gate,
+        small_site_limit=10,
+    ).start()
+    try:
+        status, doc = service.submit(request_doc(TINY_FLAGS))
+        assert status == 202
+        assert doc["job"]["class"] == LARGE_CLASS
+    finally:
+        service.stop(timeout=30)
