@@ -75,6 +75,19 @@
 #      drives mixed traffic through the HTTP API and asserts small-job
 #      P99 under concurrent large-job load stays within ~2x its unloaded
 #      P99 and below the large job's wall-clock.
+#   5c. multi-replica serving smoke — two replica daemons (--replica-id
+#      a/b) on ONE run dir: a large job lands on a, whose fault plan
+#      SIGKILLs it the moment device work begins (`kill -9 ... mid-
+#      device`); small jobs keep flowing through b throughout; b steals
+#      the orphaned job under a fencing epoch and — per the journaled
+#      device_began rule — settles it with the structured
+#      replica-failover error instead of silently re-running the
+#      devices; the comma-separated client endpoint list fails over off
+#      the dead replica; `graftcheck lockgraph` stays acyclic with the
+#      lease-substrate locks. Then the full two-replica chaos matrix
+#      (tests/test_serve_replicas_chaos.py): SIGKILL at every registered
+#      serve kill-point, survivor results byte-compared against a
+#      single-replica oracle.
 #   6. faults — the robustness smoke, CPU-pinned: an oracle run, the same
 #      run SIGKILLed by a deterministic fault plan at the
 #      checkpoint.post-save kill-point (exit must be 137), then
@@ -797,6 +810,109 @@ if [ "$sc_rc" -ne 0 ]; then
 fi
 rm -rf "$SC_TMP"
 
+echo "== multi-replica serving smoke (lease-fenced work stealing) =="
+rep_rc=0
+REP_TMP=$(mktemp -d)
+env JAX_PLATFORMS=cpu SPARK_EXAMPLES_TPU_NO_CACHE=1 \
+    SPARK_EXAMPLES_TPU_FAULTS='kill@serve.worker.mid-job' \
+  python -m spark_examples_tpu serve --port 0 \
+    --run-dir "$REP_TMP/rd" --replica-id a --executor-slices 0 \
+    --no-persistent-cache --lease-seconds 1.0 --lease-grace-seconds 0.2 \
+    --steal-interval-seconds 0.2 \
+    --endpoint-file "$REP_TMP/endpoint.a" 2> "$REP_TMP/daemon.a.err" &
+REP_A_PID=$!
+env JAX_PLATFORMS=cpu SPARK_EXAMPLES_TPU_NO_CACHE=1 \
+  python -m spark_examples_tpu serve --port 0 \
+    --run-dir "$REP_TMP/rd" --replica-id b --executor-slices 0 \
+    --no-persistent-cache --lease-seconds 1.0 --lease-grace-seconds 0.2 \
+    --steal-interval-seconds 0.2 \
+    --endpoint-file "$REP_TMP/endpoint.b" 2> "$REP_TMP/daemon.b.err" &
+REP_B_PID=$!
+for _ in $(seq 1 600); do
+  [ -f "$REP_TMP/endpoint.a" ] && [ -f "$REP_TMP/endpoint.b" ] && break
+  sleep 0.1
+done
+if [ ! -f "$REP_TMP/endpoint.a" ] || [ ! -f "$REP_TMP/endpoint.b" ]; then
+  echo "replica smoke: a replica never published its endpoint"; rep_rc=1
+else
+  env JAX_PLATFORMS=cpu python - \
+      "$(cat "$REP_TMP/endpoint.a")" "$(cat "$REP_TMP/endpoint.b")" \
+      "$REP_A_PID" <<'PYEOF' || rep_rc=$?
+import sys, time
+from spark_examples_tpu.serve.client import ServeClient, ServeError
+
+a_url, b_url, a_pid = sys.argv[1], sys.argv[2], int(sys.argv[3])
+small = ["--num-samples", "8", "--references", "1:0:50000"]
+large = ["--num-samples", "8", "--references", "1:0:30000000"]
+
+# The large job lands on replica a, whose fault plan SIGKILLs it the
+# moment device work begins — the owning replica dies mid-device.
+job_id = ServeClient(a_url, timeout=60).submit(large)["job"]["id"]
+assert job_id.startswith("job-a-"), job_id
+
+# Small jobs keep flowing through the survivor THROUGHOUT the failover.
+b = ServeClient(b_url, timeout=60, max_retries=5)
+small_done = 0
+stolen = None
+deadline = time.monotonic() + 240
+while time.monotonic() < deadline:
+    doc = b.wait(b.submit(small)["job"]["id"], timeout=120)
+    assert doc["job"]["status"] == "done", doc
+    small_done += 1
+    try:
+        status = b.status(job_id)["job"]
+    except ServeError as e:
+        if e.status != 404:
+            raise
+        continue  # not stolen yet
+    if status["status"] in ("done", "failed", "cancelled"):
+        stolen = status
+        if small_done >= 3:
+            break
+if stolen is None:
+    raise SystemExit(f"survivor never settled the orphaned job "
+                     f"({small_done} small jobs served meanwhile)")
+# device_began was journaled before the kill: the survivor must fail it
+# structurally, never silently re-run the devices.
+if stolen["status"] != "failed" or \
+        not (stolen["error"] or "").startswith("replica-failover:"):
+    raise SystemExit(f"stolen mid-device job not failed structurally: "
+                     f"{stolen}")
+health = b.healthz()
+rep = health["replica"]
+if rep["jobs_stolen"] < 1:
+    raise SystemExit(f"survivor reports no stolen jobs: {rep}")
+# The client endpoint list fails over off the dead replica.
+failover = ServeClient(f"{a_url},{b_url}", timeout=60, max_retries=5)
+via = failover.status(job_id)["job"]
+assert via["status"] == "failed", via
+print(f"replica smoke OK: owner SIGKILLed mid-device, survivor stole "
+      f"the job under epoch fencing -> {stolen['error'][:40]}..., "
+      f"{small_done} small jobs flowed throughout, client failed over "
+      f"({rep['jobs_stolen']} stolen, {rep['alive']} alive)")
+PYEOF
+fi
+kill -TERM "$REP_B_PID" 2>/dev/null
+wait "$REP_B_PID" 2>/dev/null
+wait "$REP_A_PID" 2>/dev/null
+if [ "$rep_rc" -ne 0 ]; then
+  echo "replica smoke failed (rc=$rep_rc):"
+  tail -20 "$REP_TMP"/daemon.*.err 2>/dev/null
+fi
+rm -rf "$REP_TMP"
+if [ "$rep_rc" -eq 0 ]; then
+  # The lease substrate's locks must keep the acquisition graph acyclic.
+  env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck lockgraph \
+    || rep_rc=$?
+fi
+if [ "$rep_rc" -eq 0 ]; then
+  # The full two-replica chaos matrix: SIGKILL at every registered serve
+  # kill-point, survivor results byte-compared to a solo-replica oracle.
+  env JAX_PLATFORMS=cpu SPARK_EXAMPLES_TPU_NO_CACHE=1 \
+    python -m pytest tests/test_serve_replicas_chaos.py -q \
+      -p no:cacheprovider || rep_rc=$?
+fi
+
 echo "== faults stage (kill/resume parity + serve watchdog) =="
 faults_rc=0
 FAULTS_TMP=$(mktemp -d)
@@ -919,5 +1035,6 @@ if [ "$ring_rc" -ne 0 ]; then exit "$ring_rc"; fi
 if [ "$an_rc" -ne 0 ]; then exit "$an_rc"; fi
 if [ "$serve_rc" -ne 0 ]; then exit "$serve_rc"; fi
 if [ "$sc_rc" -ne 0 ]; then exit "$sc_rc"; fi
+if [ "$rep_rc" -ne 0 ]; then exit "$rep_rc"; fi
 if [ "$faults_rc" -ne 0 ]; then exit "$faults_rc"; fi
 exit "$san_rc"

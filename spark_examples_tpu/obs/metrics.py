@@ -126,6 +126,15 @@ SERVE_BATCH_JOBS = "serve_batch_jobs_total"
 #: acknowledged and this one honored.
 SERVE_JOURNAL_REPLAYED = "serve_journal_replayed_total"
 
+#: Multi-replica serving (``serve/journal.py`` leases over the shared
+#: journal): lease renewals this replica performed, expired-lease jobs it
+#: stole from dead peers, and how many replicas are heartbeating against
+#: the shared run dir right now (self included) — the capacity picture a
+#: load balancer reads off any replica's scrape.
+SERVE_LEASE_RENEWALS = "serve_lease_renewals_total"
+SERVE_JOBS_STOLEN = "serve_jobs_stolen_total"
+SERVE_REPLICAS_ALIVE = "serve_replicas_alive"
+
 #: Host-memory cross-validation pair (``graftcheck hostmem``'s runtime
 #: half): the measured peak process RSS (function-backed — every read
 #: samples the OS) next to the static bound from
@@ -229,6 +238,10 @@ _WELL_KNOWN_GAUGE_HELP = {
         "Sites the pruning analysis has kept so far (LD kept-mask "
         "cardinality; equals tested for non-pruning analyses)."
     ),
+    SERVE_REPLICAS_ALIVE: (
+        "Replica daemons currently heartbeating against this shared run "
+        "dir, self included (serve/journal.py lease substrate)."
+    ),
 }
 
 _WELL_KNOWN_COUNTER_HELP = {
@@ -260,6 +273,14 @@ _WELL_KNOWN_COUNTER_HELP = {
     SERVE_JOURNAL_REPLAYED: (
         "Accepted-but-unfinished jobs replayed from the job journal at "
         "daemon startup (serve/journal.py)."
+    ),
+    SERVE_LEASE_RENEWALS: (
+        "Job-lease renewals this replica performed against the shared "
+        "run dir (serve/journal.py lease substrate)."
+    ),
+    SERVE_JOBS_STOLEN: (
+        "Jobs this replica reclaimed from a dead peer's expired lease "
+        "(epoch-fenced work stealing over the shared journal)."
     ),
 }
 
@@ -707,6 +728,9 @@ __all__ = [
     "SERVE_BATCHES",
     "SERVE_BATCH_JOBS",
     "SERVE_JOURNAL_REPLAYED",
+    "SERVE_LEASE_RENEWALS",
+    "SERVE_JOBS_STOLEN",
+    "SERVE_REPLICAS_ALIVE",
     "HOST_PEAK_RSS_BYTES",
     "HOST_STATIC_BOUND_BYTES",
     "read_host_peak_rss_bytes",

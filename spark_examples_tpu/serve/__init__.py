@@ -15,7 +15,12 @@ admission so accepted jobs survive a daemon kill (``serve/journal.py``),
 keeps its warm compile state (XLA persistent cache + geometry ledger)
 under the run dir across restarts, and exposes job
 submission/status/cancel, Prometheus metrics, and health over a thin
-stdlib HTTP API (``serve/http.py``).
+stdlib HTTP API (``serve/http.py``). N replica daemons (``--replica-id``)
+can share one run dir for host-level fault tolerance: the journal
+doubles as a lease-fenced work-stealing substrate — epoch-fenced leases,
+heartbeats, and steal scans move a dead replica's accepted jobs to a
+survivor, with requeue-once enforced across replica lives and a
+flock run-dir guard refusing unsafe sharing.
 
 Layout:
 

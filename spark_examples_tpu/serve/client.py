@@ -73,7 +73,23 @@ class ServeError(Exception):
         self.code = code
 
 
+def _connection_refused(e: BaseException) -> bool:
+    """Whether this transport error means the request NEVER reached a
+    server (the kernel refused the connect) — the only failure class a
+    single-shot POST may fail over on without risking a duplicate."""
+    if isinstance(e, ConnectionRefusedError):
+        return True
+    return isinstance(
+        getattr(e, "reason", None), ConnectionRefusedError
+    )
+
+
 class ServeClient:
+    """``url`` may be a comma-separated endpoint list
+    (``http://a:8765,http://b:8766`` — the multi-replica serving form):
+    requests go to the current endpoint and fail over to the next when a
+    connection is refused, so a client outlives any single replica."""
+
     def __init__(
         self,
         url: str,
@@ -84,13 +100,23 @@ class ServeClient:
         sleep: Callable[[float], None] = time.sleep,
         rng: Optional[random.Random] = None,
     ):
-        self.url = url.rstrip("/")
+        self.urls = [
+            u.strip().rstrip("/") for u in url.split(",") if u.strip()
+        ]
+        if not self.urls:
+            raise ValueError(f"no endpoint in url {url!r}")
+        self._endpoint = 0
         self.timeout = float(timeout)
         self.max_retries = int(max_retries)
         self.backoff_base = float(backoff_base)
         self.backoff_cap = float(backoff_cap)
         self._sleep = sleep
         self._rng = rng if rng is not None else random.Random()
+
+    @property
+    def url(self) -> str:
+        """The endpoint requests currently target (rotates on failover)."""
+        return self.urls[self._endpoint]
 
     # ------------------------------------------------------------ transport
 
@@ -112,14 +138,19 @@ class ServeClient:
         retry connection resets and 5xx responses with bounded backoff —
         they are idempotent, and a daemon mid-worker-recovery must not
         look "down" to a poller that raced one refused connect. POSTs
-        stay single-shot: a retried submit could enqueue the job twice."""
+        stay single-shot PER SERVER: a retried submit could enqueue the
+        job twice — but a REFUSED connect provably never reached a
+        server, so both verbs fail over to the next configured endpoint
+        (once per extra endpoint per request) when one is given."""
         data = None
         headers = {"Accept": "application/json"}
         if doc is not None:
             data = json.dumps(doc).encode("utf-8")
             headers["Content-Type"] = "application/json"
         attempts = max(1, self.max_retries) if method == "GET" else 1
-        for attempt in range(attempts):
+        failovers_left = len(self.urls) - 1
+        attempt = 0
+        while True:
             retryable = attempt + 1 < attempts
             req = urllib.request.Request(
                 self.url + path, data=data, method=method, headers=headers
@@ -133,6 +164,7 @@ class ServeClient:
             except urllib.error.HTTPError as e:
                 if e.code >= 500 and retryable:
                     self._backoff(attempt, e.headers)
+                    attempt += 1
                     continue
                 status = e.code
                 raw = e.read(MAX_RESPONSE_BYTES + 1)
@@ -140,11 +172,19 @@ class ServeClient:
                     e.headers.get("Content-Type", "") if e.headers else ""
                 )
                 headers = dict(e.headers) if e.headers else None
-            except (urllib.error.URLError, OSError):
-                # Connection refused / reset (possibly mid-response): safe
-                # to resend only because GETs are idempotent.
+            except (urllib.error.URLError, OSError) as e:
+                if _connection_refused(e) and failovers_left > 0:
+                    # This replica is down; move to the next endpoint
+                    # immediately (no backoff, no attempt consumed — the
+                    # request never left this host).
+                    failovers_left -= 1
+                    self._endpoint = (self._endpoint + 1) % len(self.urls)
+                    continue
+                # Connection reset (possibly mid-response): safe to
+                # resend only because GETs are idempotent.
                 if retryable:
                     self._backoff(attempt, None)
+                    attempt += 1
                     continue
                 raise
             break
@@ -231,12 +271,28 @@ class ServeClient:
         deadline = time.monotonic() + timeout
         attempt = 0
         while True:
-            body, headers = self._json_with_headers(
-                "GET", f"/v1/jobs/{job_id}"
-            )
-            if body["job"]["status"] in TERMINAL_STATUSES:
+            try:
+                body, headers = self._json_with_headers(
+                    "GET", f"/v1/jobs/{job_id}"
+                )
+            except ServeError as e:
+                if e.status != 404 or len(self.urls) <= 1:
+                    raise
+                # The failover window: a surviving replica answers 404
+                # for a dead peer's job until its steal scan adopts it
+                # (lease expiry + grace + one scan interval). With more
+                # than one endpoint configured that is a non-terminal
+                # state, bounded by this wait's own deadline.
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"job {job_id} not visible on any endpoint after "
+                        f"{timeout}s (failover pending?)"
+                    ) from None
+                headers = None
+                body = None
+            if body is not None and body["job"]["status"] in TERMINAL_STATUSES:
                 return body
-            if time.monotonic() > deadline:
+            if body is not None and time.monotonic() > deadline:
                 raise TimeoutError(
                     f"job {job_id} still {body['job']['status']!r} after "
                     f"{timeout}s"
@@ -263,7 +319,14 @@ def submit_main(argv: Optional[Sequence[str]] = None) -> int:
     """The ``submit`` CLI verb; see the module docstring."""
     parser = argparse.ArgumentParser(prog="spark_examples_tpu submit")
     parser.add_argument(
-        "--url", required=True, help="Service base URL (see serve --port)."
+        "--url",
+        required=True,
+        help=(
+            "Service base URL (see serve --port), or a comma-separated "
+            "endpoint list (http://a:8765,http://b:8766): the client "
+            "fails over to the next endpoint when a connect is refused "
+            "— the multi-replica serving form."
+        ),
     )
     parser.add_argument(
         "--kind", choices=list(SUBMIT_KIND_CHOICES), default="pca"

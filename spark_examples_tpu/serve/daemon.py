@@ -49,6 +49,7 @@ compile counts) to stderr.
 from __future__ import annotations
 
 import os
+import re
 import sys
 import tempfile
 import threading
@@ -58,8 +59,13 @@ from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from spark_examples_tpu.serve.executor import ExecutionOutcome, execute_job
 from spark_examples_tpu.serve.journal import (
+    DEFAULT_LEASE_SECONDS,
     JobJournal,
+    LeaseStore,
+    RunDirLock,
+    acquire_run_dir_lock,
     compact_journal,
+    compact_journal_shared,
     journal_path,
     replay_journal,
 )
@@ -88,6 +94,20 @@ from spark_examples_tpu.utils import faults
 #: worker is replaced within ~this bound, so one crashed job never looks
 #: like a wedged daemon to pollers.
 WATCHDOG_INTERVAL_SECONDS = 0.05
+
+#: A replica renews its leases this many times per TTL — two missed
+#: ticks still leave one renewal before expiry, so only a genuinely
+#: stalled (or dead) replica ever lets a lease lapse.
+LEASE_RENEWALS_PER_TTL = 3
+
+#: Replica-id grammar: filesystem-safe (it names lease/heartbeat/lock
+#: files and is embedded in job ids), bounded, and never empty.
+_REPLICA_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+#: Shared-journal size past which a replica's scan triggers runtime
+#: compaction (startup-only compaction would let settled records — and
+#: the cost of every steal-scan fold — grow until the next restart).
+JOURNAL_COMPACT_BYTES = 4 << 20
 
 #: Plan-rejection codes that are RESOURCE bounds (the request is
 #: well-formed but too big for the declared budgets) — surfaced as HTTP
@@ -189,6 +209,11 @@ class PcaService:
         batch_max_jobs: int = DEFAULT_BATCH_MAX_JOBS,
         batch_linger_seconds: float = DEFAULT_BATCH_LINGER_SECONDS,
         persistent_cache: bool = False,
+        replica_id: Optional[str] = None,
+        lease_seconds: float = DEFAULT_LEASE_SECONDS,
+        lease_grace_seconds: Optional[float] = None,
+        steal_interval_seconds: Optional[float] = None,
+        guard_run_dir: bool = False,
     ):
         if terminal_retention < 1:
             raise ValueError(
@@ -216,6 +241,25 @@ class PcaService:
             raise ValueError(
                 f"small_slice_devices must be >= 1, got "
                 f"{small_slice_devices}"
+            )
+        if replica_id is not None and not _REPLICA_ID_RE.match(replica_id):
+            raise ValueError(
+                f"replica_id must match {_REPLICA_ID_RE.pattern} (it names "
+                f"lease and lock files), got {replica_id!r}"
+            )
+        if lease_seconds <= 0:
+            raise ValueError(
+                f"lease_seconds must be > 0, got {lease_seconds}"
+            )
+        if lease_grace_seconds is not None and lease_grace_seconds < 0:
+            raise ValueError(
+                f"lease_grace_seconds must be >= 0, got "
+                f"{lease_grace_seconds}"
+            )
+        if steal_interval_seconds is not None and steal_interval_seconds <= 0:
+            raise ValueError(
+                f"steal_interval_seconds must be > 0, got "
+                f"{steal_interval_seconds}"
             )
         self.run_dir = run_dir or tempfile.mkdtemp(prefix="spark-serve-")
         self.host_mem_budget = host_mem_budget
@@ -246,6 +290,26 @@ class PcaService:
         self._watchdog: Optional[threading.Thread] = None
         self._heartbeat = None
         self._journal: Optional[JobJournal] = None
+        #: Multi-replica identity (None = solo mode: no leases, no
+        #: stealing, journal records stay epoch-less — byte-for-byte the
+        #: single-daemon behavior).
+        self.replica_id = replica_id
+        self.lease_seconds = float(lease_seconds)
+        self.lease_grace_seconds = (
+            float(lease_grace_seconds)
+            if lease_grace_seconds is not None
+            else float(lease_seconds)
+        )
+        self.steal_interval_seconds = (
+            float(steal_interval_seconds)
+            if steal_interval_seconds is not None
+            else float(lease_seconds)
+        )
+        self._guard_run_dir = bool(guard_run_dir)
+        self._run_dir_lock: Optional[RunDirLock] = None
+        self._lease_store: Optional[LeaseStore] = None
+        self._lease_thread: Optional[threading.Thread] = None
+        self._lease_stop = threading.Event()
         self._started_unix: Optional[float] = None
         self._replayed_jobs = 0
         self._primed_geometries = 0
@@ -347,6 +411,27 @@ class PcaService:
         self._journal_replayed = well_known_counter(
             self.registry, SERVE_JOURNAL_REPLAYED
         )
+        from spark_examples_tpu.obs.metrics import (
+            SERVE_JOBS_STOLEN,
+            SERVE_LEASE_RENEWALS,
+            SERVE_REPLICAS_ALIVE,
+        )
+
+        self._lease_renewals = well_known_counter(
+            self.registry, SERVE_LEASE_RENEWALS
+        )
+        self._jobs_stolen = well_known_counter(
+            self.registry, SERVE_JOBS_STOLEN
+        )
+        # Solo mode exports 0 honestly: nothing is heartbeating the run
+        # dir's replica directory, so no replica failover is available.
+        well_known_gauge(self.registry, SERVE_REPLICAS_ALIVE).set_function(
+            lambda: float(
+                self._lease_store.alive_count()
+                if self._lease_store is not None
+                else 0
+            )
+        )
 
     # ------------------------------------------------------------ lifecycle
 
@@ -364,6 +449,21 @@ class PcaService:
         # misleading "worker-crashed:" error.
         faults.active()
         os.makedirs(self.run_dir, exist_ok=True)
+        if self._guard_run_dir:
+            # Raises RunDirBusy (CLI exit 2): a second unreplicated
+            # daemon on this run dir would corrupt the journal; replicas
+            # with distinct ids coexist by design.
+            self._run_dir_lock = acquire_run_dir_lock(
+                self.run_dir, self.replica_id
+            )
+        if self.replica_id is not None:
+            self._lease_store = LeaseStore(
+                self.run_dir,
+                self.replica_id,
+                lease_seconds=self.lease_seconds,
+                grace_seconds=self.lease_grace_seconds,
+            )
+            self._lease_store.heartbeat()
         from spark_examples_tpu.utils.cache import (
             attach_geometry_ledger,
             enable_persistent_compile_cache,
@@ -415,7 +515,9 @@ class PcaService:
             self._primed_geometries = attach_geometry_ledger(
                 os.path.join(self.run_dir, "geometry.ledger")
             )
-        self._journal = JobJournal(journal_path(self.run_dir))
+        self._journal = JobJournal(
+            journal_path(self.run_dir), replica=self.replica_id
+        )
         self._replay_journal()
         self._started_unix = time.time()
         for worker in self._workers:
@@ -433,6 +535,13 @@ class PcaService:
             target=self._watchdog_loop, name="serve-watchdog", daemon=True
         )
         self._watchdog.start()
+        if self._lease_store is not None:
+            self._lease_thread = threading.Thread(
+                target=self._lease_loop,
+                name=f"serve-lease-{self.replica_id}",
+                daemon=True,
+            )
+            self._lease_thread.start()
         if self.heartbeat_seconds > 0:
             from spark_examples_tpu.obs.heartbeat import Heartbeat
 
@@ -442,79 +551,154 @@ class PcaService:
         return self
 
     def _replay_journal(self) -> None:
-        """Reload accepted-but-unfinished jobs from the journal (a prior
-        incarnation's admissions against this run dir). Jobs that never
-        began device work requeue with their one retry consumed; jobs
-        journaled ``began`` fail with a structured ``daemon-restarted``
-        error — the exact policy the in-process watchdog applies to a
-        crashed worker, extended to a crashed process."""
+        """Reload accepted-but-unfinished jobs from the journal (prior
+        admissions against this run dir). Jobs that never began device
+        work requeue with their one retry consumed; jobs journaled
+        ``began`` fail with a structured error — the exact policy the
+        in-process watchdog applies to a crashed worker, extended to a
+        crashed process. In multi-replica mode the replay only ADOPTS
+        jobs it can lease: this replica's previous life's jobs re-claim
+        their lease, a dead peer's expired jobs steal (epoch+1), and a
+        live peer's jobs are skipped — they stay in the shared journal,
+        owned by their replica."""
         assert self._journal is not None
         pending, max_seq = replay_journal(self._journal.path)
         with self._lock:
             self._seq = max(self._seq, max_seq)
         requeued = []
         for record in pending:
-            try:
-                request = parse_request(record.request_doc)
-                conf = _parse_job_flags(request.flags, kind=request.kind)
-            except (ProtocolError, ValueError) as e:
-                print(
-                    f"serve: journal record {record.job_id} no longer "
-                    f"parses ({e}); dropping it",
-                    file=sys.stderr,
+            stolen = False
+            if self._lease_store is not None:
+                foreign = (
+                    record.lease_replica is not None
+                    and record.lease_replica != self.replica_id
                 )
-                continue
-            job = Job(
-                id=record.job_id,
-                request=request,
-                conf=conf,
-                job_class=classify_conf(
-                    conf, small_site_limit=self.small_site_limit
-                ),
-                submitted_unix=record.submitted_unix,
-                deadline_unix=record.deadline_unix,
-                batch_key=self._batch_key(conf, request.kind),
-                # The restart consumed the job's one free retry: a
-                # worker crash on the replayed copy must fail it, not
-                # loop it through a third life.
-                requeues=1,
+                if foreign:
+                    # Startup-replay steals pass the same registered
+                    # kill-point as the running steal scan: a kill here
+                    # must leave the job claimable by any other replica.
+                    faults.kill_point("serve.steal.pre-claim")
+                epoch = self._lease_store.claim(
+                    record.job_id, steal=True, min_epoch=record.lease_epoch
+                )
+                if epoch is None:
+                    continue  # a live peer's job (or we lost the race)
+                fresh = self._revalidate_claim(record.job_id, epoch)
+                if fresh is None:
+                    continue  # settled between our fold and our claim
+                record = fresh
+                stolen = foreign
+                self._journal.lease(record.job_id, epoch, stolen=stolen)
+                if stolen:
+                    self._jobs_stolen.inc(1)
+            if self._adopt_pending(record, stolen=stolen):
+                requeued.append(record)
+        if self._lease_store is not None:
+            # Lease-aware compaction: only the holder of the journal's
+            # exclusive compaction lock compacts (a replica starting
+            # while a peer is mid-compaction skips — never two
+            # rewriters); the winner re-folds UNDER the lock so peers'
+            # concurrent records survive the rewrite.
+            compact_journal_shared(
+                self._journal.path, lease_dir=self._lease_store.lease_dir
             )
+        else:
+            # Solo mode: exclusive ownership (enforced by the run-dir
+            # guard), so the replay's own pending list is the truth.
+            # Began and unparseable records leave the journal (their
+            # table entries — when any — are terminal, and replaying
+            # them again would be wrong).
+            compact_journal(self._journal.path, requeued)
+
+    def _adopt_pending(
+        self, record, stolen: bool, count_replayed: bool = True
+    ) -> bool:
+        """Adopt one replayed/stolen pending job into this replica's
+        table and queue; returns ``True`` iff the job was requeued.
+        ``stolen`` selects the structured-error wording for jobs whose
+        device work had begun under the dead owner."""
+        try:
+            request = parse_request(record.request_doc)
+            conf = _parse_job_flags(request.flags, kind=request.kind)
+        except (ProtocolError, ValueError) as e:
+            print(
+                f"serve: journal record {record.job_id} no longer "
+                f"parses ({e}); dropping it",
+                file=sys.stderr,
+            )
+            # A shared journal re-folds at compaction, so a silently
+            # skipped record would replay forever: tombstone it.
+            if self._journal is not None:
+                self._journal.terminal(
+                    record.job_id,
+                    "rejected",
+                    epoch=self._lease_epoch(record.job_id),
+                )
+            if self._lease_store is not None:
+                self._lease_store.release(record.job_id)
+            return False
+        job = Job(
+            id=record.job_id,
+            request=request,
+            conf=conf,
+            job_class=classify_conf(
+                conf, small_site_limit=self.small_site_limit
+            ),
+            submitted_unix=record.submitted_unix,
+            deadline_unix=record.deadline_unix,
+            batch_key=self._batch_key(conf, request.kind),
+            # The restart/steal consumed the job's one free retry: a
+            # worker crash on the adopted copy must fail it, not loop
+            # it through a third life.
+            requeues=1,
+        )
+        if count_replayed:
             self._journal_replayed.inc(1)
             self._replayed_jobs += 1
-            if record.device_began:
-                with self._lock:
-                    self._table[job.id] = job
-                    self._fail_crashed_locked(
-                        job,
+        if record.device_began:
+            # The requeue-once boundary holds ACROSS replica lives: the
+            # journaled began flag was written by whichever life started
+            # the device work, and no later life may silently re-run it.
+            with self._lock:
+                self._table[job.id] = job
+                self._fail_crashed_locked(
+                    job,
+                    (
+                        f"replica-failover: replica "
+                        f"{record.lease_replica or 'unknown'} died after "
+                        "this job's device work began; not re-run "
+                        "(device state under a crashed update cannot be "
+                        "trusted for a silent retry)"
+                    )
+                    if stolen
+                    else (
                         "daemon-restarted: the daemon died after this "
                         "job's device work began; not re-run (device "
                         "state under a crashed update cannot be trusted "
-                        "for a silent retry)",
-                    )
-                self._completed.labels(status="failed").inc()
-                continue
+                        "for a silent retry)"
+                    ),
+                )
+            self._journal_terminal(job)
+            self._completed.labels(status="failed").inc()
+            return False
+        with self._lock:
+            self._table[job.id] = job
+        try:
+            # Replayed and stolen jobs alike re-enter capacity-exempt
+            # (the contract is on inject_reclaimed): their 202 was
+            # acknowledged by the previous owner.
+            self._queue.inject_reclaimed(job)
+        except (QueueFull, QueueClosed) as e:
             with self._lock:
-                self._table[job.id] = job
-            try:
-                # Capacity-exempt: these admissions were acknowledged by
-                # a previous incarnation — capacity bounds NEW traffic,
-                # and the transient overshoot is bounded by the previous
-                # capacity plus one dispatch group.
-                self._queue.put(job, enforce_capacity=False)
-            except QueueClosed as e:
-                with self._lock:
-                    self._fail_crashed_locked(
-                        job,
-                        f"daemon-restarted: replay could not requeue "
-                        f"({e})",
-                    )
-                self._completed.labels(status="failed").inc()
-                continue
-            requeued.append(record)
-        # Compact: only still-pending accepted records survive; began and
-        # unparseable ones leave the journal (their table entries — when
-        # any — are terminal, and replaying them again would be wrong).
-        compact_journal(self._journal.path, requeued)
+                self._fail_crashed_locked(
+                    job,
+                    f"{'replica-failover' if stolen else 'daemon-restarted'}"
+                    f": could not requeue ({e})",
+                )
+            self._journal_terminal(job)
+            self._completed.labels(status="failed").inc()
+            return False
+        return True
 
     def begin_drain(self) -> None:
         """Stop admission (new submissions get 503); already-admitted jobs
@@ -569,6 +753,18 @@ class PcaService:
         if self._heartbeat is not None:
             self._heartbeat.stop()
             self._heartbeat = None
+        self._lease_stop.set()
+        if self._lease_thread is not None:
+            self._lease_thread.join(timeout=5.0)
+            self._lease_thread = None
+        if self._lease_store is not None:
+            # An intentional departure, not a death: withdraw the
+            # heartbeat so surviving peers do not report the pool
+            # degraded over a clean scale-down.
+            self._lease_store.retire()
+        if self._run_dir_lock is not None:
+            self._run_dir_lock.release()
+            self._run_dir_lock = None
         return True
 
     def stop(self, timeout: float = 30.0) -> bool:
@@ -674,7 +870,14 @@ class PcaService:
         now = time.time()
         with self._lock:
             self._seq += 1
-            job_id = f"job-{self._seq:06d}"
+            # Replica-stamped ids keep N concurrent admitters collision-
+            # free on one shared journal (each replica's sequence only
+            # ever continues past what the fold has seen).
+            job_id = (
+                f"job-{self.replica_id}-{self._seq:06d}"
+                if self.replica_id is not None
+                else f"job-{self._seq:06d}"
+            )
         job = Job(
             id=job_id,
             request=request,
@@ -701,13 +904,38 @@ class PcaService:
         # device work; a rejected put below appends a terminal tombstone
         # so the record cannot resurrect.
         self._journal_accepted(job)
+        if self._lease_store is not None:
+            # Lease the job the moment it is durably accepted: from here
+            # on a dead replica's work is visibly expired, stealable
+            # state rather than invisible in-memory state. The id is
+            # fresh, so the epoch-1 claim can only fail if this replica
+            # was deposed as a zombie and a peer's orphan sweep already
+            # took the job — refuse the admission rather than run a job
+            # another replica owns.
+            epoch = self._lease_store.claim(job.id)
+            if epoch is None:
+                # No tombstone: the lease holder (or its stealer) owns
+                # the journal's last word on this id. The client never
+                # gets this 202, so a later phantom run is wasted
+                # compute, never double-trusted device work.
+                with self._lock:
+                    del self._table[job.id]
+                self._rejected.labels(code="lease-unavailable").inc()
+                return 503, error_doc(
+                    "lease-unavailable",
+                    f"could not lease {job.id} (a peer replica claimed "
+                    "it — this replica may be recovering from a stall); "
+                    "resubmit",
+                    retry_after_seconds=5.0,
+                )
+            if self._journal is not None:
+                self._journal.lease(job.id, epoch)
         try:
             self._queue.put(job)
         except QueueFull as e:
             with self._lock:
                 del self._table[job.id]
-            if self._journal is not None:
-                self._journal.terminal(job.id, "rejected")
+            self._journal_tombstone(job)
             self._rejected.labels(code="queue-full").inc()
             return 429, error_doc(
                 "queue-full", str(e), retry_after_seconds=5.0
@@ -715,8 +943,7 @@ class PcaService:
         except QueueClosed as e:
             with self._lock:
                 del self._table[job.id]
-            if self._journal is not None:
-                self._journal.terminal(job.id, "rejected")
+            self._journal_tombstone(job)
             self._rejected.labels(code="draining").inc()
             return 503, error_doc(
                 "draining", str(e), retry_after_seconds=30.0
@@ -740,9 +967,29 @@ class PcaService:
             deadline_unix=job.deadline_unix,
         )
 
+    def _lease_epoch(self, job_id: str) -> Optional[int]:
+        return (
+            self._lease_store.epoch_of(job_id)
+            if self._lease_store is not None
+            else None
+        )
+
     def _journal_terminal(self, job: Job) -> None:
         if self._journal is not None:
-            self._journal.terminal(job.id, job.status)
+            self._journal.terminal(
+                job.id, job.status, epoch=self._lease_epoch(job.id)
+            )
+        if self._lease_store is not None:
+            self._lease_store.release(job.id)
+
+    def _journal_tombstone(self, job: Job) -> None:
+        """Admission-path tombstone: the accepted record may not replay."""
+        if self._journal is not None:
+            self._journal.terminal(
+                job.id, "rejected", epoch=self._lease_epoch(job.id)
+            )
+        if self._lease_store is not None:
+            self._lease_store.release(job.id)
 
     # --------------------------------------------------------------- lookup
 
@@ -816,8 +1063,34 @@ class PcaService:
                 }
                 for w in workers
             ]
+        replica_block = None
+        degraded = False
+        if self._lease_store is not None:
+            peers = self._lease_store.peers()
+            degraded = any(not p["alive"] for p in peers)
+            replica_block = {
+                "id": self.replica_id,
+                "lease_seconds": self.lease_seconds,
+                "grace_seconds": self.lease_grace_seconds,
+                "leases_held": len(self._lease_store.owned_jobs()),
+                "alive": self._lease_store.alive_count(),
+                "peers": peers,
+                # Degraded = admitting WITHOUT live failover cover: some
+                # known peer stopped heartbeating (its jobs are being
+                # stolen). Admission continues — that is the point of
+                # replication — but a balancer can see the thinner pool.
+                "degraded": degraded,
+                "jobs_stolen": int(self._jobs_stolen.value),
+                "lease_renewals": int(self._lease_renewals.value),
+            }
+        doc_status = (
+            "draining"
+            if self.draining
+            else ("degraded" if degraded else "ok")
+        )
         return {
-            "status": "draining" if self.draining else "ok",
+            "status": doc_status,
+            "replica": replica_block,
             "mesh": {
                 "devices": self.device_count,
                 "platform": self.platform,
@@ -937,6 +1210,25 @@ class PcaService:
             self._journal_terminal(job)
             self._completed.labels(status="failed").inc()
             return
+        if (
+            self._lease_store is not None
+            and not self._lease_store.still_owner(job.id)
+        ):
+            # Deposed while queued (stalled renewals, clock skew): the
+            # job belongs to whichever replica stole the lease. Abandon
+            # BEFORE any device work and publish nothing — no terminal
+            # record (the stealer owns the journal's last word), only a
+            # local status for this replica's pollers.
+            self._lease_store.forget(job.id)
+            with self._lock:
+                self._fail_crashed_locked(
+                    job,
+                    "lease-lost: this replica's lease on the job expired "
+                    "before dispatch; a peer replica owns it now and its "
+                    "run decides the outcome",
+                )
+            self._completed.labels(status="failed").inc()
+            return
         with self._lock:
             job.status = "running"
             job.started_unix = now
@@ -952,9 +1244,10 @@ class PcaService:
             job.device_began = True
         # Durable requeue-once boundary: the journal must know device work
         # began BEFORE it begins — a process death after this line must
-        # not silently re-run the job on restart.
+        # not silently re-run the job on restart, whichever replica
+        # replays or steals it.
         if self._journal is not None:
-            self._journal.began(job.id)
+            self._journal.began(job.id, epoch=self._lease_epoch(job.id))
         # Registered kill-point: device work marked begun, executor about
         # to run — a crash from here on must NOT be requeued (device state
         # under a crashed update cannot be trusted for a silent retry).
@@ -974,6 +1267,32 @@ class PcaService:
         except Exception as e:  # noqa: BLE001 — the job FAILS, the service lives
             error = f"{type(e).__name__}: {e}"
         seconds = time.perf_counter() - started
+        if (
+            self._lease_store is not None
+            and not self._lease_store.still_owner(job.id)
+        ):
+            # The pre-publish fence: a zombie replica (paused past its
+            # lease, deposed by a stealer's higher epoch) must detect the
+            # loss and abandon BEFORE publishing — no terminal record, no
+            # result; the stolen run's terminal is the journal's only
+            # valid word on this job (and fold-time epoch fencing ignores
+            # this replica's write even if a pause landed it anyway).
+            self._lease_store.forget(job.id)
+            with self._lock:
+                job.finished_unix = time.time()
+                job.seconds = seconds
+                self._inflight -= 1
+                worker.running_job_id = None
+                self._fail_crashed_locked(
+                    job,
+                    "lease-lost: this replica was deposed while the job "
+                    "ran (lease expired past the grace window); result "
+                    "abandoned unpublished — the stealing replica's run "
+                    "decides the outcome",
+                )
+            self._slice_inflight.labels(slice=worker.spec.name).set(0)
+            self._completed.labels(status="failed").inc()
+            return
         with self._lock:
             job.finished_unix = time.time()
             job.seconds = seconds
@@ -1126,6 +1445,175 @@ class PcaService:
             self._journal_terminal(crashed)
             self._completed.labels(status="failed").inc()
 
+    # ----------------------------------------------------- lease protocol
+
+    def _lease_loop(self) -> None:
+        """The replica's lease-maintenance thread: heartbeat + renewals
+        every TTL/``LEASE_RENEWALS_PER_TTL``, and a steal scan every
+        ``steal_interval_seconds``. Maintenance errors are logged, never
+        fatal — a replica that cannot renew simply loses its leases to a
+        peer, which is the designed degradation, not a crash."""
+        interval = self.lease_seconds / LEASE_RENEWALS_PER_TTL
+        last_steal = time.monotonic()
+        while not self._lease_stop.wait(timeout=interval):
+            try:
+                self._lease_tick()
+                now = time.monotonic()
+                if now - last_steal >= self.steal_interval_seconds:
+                    last_steal = now
+                    self._steal_expired()
+                    self._maybe_compact()
+            except Exception as e:  # noqa: BLE001 — maintenance survives
+                print(
+                    f"serve[{self.replica_id}]: lease maintenance error: "
+                    f"{type(e).__name__}: {e}",
+                    file=sys.stderr,
+                )
+
+    def _lease_tick(self) -> None:
+        """One maintenance beat: publish liveness, renew every owned
+        lease, abandon any we lost (stolen by a peer, or expired under a
+        stall — renewing a lapsed lease would race its stealer)."""
+        store = self._lease_store
+        assert store is not None
+        store.heartbeat()
+        owned = store.owned_jobs()
+        if not owned:
+            return
+        # Registered kill-point: this replica owns leases and is about to
+        # renew them — a kill here is the canonical host loss (every
+        # lease lapses unrenewed; peers steal the jobs). `crash` kills
+        # just this maintenance thread: the in-process stand-in.
+        faults.kill_point("serve.lease.pre-renew")
+        for job_id in owned:
+            if store.renew(job_id):
+                self._lease_renewals.inc(1)
+            else:
+                self._abandon_lease_lost(job_id)
+
+    def _abandon_lease_lost(self, job_id: str) -> None:
+        """A lease this replica held is gone. A still-QUEUED job is
+        pulled from the queue and failed locally WITHOUT a terminal
+        record — the journal's last word belongs to the job's new owner.
+        A running (or mid-claim) job is left to ``_run_job``'s
+        pre-publish fence, which performs the same abandonment at the
+        moment publication would have happened."""
+        assert self._lease_store is not None
+        self._lease_store.forget(job_id)
+        removed = self._queue.remove(job_id)
+        if removed is None:
+            return  # running / popped: the pre-publish fence decides
+        with self._lock:
+            job = self._table.get(job_id)
+            if job is None or job.status != "queued":
+                return
+            self._fail_crashed_locked(
+                job,
+                "lease-lost: this replica's lease expired before "
+                "dispatch; a peer replica owns the job now and its run "
+                "decides the outcome",
+            )
+        self._completed.labels(status="failed").inc()
+
+    def _steal_expired(self) -> None:
+        """Scan for jobs whose lease expired because their owner died,
+        and reclaim them under a fencing epoch. The journal fold (NOT
+        the lease file) decides live-ness of the job itself: a lease
+        left behind by a settled job is skipped, and compaction sweeps
+        it. Stolen jobs keep their original deadline budget — an
+        expired one fails with the structured ``deadline-exceeded`` code
+        at re-dispatch instead of running late."""
+        store = self._lease_store
+        assert store is not None
+        if self.draining or self._journal is None:
+            return  # a draining replica must not adopt work it won't run
+        expired = {view.job_id for view in store.expired_foreign()}
+        peers = store.peers()
+        if not expired and all(p["alive"] for p in peers):
+            # Steady state: nothing expired and every known peer is
+            # heartbeating — orphans need a dead owner, and an owner
+            # always heartbeats before its first admission. Skip the
+            # journal fold entirely (the scan stays O(listdir)).
+            return
+        pending, _max_seq = replay_journal(self._journal.path)
+        alive_peers = {p["id"] for p in peers if p["alive"]}
+        for record in pending:
+            if record.job_id in expired:
+                # A dead owner's expired lease — the normal steal.
+                self._steal_one(record)
+                continue
+            owner = record.accepted_record.get("replica")
+            if (
+                record.lease_epoch == 0
+                and owner != self.replica_id
+                and owner not in alive_peers
+                and store.current(record.job_id) is None
+            ):
+                # Accepted but never leased: the owner died in the
+                # one-record window between the accepted append and its
+                # lease claim (or a solo daemon's journal was adopted by
+                # replicas). Its heartbeat is stale/absent, so the job
+                # is orphaned — reclaim it like any expired lease.
+                self._steal_one(record)
+
+    def _steal_one(self, record) -> None:
+        store = self._lease_store
+        assert store is not None and self._journal is not None
+        # Registered kill-point: steal target identified, fencing epoch
+        # about to be link-claimed — a kill here must leave the job
+        # claimable by any other replica.
+        faults.kill_point("serve.steal.pre-claim")
+        epoch = store.claim(
+            record.job_id, steal=True, min_epoch=record.lease_epoch
+        )
+        if epoch is None:
+            return  # another stealer won the link race (or owner woke)
+        fresh = self._revalidate_claim(record.job_id, epoch)
+        if fresh is None:
+            return  # settled between our fold and our claim
+        self._journal.lease(record.job_id, epoch, stolen=True)
+        self._jobs_stolen.inc(1)
+        self._adopt_pending(fresh, stolen=True, count_replayed=False)
+
+    def _maybe_compact(self) -> None:
+        """Bound the shared journal — and every fold over it — across a
+        long-lived replica's life: startup compaction alone would let
+        settled-job records accumulate until the next restart. When the
+        file outgrows the threshold, the compaction-lock holder rewrites
+        it to O(pending); losers skip and retry at a later scan."""
+        if self._journal is None or self._lease_store is None:
+            return
+        try:
+            size = os.path.getsize(self._journal.path)
+        except OSError:
+            return
+        if size >= JOURNAL_COMPACT_BYTES:
+            compact_journal_shared(
+                self._journal.path, lease_dir=self._lease_store.lease_dir
+            )
+
+    def _revalidate_claim(self, job_id: str, epoch: int):
+        """Post-claim fence against a STALE FOLD: between the fold a
+        steal decision was made from and the claim itself, the job's
+        previous holder may have settled it and released its lease —
+        which is exactly what would have made our claim succeed at a
+        fresh epoch. The settle's terminal write strictly precedes the
+        lease unlink, so a re-fold AFTER a successful claim necessarily
+        sees it: a settled (or higher-fenced) job abandons the claim
+        before any lease record is journaled or any work adopted.
+        Returns the re-folded pending record to adopt, or ``None``."""
+        assert self._journal is not None and self._lease_store is not None
+        pending, _max_seq = replay_journal(self._journal.path)
+        for record in pending:
+            if record.job_id == job_id:
+                if record.lease_epoch <= epoch:
+                    # Re-folded, not the caller's snapshot: the record's
+                    # began/deadline facts are as fresh as the fence.
+                    return record
+                break
+        self._lease_store.release(job_id)
+        return None
+
     def _fail_crashed_locked(self, job: Job, error: str) -> None:
         job.status = "failed"
         job.error = error
@@ -1133,4 +1621,9 @@ class PcaService:
         self._mark_terminal_locked(job)
 
 
-__all__ = ["MEM_LIMIT_CODES", "PcaService", "WATCHDOG_INTERVAL_SECONDS"]
+__all__ = [
+    "LEASE_RENEWALS_PER_TTL",
+    "MEM_LIMIT_CODES",
+    "PcaService",
+    "WATCHDOG_INTERVAL_SECONDS",
+]

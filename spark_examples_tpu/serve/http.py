@@ -36,6 +36,10 @@ from spark_examples_tpu.serve.daemon import (
     DEFAULT_TERMINAL_RETENTION,
     PcaService,
 )
+from spark_examples_tpu.serve.journal import (
+    DEFAULT_LEASE_SECONDS,
+    RunDirBusy,
+)
 from spark_examples_tpu.serve.protocol import error_doc
 from spark_examples_tpu.serve.queue import (
     DEFAULT_BATCH_LINGER_SECONDS,
@@ -346,6 +350,50 @@ def serve_main(argv: Optional[Sequence[str]] = None) -> int:
         ),
     )
     parser.add_argument(
+        "--replica-id",
+        default=None,
+        metavar="ID",
+        help=(
+            "Join --run-dir as one of N replica daemons sharing its job "
+            "journal: jobs are leased (time-bounded, epoch-fenced), "
+            "liveness is heartbeated, and a job whose owning replica "
+            "died is stolen by a survivor. Replicas need distinct ids; "
+            "without this flag the daemon owns the run dir exclusively."
+        ),
+    )
+    parser.add_argument(
+        "--lease-seconds",
+        type=float,
+        default=DEFAULT_LEASE_SECONDS,
+        metavar="S",
+        help=(
+            "Job-lease time-to-live with --replica-id (default "
+            "%(default)s): a healthy replica renews 3x per TTL; a lease "
+            "this stale marks its owner dead."
+        ),
+    )
+    parser.add_argument(
+        "--lease-grace-seconds",
+        type=float,
+        default=None,
+        metavar="S",
+        help=(
+            "Clock-skew grace: peers steal only past expiry PLUS this "
+            "window, while the owner abandons at expiry (default: the "
+            "lease TTL)."
+        ),
+    )
+    parser.add_argument(
+        "--steal-interval-seconds",
+        type=float,
+        default=None,
+        metavar="S",
+        help=(
+            "How often a replica scans for dead peers' expired leases "
+            "(default: the lease TTL)."
+        ),
+    )
+    parser.add_argument(
         "--no-persistent-cache",
         action="store_true",
         help=(
@@ -388,6 +436,20 @@ def serve_main(argv: Optional[Sequence[str]] = None) -> int:
             f"--batch-linger-seconds must be >= 0, got "
             f"{ns.batch_linger_seconds}"
         )
+    if ns.lease_seconds <= 0:
+        parser.error(
+            f"--lease-seconds must be > 0, got {ns.lease_seconds}"
+        )
+    if ns.lease_grace_seconds is not None and ns.lease_grace_seconds < 0:
+        parser.error(
+            f"--lease-grace-seconds must be >= 0, got "
+            f"{ns.lease_grace_seconds}"
+        )
+    if ns.steal_interval_seconds is not None and ns.steal_interval_seconds <= 0:
+        parser.error(
+            f"--steal-interval-seconds must be > 0, got "
+            f"{ns.steal_interval_seconds}"
+        )
     if ns.executor_slices != "auto":
         try:
             slices_spec: Optional[int] = int(ns.executor_slices)
@@ -416,9 +478,20 @@ def serve_main(argv: Optional[Sequence[str]] = None) -> int:
         batch_max_jobs=ns.batch_max_jobs,
         batch_linger_seconds=ns.batch_linger_seconds,
         persistent_cache=not ns.no_persistent_cache,
+        replica_id=ns.replica_id,
+        lease_seconds=ns.lease_seconds,
+        lease_grace_seconds=ns.lease_grace_seconds,
+        steal_interval_seconds=ns.steal_interval_seconds,
+        # The CLI daemon always guards its run dir: a second daemon on
+        # the same --run-dir without --replica-id exits 2 below instead
+        # of silently corrupting the shared journal.
+        guard_run_dir=True,
     )
     try:
         service.start()
+    except RunDirBusy as e:
+        print(f"serve: {e}", file=sys.stderr)
+        return 2
     except ValueError as e:
         # A slice topology the device set cannot satisfy (e.g. every
         # device reserved for small slices) is a configuration error —
@@ -451,10 +524,13 @@ def serve_main(argv: Optional[Sequence[str]] = None) -> int:
     slices = ",".join(
         f"{w.spec.name}:{w.spec.device_count}" for w in service._workers
     )
+    replica = (
+        f" replica={service.replica_id}" if service.replica_id else ""
+    )
     print(
         f"serve: listening on {server.url} "
         f"(devices={service.device_count} platform={service.platform} "
-        f"slices=[{slices}] run_dir={service.run_dir})",
+        f"slices=[{slices}]{replica} run_dir={service.run_dir})",
         file=sys.stderr,
         flush=True,
     )

@@ -1,42 +1,91 @@
-"""Append-only job-table journal: accepted jobs survive daemon restarts.
+"""Shared job-table journal + lease substrate: N replicas, one filesystem.
 
 PR 9's watchdog extended the crash story from "a job dies" to "a worker
-thread dies"; this journal extends it to "the PROCESS dies". Every
-admission decision the daemon acknowledges to a client is durably
-recorded BEFORE the 202 leaves the socket, so a SIGKILL'd daemon can be
-restarted against the same run directory and finish what it accepted:
+thread dies"; PR 12's journal extended it to "the PROCESS dies". This
+module extends it to "the HOST dies": the journal is no longer one
+daemon's private replay log but the coordination substrate for N
+independent replica daemons sharing a run directory. Three cooperating
+pieces:
 
-- ``accepted`` — the job's wire request document (the same versioned
-  protocol form the client posted; replay re-validates it through the
-  REAL parsers, never a pickled internal object), its admission class,
-  id, and timestamps;
-- ``began`` — device work started: the requeue-once boundary. A job
-  journaled ``began`` is NOT re-run after a restart (device state under
-  a crashed update cannot be trusted for a silent retry — the same
-  policy the in-process watchdog applies); it is failed with a
-  structured ``daemon-restarted`` error instead. A job accepted but not
-  begun replays into the queue with its one requeue consumed;
-- ``terminal`` — done/failed/cancelled: the record that lets replay drop
-  the job.
+- **the journal** (:class:`JobJournal` / :func:`replay_journal`): one
+  JSON record per line, ``fsync``'d per record. Every admission decision
+  a replica acknowledges to a client is durably recorded BEFORE the 202
+  leaves the socket. With concurrent writers, appends take a SHARED
+  ``flock`` on a side lock file (``<journal>.lock``) and re-check the
+  journal's inode before each write — so a compaction (which holds the
+  EXCLUSIVE lock, see below) can atomically replace the file without a
+  concurrent appender's record landing in the dead inode and vanishing;
+- **leases** (:class:`LeaseStore`): time-bounded, epoch-fenced ownership
+  of accepted jobs. A lease is a file ``leases/<job>.e<epoch>`` created
+  with ``os.link`` from a fully-written, fsync'd temp file — link fails
+  atomically when the name exists, so exactly ONE replica wins each
+  (job, epoch) and two replicas can never both own a job. Renewals
+  rewrite the owner's own epoch file via ``os.replace`` (atomic content
+  swap; owner-exclusive by construction). A replica **steals** a job
+  whose lease expired past the grace window — its owner died — by
+  link-claiming epoch+1: the same exactly-once primitive, so two
+  concurrent stealers race to a single winner. Each successful claim or
+  steal also appends a fsync'd ``lease`` record to the journal: the
+  fold's fencing input;
+- **the fenced fold**: ``terminal`` records written by a replica carry
+  its lease epoch. At fold time a terminal whose epoch is below the
+  job's highest journaled lease epoch is IGNORED — a deposed zombie
+  replica's late write cannot settle (or double-complete) a job the
+  stealer now owns; the stolen run's terminal wins. Epoch-less records
+  (single-replica mode) fold exactly as before. The journaled
+  ``device_began`` flag keeps enforcing requeue-once across replica
+  lives: a stolen job that already touched the devices is failed with a
+  structured error, never silently re-run.
 
-Wire format: one JSON object per line, ``fsync``'d per record (atomic at
-the record level: a torn final line from a mid-write kill is detected and
-skipped at replay — the client of THAT job never received its 202, so
-nothing acknowledged is lost). On startup the daemon replays the journal
-and compacts it (atomic rewrite holding only still-pending records), so
-journal size is O(pending + jobs since restart), not O(jobs ever served).
+Compaction under concurrent writers is lease-aware
+(:func:`compact_journal_shared`): only the holder of the journal's
+exclusive compaction ``flock`` compacts (others skip — a no-op, not an
+error), the fold re-reads the journal UNDER the lock so no record
+appended between a replica's startup replay and its compaction can be
+lost, and the rewrite preserves each pending job's highest lease epoch
+so fencing survives the rewrite. A torn final line (kill mid-append) is
+skipped at fold and dropped by compaction — by the write protocol it can
+only be the last line a crashed appender produced, and the client of
+THAT record never received its 202.
+
+The run-dir guard (:func:`acquire_run_dir_lock`) makes the sharing
+contract explicit: a daemon WITHOUT ``--replica-id`` holds the run dir's
+``serve.lock`` exclusively (a second such daemon exits 2 instead of
+silently corrupting the journal); replicas hold it SHARED — they coexist
+with each other, conflict with a solo daemon — plus an exclusive
+per-replica lock so a duplicated ``--replica-id`` is rejected too.
 """
 
 from __future__ import annotations
 
+import fcntl
 import json
 import os
 import threading
+import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 #: Journal filename under the service run directory.
 JOURNAL_BASENAME = "jobs.journal.jsonl"
+
+#: Side lock file next to the journal: appenders hold it SHARED per
+#: record, compaction holds it EXCLUSIVE across read+rewrite+replace.
+#: Never itself replaced, so every process locks the same inode.
+JOURNAL_LOCK_SUFFIX = ".lock"
+
+#: Lease files (``<job>.e<epoch>``) live here under the run dir.
+LEASE_DIRNAME = "leases"
+
+#: Per-replica heartbeat files (``<replica>.json``) live here.
+HEARTBEAT_DIRNAME = "replicas"
+
+#: Run-dir ownership guard (``flock``; see :func:`acquire_run_dir_lock`).
+RUN_DIR_LOCK_BASENAME = "serve.lock"
+
+#: Default lease time-to-live. A healthy replica renews every TTL/3, so
+#: an expiry means the owner missed three consecutive renewal ticks.
+DEFAULT_LEASE_SECONDS = 5.0
 
 
 def journal_path(run_dir: str) -> str:
@@ -54,29 +103,72 @@ class PendingJob:
     deadline_unix: Optional[float]
     device_began: bool = False
     accepted_record: Dict = field(default_factory=dict)
+    #: Highest journaled lease epoch (0 = never leased) and the replica
+    #: that holds it — the fencing facts a stealer needs to claim
+    #: epoch+1 and to name the dead owner in a structured failure.
+    lease_epoch: int = 0
+    lease_replica: Optional[str] = None
 
 
 class JobJournal:
-    """Appender half: the daemon's durable admission log."""
+    """Appender half: one replica's durable admission log. ``replica``
+    stamps every ``began``/``terminal``/``lease`` record this appender
+    writes (``None`` = single-replica mode: records stay epoch-less and
+    the fold applies no fencing)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, replica: Optional[str] = None):
         self.path = path
+        self.replica = replica
+        # Serializes this process's appends so records never interleave
+        # mid-line; cross-process serialization is the shared flock.
         # lock order: journal lock is a leaf — nothing else is acquired
-        # while holding it (machine-checked by `graftcheck lockgraph`);
-        # it serializes appends so records never interleave mid-line.
+        # while holding it (machine-checked by `graftcheck lockgraph`).
         self._lock = threading.Lock()
         self._file = None
+        self._lock_fd: Optional[int] = None
+
+    def _ensure_open_locked(self) -> None:
+        """(Re)open the journal if unopened or if compaction swapped the
+        file out from under our handle (inode changed): an append into a
+        replaced inode would vanish."""
+        if self._file is not None:
+            try:
+                if (
+                    os.stat(self.path).st_ino
+                    == os.fstat(self._file.fileno()).st_ino
+                ):
+                    return
+            except OSError:
+                pass
+            self._file.close()
+            self._file = None
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._file = open(self.path, "a", encoding="utf-8")
 
     def _append(self, record: Dict, fsync: bool = True) -> None:
         line = json.dumps(record, sort_keys=True) + "\n"
         with self._lock:
-            if self._file is None:
+            if self._lock_fd is None:
                 os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-                self._file = open(self.path, "a", encoding="utf-8")
-            self._file.write(line)
-            self._file.flush()
-            if fsync:
-                os.fsync(self._file.fileno())
+                self._lock_fd = os.open(
+                    self.path + JOURNAL_LOCK_SUFFIX,
+                    os.O_CREAT | os.O_RDWR,
+                    0o644,
+                )
+            # Shared vs a compactor's exclusive hold: an append either
+            # completes before the rewrite reads the journal (the record
+            # survives into the compacted file) or starts after the
+            # os.replace (the inode re-check opens the new file). Held
+            # only for this one buffered write+flush — bounded.
+            fcntl.flock(self._lock_fd, fcntl.LOCK_SH)
+            try:
+                self._ensure_open_locked()
+                self._file.write(line)
+                self._file.flush()
+                if fsync:
+                    os.fsync(self._file.fileno())
+            finally:
+                fcntl.flock(self._lock_fd, fcntl.LOCK_UN)
 
     # ------------------------------------------------------------- records
 
@@ -88,39 +180,71 @@ class JobJournal:
         submitted_unix: float,
         deadline_unix: Optional[float],
     ) -> None:
+        # The replica stamp lets the steal scan attribute a job that was
+        # accepted but never leased (its owner died in the one-record
+        # window between this append and the lease claim) to a dead peer
+        # via the heartbeat file instead of leaving it orphaned.
         self._append(
-            {
-                "event": "accepted",
-                "id": job_id,
-                "request": request_doc,
-                "job_class": job_class,
-                "submitted_unix": submitted_unix,
-                "deadline_unix": deadline_unix,
-            }
+            self._stamped(
+                {
+                    "event": "accepted",
+                    "id": job_id,
+                    "request": request_doc,
+                    "job_class": job_class,
+                    "submitted_unix": submitted_unix,
+                    "deadline_unix": deadline_unix,
+                },
+                None,
+            )
         )
 
-    def began(self, job_id: str) -> None:
-        self._append({"event": "began", "id": job_id})
+    def began(self, job_id: str, epoch: Optional[int] = None) -> None:
+        self._append(self._stamped({"event": "began", "id": job_id}, epoch))
 
-    def terminal(self, job_id: str, status: str) -> None:
+    def terminal(
+        self, job_id: str, status: str, epoch: Optional[int] = None
+    ) -> None:
         # done/failed terminals flush without fsync — it is the worker's
         # hot path (every batched job pays it), and losing one in a crash
         # only downgrades a finished job's post-restart status to the
-        # `began`-pinned daemon-restarted failure (never a re-run, never
-        # a resurrection; the per-job manifest on disk keeps the truth).
+        # `began`-pinned structured failure (never a re-run, never a
+        # resurrection; the per-job manifest on disk keeps the truth).
         # A lost CANCELLED record would be worse — the job would replay
         # and RUN after the user cancelled it — so cancels stay fsync'd,
         # as do the admission-path tombstones ("rejected").
         self._append(
-            {"event": "terminal", "id": job_id, "status": status},
+            self._stamped(
+                {"event": "terminal", "id": job_id, "status": status}, epoch
+            ),
             fsync=status not in ("done", "failed"),
         )
+
+    def lease(
+        self, job_id: str, epoch: int, stolen: bool = False
+    ) -> None:
+        """One successful lease claim/steal — the fold's fencing input,
+        always fsync'd (a stale-epoch zombie write is only provably
+        stale if the higher lease record is durable)."""
+        record = self._stamped({"event": "lease", "id": job_id}, epoch)
+        if stolen:
+            record["stolen"] = True
+        self._append(record)
+
+    def _stamped(self, record: Dict, epoch: Optional[int]) -> Dict:
+        if self.replica is not None:
+            record["replica"] = self.replica
+        if epoch is not None:
+            record["epoch"] = int(epoch)
+        return record
 
     def close(self) -> None:
         with self._lock:
             if self._file is not None:
                 self._file.close()
                 self._file = None
+            if self._lock_fd is not None:
+                os.close(self._lock_fd)
+                self._lock_fd = None
 
 
 # ---------------------------------------------------------------- replay
@@ -149,28 +273,37 @@ def _iter_records(path: str):
 
 def replay_journal(path: str) -> Tuple[List[PendingJob], int]:
     """Fold the journal into ``(pending_jobs, max_seq)``: every accepted
-    job without a terminal record, in admission order, with its
-    ``device_began`` flag; and the highest numeric job id seen (the
-    restarted daemon's id sequence must continue past it — replayed ids
-    stay stable for clients polling across the restart).
+    job without a VALID terminal record, in admission order, with its
+    ``device_began`` flag and highest lease epoch; and the highest
+    numeric job-id sequence seen (a restarted replica's id sequence must
+    continue past it — replayed ids stay stable for clients polling
+    across the restart).
 
     The fold is ORDER-INSENSITIVE across events of one job: ``began``/
-    ``terminal`` count even when they precede the ``accepted`` record in
-    the file (the appenders are concurrent threads serialized only per
-    record, so a fast worker's events can land first) — a job with any
-    terminal record is settled, and a ``began`` record always pins the
-    no-silent-re-run policy."""
+    ``terminal``/``lease`` count even when they precede the ``accepted``
+    record in the file (appenders are concurrent threads AND concurrent
+    replica processes serialized only per record). **Epoch fencing**: a
+    terminal record carrying a lease epoch below the job's highest
+    journaled lease epoch is a deposed replica's late write — ignored,
+    so the job it failed to settle is settled (or re-run) by its current
+    owner instead, and never double-completed. Epoch-less terminals
+    (single-replica mode) always count. A ``began`` record pins the
+    no-silent-re-run policy regardless of which replica's life wrote it."""
     pending: Dict[str, PendingJob] = {}
     began: set = set()
-    settled: set = set()
+    terminals: Dict[str, List[Optional[int]]] = {}
+    lease_epoch: Dict[str, int] = {}
+    lease_replica: Dict[str, str] = {}
     max_seq = 0
     for record in _iter_records(path):
         job_id = record.get("id")
         if not isinstance(job_id, str):
             continue
         if job_id.startswith("job-"):
+            # Both id grammars: solo `job-000042` and replica-stamped
+            # `job-<replica>-000042` — the sequence is the last segment.
             try:
-                max_seq = max(max_seq, int(job_id[len("job-"):]))
+                max_seq = max(max_seq, int(job_id.rsplit("-", 1)[-1]))
             except ValueError:
                 pass
         event = record["event"]
@@ -196,20 +329,45 @@ def replay_journal(path: str) -> Tuple[List[PendingJob], int]:
         elif event == "began":
             began.add(job_id)
         elif event == "terminal":
+            epoch = record.get("epoch")
+            terminals.setdefault(job_id, []).append(
+                int(epoch) if isinstance(epoch, int) else None
+            )
+        elif event == "lease":
+            epoch = record.get("epoch")
+            if isinstance(epoch, int) and epoch > lease_epoch.get(job_id, 0):
+                lease_epoch[job_id] = epoch
+                replica = record.get("replica")
+                if isinstance(replica, str):
+                    lease_replica[job_id] = replica
+    settled: set = set()
+    for job_id, epochs in terminals.items():
+        fence = lease_epoch.get(job_id, 0)
+        # Valid iff epoch-less (no fencing in play) or at/above the
+        # job's highest journaled lease epoch; decided after the full
+        # read so a steal's lease record fences a terminal that landed
+        # earlier in the file.
+        if any(e is None or e >= fence for e in epochs):
             settled.add(job_id)
     survivors = []
     for job in pending.values():
         if job.job_id in settled:
             continue
         job.device_began = job.job_id in began
+        job.lease_epoch = lease_epoch.get(job.job_id, 0)
+        job.lease_replica = lease_replica.get(job.job_id)
         survivors.append(job)
     return survivors, max_seq
 
 
-def compact_journal(path: str, pending: List[PendingJob]) -> None:
-    """Atomically rewrite the journal to hold only the still-pending
-    accepted records (+ their began flags): replay cost and journal size
-    stay bounded by the live job table, not the daemon's lifetime."""
+# ----------------------------------------------------------- compaction
+
+
+def _rewrite_journal(path: str, pending: List[PendingJob]) -> None:
+    """Atomic rewrite holding only still-pending jobs' records: the
+    accepted record, the began flag, and (when the job was ever leased)
+    one lease record at the highest epoch — fencing must survive the
+    rewrite or a zombie's late terminal would settle a compacted job."""
     tmp = f"{path}.{os.getpid()}.tmp"
     with open(tmp, "w", encoding="utf-8") as f:
         for job in pending:
@@ -221,16 +379,518 @@ def compact_journal(path: str, pending: List[PendingJob]) -> None:
                     )
                     + "\n"
                 )
+            if job.lease_epoch > 0:
+                record: Dict = {
+                    "event": "lease",
+                    "id": job.job_id,
+                    "epoch": job.lease_epoch,
+                }
+                if job.lease_replica is not None:
+                    record["replica"] = job.lease_replica
+                f.write(json.dumps(record, sort_keys=True) + "\n")
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
 
 
+def compact_journal(path: str, pending: List[PendingJob]) -> None:
+    """Single-writer compaction (the solo daemon's startup path, and
+    tests): rewrite the journal to hold only ``pending``. Takes the
+    exclusive compaction flock for symmetry with the shared-append
+    protocol — in solo mode it is uncontended."""
+    lock_fd = os.open(
+        path + JOURNAL_LOCK_SUFFIX, os.O_CREAT | os.O_RDWR, 0o644
+    )
+    try:
+        fcntl.flock(lock_fd, fcntl.LOCK_EX)
+        _rewrite_journal(path, pending)
+    finally:
+        os.close(lock_fd)
+
+
+def compact_journal_shared(
+    path: str, lease_dir: Optional[str] = None
+) -> bool:
+    """Lease-aware compaction for concurrent writers: only the holder of
+    the journal's exclusive compaction flock compacts — a replica that
+    loses the race (or arrives while another replica is mid-compaction)
+    SKIPS, returning ``False``, instead of rewriting a journal it does
+    not own. The winner re-folds the journal UNDER the lock (no appender
+    can race the read: appends hold the lock shared), rewrites it to the
+    pending set, and — when ``lease_dir`` is given — sweeps settled
+    jobs' lease files so the lease directory stays O(pending) too."""
+    lock_fd = os.open(
+        path + JOURNAL_LOCK_SUFFIX, os.O_CREAT | os.O_RDWR, 0o644
+    )
+    try:
+        try:
+            fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            return False
+        pending, _max_seq = replay_journal(path)
+        _rewrite_journal(path, pending)
+        if lease_dir is not None:
+            _sweep_lease_files(
+                lease_dir, keep={job.job_id for job in pending}
+            )
+        return True
+    finally:
+        os.close(lock_fd)
+
+
+def _sweep_lease_files(lease_dir: str, keep: set) -> None:
+    try:
+        names = os.listdir(lease_dir)
+    except FileNotFoundError:
+        return
+    for name in names:
+        job_id, _sep, _epoch = name.rpartition(".e")
+        if job_id and job_id not in keep:
+            try:
+                os.unlink(os.path.join(lease_dir, name))
+            except OSError:
+                pass  # a concurrent sweep won the unlink — same outcome
+
+
+# -------------------------------------------------------------- leases
+
+
+@dataclass(frozen=True)
+class LeaseView:
+    """One job's current lease as read from disk (its highest epoch)."""
+
+    job_id: str
+    replica: str
+    epoch: int
+    expires_unix: float
+
+
+class LeaseStore:
+    """One replica's half of the lease protocol; see the module
+    docstring for the claim/renew/steal file semantics."""
+
+    def __init__(
+        self,
+        run_dir: str,
+        replica: str,
+        lease_seconds: float = DEFAULT_LEASE_SECONDS,
+        grace_seconds: Optional[float] = None,
+        clock: Callable[[], float] = time.time,
+    ):
+        if not replica:
+            raise ValueError("LeaseStore needs a non-empty replica id")
+        if lease_seconds <= 0:
+            raise ValueError(
+                f"lease_seconds must be > 0, got {lease_seconds}"
+            )
+        self.run_dir = run_dir
+        self.replica = replica
+        self.lease_seconds = float(lease_seconds)
+        #: Clock-skew allowance: a foreign lease is stealable only past
+        #: expiry PLUS this window, while the owner abandons at expiry —
+        #: the asymmetry that keeps an owner's last-moment publish and a
+        #: stealer's claim from overlapping under skewed clocks.
+        self.grace_seconds = (
+            float(grace_seconds)
+            if grace_seconds is not None
+            else float(lease_seconds)
+        )
+        self.lease_dir = os.path.join(run_dir, LEASE_DIRNAME)
+        self.heartbeat_dir = os.path.join(run_dir, HEARTBEAT_DIRNAME)
+        self._clock = clock
+        # lock order: lease-store lock is a leaf — it guards only the
+        # owned-epoch dict; every file operation happens outside it.
+        self._lock = threading.Lock()
+        self._owned: Dict[str, int] = {}
+        os.makedirs(self.lease_dir, exist_ok=True)
+        os.makedirs(self.heartbeat_dir, exist_ok=True)
+
+    # ------------------------------------------------------------- files
+
+    def _path(self, job_id: str, epoch: int) -> str:
+        return os.path.join(self.lease_dir, f"{job_id}.e{epoch}")
+
+    def _write_tmp(self, doc: Dict) -> str:
+        tmp = os.path.join(
+            self.lease_dir,
+            f".tmp.{self.replica}.{os.getpid()}.{threading.get_ident()}",
+        )
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        return tmp
+
+    def _lease_doc(self, job_id: str, epoch: int) -> Dict:
+        return {
+            "job": job_id,
+            "replica": self.replica,
+            "epoch": epoch,
+            "expires_unix": self._clock() + self.lease_seconds,
+        }
+
+    def _try_claim_file(self, job_id: str, epoch: int) -> bool:
+        """The exactly-once primitive: link a fully-written temp file to
+        the (job, epoch) name — atomic in existence AND content; the
+        loser of a race gets ``FileExistsError``, never a torn read."""
+        tmp = self._write_tmp(self._lease_doc(job_id, epoch))
+        try:
+            os.link(tmp, self._path(job_id, epoch))
+            return True
+        except FileExistsError:
+            return False
+        finally:
+            os.unlink(tmp)
+
+    def current(self, job_id: str) -> Optional[LeaseView]:
+        """The job's highest-epoch lease on disk, or ``None``."""
+        views = self._scan(prefix=f"{job_id}.e")
+        return views.get(job_id)
+
+    def _scan(self, prefix: Optional[str] = None) -> Dict[str, LeaseView]:
+        """Highest-epoch lease view per job (optionally one job only)."""
+        try:
+            names = os.listdir(self.lease_dir)
+        except FileNotFoundError:
+            return {}
+        best: Dict[str, Tuple[int, str]] = {}
+        for name in names:
+            if name.startswith(".tmp."):
+                continue
+            if prefix is not None and not name.startswith(prefix):
+                continue
+            job_id, sep, epoch_text = name.rpartition(".e")
+            if not sep or not job_id:
+                continue
+            try:
+                epoch = int(epoch_text)
+            except ValueError:
+                continue
+            if epoch > best.get(job_id, (0, ""))[0]:
+                best[job_id] = (epoch, name)
+        views: Dict[str, LeaseView] = {}
+        for job_id, (epoch, name) in best.items():
+            try:
+                with open(
+                    os.path.join(self.lease_dir, name), encoding="utf-8"
+                ) as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue  # swept concurrently; claims are atomic-content
+            replica = doc.get("replica")
+            expires = doc.get("expires_unix")
+            if not isinstance(replica, str) or not isinstance(
+                expires, (int, float)
+            ):
+                continue
+            views[job_id] = LeaseView(
+                job_id=job_id,
+                replica=replica,
+                epoch=epoch,
+                expires_unix=float(expires),
+            )
+        return views
+
+    # ------------------------------------------------------------ protocol
+
+    def claim(
+        self, job_id: str, steal: bool = False, min_epoch: int = 0
+    ) -> Optional[int]:
+        """Acquire the job's lease; returns the held epoch or ``None``.
+
+        - no lease on disk → claim epoch 1 (fresh admission / replay of
+          a never-leased journal);
+        - our own UNEXPIRED lease (a fast restart of THIS replica id) →
+          adopt it at its epoch and renew; our own EXPIRED lease →
+          re-claim at epoch+1 (a stealer may already be mid-claim at
+          that epoch — the link race decides, never both);
+        - a foreign live lease → ``None`` (the job is theirs);
+        - a foreign lease expired past the grace window → with
+          ``steal=True``, link-claim epoch+1 (exactly one concurrent
+          stealer wins); without, ``None`` — admission never steals.
+
+        ``min_epoch`` is the job's highest JOURNALED lease epoch as the
+        caller folded it: the granted epoch always exceeds it, so a
+        claim made from a stale fold (the previous owner settled and
+        unlinked its lease files meanwhile) can never re-issue a fenced
+        epoch. Stale-fold claims are additionally re-validated against
+        the journal by the caller (``serve/daemon.py``) before any work
+        is adopted."""
+        view = self.current(job_id)
+        if view is None:
+            epoch = 1
+        elif view.replica == self.replica:
+            if self._clock() <= view.expires_unix:
+                with self._lock:
+                    self._owned[job_id] = view.epoch
+                self.renew(job_id)
+                return view.epoch
+            epoch = view.epoch + 1
+        elif self._clock() > view.expires_unix + self.grace_seconds:
+            if not steal:
+                return None
+            epoch = view.epoch + 1
+        else:
+            return None
+        epoch = max(epoch, int(min_epoch) + 1)
+        if not self._try_claim_file(job_id, epoch):
+            return None
+        with self._lock:
+            self._owned[job_id] = epoch
+        return epoch
+
+    def renew(self, job_id: str) -> bool:
+        """Extend our lease's expiry (atomic content swap of our own
+        epoch file). Returns ``False`` — the lease is LOST, abandon the
+        job — when we no longer hold it: a higher epoch exists (stolen),
+        the file vanished, or our own expiry already passed (a renewal
+        thread stalled past the TTL must not resurrect itself: by then a
+        stealer may legitimately be mid-claim inside the grace window)."""
+        with self._lock:
+            epoch = self._owned.get(job_id)
+        if epoch is None:
+            return False
+        view = self.current(job_id)
+        if (
+            view is None
+            or view.epoch != epoch
+            or view.replica != self.replica
+            or self._clock() > view.expires_unix
+        ):
+            self.forget(job_id)
+            return False
+        tmp = self._write_tmp(self._lease_doc(job_id, epoch))
+        os.replace(tmp, self._path(job_id, epoch))
+        return True
+
+    def still_owner(self, job_id: str) -> bool:
+        """The pre-publish fence: do we hold the job's HIGHEST epoch,
+        unexpired, right now? Checked before every terminal write and
+        result publication — a deposed or expired owner abandons."""
+        with self._lock:
+            epoch = self._owned.get(job_id)
+        if epoch is None:
+            return False
+        view = self.current(job_id)
+        return (
+            view is not None
+            and view.epoch == epoch
+            and view.replica == self.replica
+            and self._clock() <= view.expires_unix
+        )
+
+    def owned_jobs(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._owned)
+
+    def epoch_of(self, job_id: str) -> Optional[int]:
+        with self._lock:
+            return self._owned.get(job_id)
+
+    def forget(self, job_id: str) -> None:
+        """Drop local ownership bookkeeping (lease lost or released)."""
+        with self._lock:
+            self._owned.pop(job_id, None)
+
+    def release(self, job_id: str) -> None:
+        """Job settled: unlink our lease file(s) up to our epoch and
+        forget it. A higher (stolen) epoch file is never touched."""
+        with self._lock:
+            epoch = self._owned.pop(job_id, None)
+        if epoch is None:
+            return
+        for e in range(1, epoch + 1):
+            try:
+                os.unlink(self._path(job_id, e))
+            except OSError:
+                pass
+
+    def expired_foreign(self) -> List[LeaseView]:
+        """Steal candidates: every job whose HIGHEST lease belongs to
+        another replica and expired past the grace window."""
+        now = self._clock()
+        return [
+            view
+            for view in self._scan().values()
+            if view.replica != self.replica
+            and now > view.expires_unix + self.grace_seconds
+        ]
+
+    # ---------------------------------------------------------- liveness
+
+    def heartbeat(self) -> None:
+        """Atomic publish of this replica's liveness (peers read the
+        written clock, not mtime — one host, one clock domain)."""
+        doc = {
+            "replica": self.replica,
+            "pid": os.getpid(),
+            "unix": self._clock(),
+        }
+        tmp = os.path.join(
+            self.heartbeat_dir, f".tmp.{self.replica}.{os.getpid()}"
+        )
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(
+            tmp, os.path.join(self.heartbeat_dir, f"{self.replica}.json")
+        )
+
+    def retire(self) -> None:
+        """Clean shutdown: withdraw this replica's heartbeat file so
+        peers see an intentionally departed member (absent) rather than
+        a dead one (stale) — a drained replica must not leave the pool
+        reporting ``degraded`` forever."""
+        try:
+            os.unlink(
+                os.path.join(self.heartbeat_dir, f"{self.replica}.json")
+            )
+        except OSError:
+            pass
+
+    def peers(self, stale_after: Optional[float] = None) -> List[Dict]:
+        """Every OTHER replica's last heartbeat: ``{id, age_seconds,
+        alive}`` (alive = age within ``stale_after``, default 3×TTL)."""
+        horizon = (
+            float(stale_after)
+            if stale_after is not None
+            else 3.0 * self.lease_seconds
+        )
+        now = self._clock()
+        try:
+            names = os.listdir(self.heartbeat_dir)
+        except FileNotFoundError:
+            return []
+        # Keyed by replica id: the accumulation is bounded by how many
+        # daemons share the run dir, never by any input's size.
+        ages: Dict[str, float] = {}
+        for name in sorted(names):
+            if not name.endswith(".json") or name.startswith(".tmp."):
+                continue
+            replica = name[: -len(".json")]
+            if replica == self.replica:
+                continue
+            try:
+                with open(
+                    os.path.join(self.heartbeat_dir, name), encoding="utf-8"
+                ) as f:
+                    doc = json.load(f)
+                ages[replica] = now - float(doc["unix"])
+            except (OSError, json.JSONDecodeError, KeyError, TypeError,
+                    ValueError):
+                continue
+        return [
+            {
+                "id": replica,
+                "age_seconds": age,
+                "alive": age <= horizon,
+            }
+            for replica, age in sorted(ages.items())
+        ]
+
+    def alive_count(self, stale_after: Optional[float] = None) -> int:
+        """Replicas currently heartbeating, self included."""
+        return 1 + sum(
+            1 for p in self.peers(stale_after=stale_after) if p["alive"]
+        )
+
+
+# ------------------------------------------------------- run-dir guard
+
+
+class RunDirBusy(RuntimeError):
+    """Another daemon owns (part of) this run directory; see
+    :func:`acquire_run_dir_lock`. The CLI maps this to exit 2."""
+
+
+class RunDirLock:
+    """Held ``flock`` descriptors for one daemon's run-dir claim."""
+
+    def __init__(self, fds: List[int]):
+        self._fds = fds
+
+    def release(self) -> None:
+        fds, self._fds = self._fds, []
+        for fd in fds:
+            try:
+                os.close(fd)  # closing drops the flock
+            except OSError:
+                pass
+
+
+def acquire_run_dir_lock(
+    run_dir: str, replica_id: Optional[str] = None
+) -> RunDirLock:
+    """Claim a service run dir, or raise :class:`RunDirBusy`.
+
+    A solo daemon (no replica id) holds ``serve.lock`` EXCLUSIVELY: a
+    second daemon pointed at the same ``--run-dir`` without
+    ``--replica-id`` is refused instead of silently corrupting the
+    journal. Replicas hold ``serve.lock`` SHARED (they coexist by
+    design, but conflict with a solo daemon in either order) plus an
+    exclusive per-replica ``serve.<id>.lock`` so a duplicated replica id
+    — two daemons claiming the same identity, epochs and heartbeats
+    colliding — is refused too."""
+    os.makedirs(run_dir, exist_ok=True)
+    fds: List[int] = []
+
+    def _locked(basename: str, operation: int, message: str) -> None:
+        fd = os.open(
+            os.path.join(run_dir, basename), os.O_CREAT | os.O_RDWR, 0o644
+        )
+        try:
+            fcntl.flock(fd, operation | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            for held in fds:
+                os.close(held)
+            raise RunDirBusy(message) from None
+        fds.append(fd)
+
+    if replica_id is None:
+        _locked(
+            RUN_DIR_LOCK_BASENAME,
+            fcntl.LOCK_EX,
+            f"run dir {run_dir!r} is already owned by another daemon; a "
+            "second daemon on the same --run-dir would corrupt the job "
+            "journal — to run multiple replicas against one run dir, "
+            "give each a distinct --replica-id",
+        )
+    else:
+        _locked(
+            RUN_DIR_LOCK_BASENAME,
+            fcntl.LOCK_SH,
+            f"run dir {run_dir!r} is owned exclusively by a daemon "
+            "running without --replica-id; stop it (or move it to a "
+            "replica id) before attaching replicas",
+        )
+        _locked(
+            f"serve.{replica_id}.lock",
+            fcntl.LOCK_EX,
+            f"replica id {replica_id!r} is already running against run "
+            f"dir {run_dir!r}; every replica needs a distinct "
+            "--replica-id",
+        )
+    return RunDirLock(fds)
+
+
 __all__ = [
+    "DEFAULT_LEASE_SECONDS",
+    "HEARTBEAT_DIRNAME",
     "JOURNAL_BASENAME",
+    "JOURNAL_LOCK_SUFFIX",
+    "LEASE_DIRNAME",
+    "RUN_DIR_LOCK_BASENAME",
     "JobJournal",
+    "LeaseStore",
+    "LeaseView",
     "PendingJob",
+    "RunDirBusy",
+    "RunDirLock",
+    "acquire_run_dir_lock",
+    "compact_journal",
+    "compact_journal_shared",
     "journal_path",
     "replay_journal",
-    "compact_journal",
 ]

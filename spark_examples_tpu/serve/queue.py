@@ -209,6 +209,18 @@ class BoundedJobQueue:
             # sleeps.
             self._nonempty.notify_all()
 
+    def inject_reclaimed(self, job: Job) -> None:
+        """Admit a RECLAIMED job: one replayed from the journal by a
+        restarted daemon, or stolen from a dead peer replica's expired
+        lease (``serve/daemon.py`` replay + steal scan). Capacity-exempt
+        by contract: the job's 202 was acknowledged by its original
+        owner, so this daemon's admission capacity — which bounds NEW
+        traffic — must not drop it; the transient overshoot is bounded
+        by the previous owner's capacity. Raises :class:`QueueClosed`
+        while draining (a draining replica must not adopt work it will
+        never run)."""
+        self.put(job, enforce_capacity=False)
+
     # -------------------------------------------------------------- worker
 
     def _lanes(self, classes: Optional[Sequence[str]]) -> List[Deque[Job]]:

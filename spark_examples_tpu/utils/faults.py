@@ -78,6 +78,18 @@ KILL_POINTS: Dict[str, str] = {
         "serve/daemon.py:_run_job — device work marked begun, executor "
         "about to run (a crash here must NOT be requeued)"
     ),
+    "serve.lease.pre-renew": (
+        "serve/daemon.py:_lease_tick — this replica owns >= 1 job lease "
+        "and is about to renew them (a kill here is the canonical host "
+        "loss: every lease expires unrenewed and a peer replica steals "
+        "the jobs)"
+    ),
+    "serve.steal.pre-claim": (
+        "serve/daemon.py:_steal_expired — an expired foreign lease was "
+        "identified and the fencing epoch is about to be link-claimed "
+        "(a kill here must leave the job claimable by any other replica "
+        "— no half-taken lease)"
+    ),
     "analysis.pre-manifest": (
         "analyses/base.py:finish_analysis_run — every site streamed and "
         "every per-site output published, before the warm-ledger record "

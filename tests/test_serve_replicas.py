@@ -1,0 +1,888 @@
+"""Multi-replica serving (PR 13): lease-fenced work-stealing over the
+shared journal, surviving host loss.
+
+The contract under test:
+
+- **run-dir guard** — a second unreplicated daemon on one ``--run-dir``
+  is refused (exit 2 via ``RunDirBusy``); replicas with distinct
+  ``--replica-id`` values coexist by design, duplicates are refused;
+- **leases** — ``os.link``-atomic claim files: exactly one replica wins
+  each (job, epoch); stealing requires expiry PLUS the grace window;
+  renewals extend expiry; a deposed or lapsed owner abandons;
+- **epoch fencing** — a zombie replica's late terminal record at a
+  stale epoch is ignored by ``replay_journal``; the stolen run's
+  terminal wins; exactly one valid outcome per job id;
+- **requeue-once across replica lives** — a stolen job journaled
+  ``began`` fails with the structured ``replica-failover:`` error, never
+  a silent device re-run; an unbegun job re-runs on the survivor;
+- **deadlines across a steal** — the original ``deadline_seconds``
+  budget rides the steal and is re-validated at re-dispatch;
+- **lease-aware compaction** — only the compaction-lock holder rewrites
+  the shared journal; fencing epochs survive the rewrite; a torn
+  boundary record is dropped; appenders re-open across a compaction;
+- **client failover** — a comma-separated endpoint list fails over on a
+  refused connect, for GETs and (refused-only) POSTs.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from spark_examples_tpu.serve.client import ServeClient
+from spark_examples_tpu.serve.daemon import PcaService
+from spark_examples_tpu.serve.executor import ExecutionOutcome
+from spark_examples_tpu.serve.http import serve_main, start_server
+from spark_examples_tpu.serve.journal import (
+    JOURNAL_LOCK_SUFFIX,
+    JobJournal,
+    LeaseStore,
+    RunDirBusy,
+    acquire_run_dir_lock,
+    compact_journal,
+    compact_journal_shared,
+    journal_path,
+    replay_journal,
+)
+from spark_examples_tpu.serve.protocol import request_doc
+from spark_examples_tpu.utils import faults
+
+TINY_FLAGS = ["--num-samples", "8", "--references", "1:0:50000"]
+
+
+@pytest.fixture(autouse=True)
+def _reset_fault_plan():
+    """Every test starts and ends with no active fault plan (the crash
+    tests configure one; a leak would poison unrelated tests)."""
+    faults.configure(None)
+    yield
+    faults.configure(None)
+
+
+def _wait_status(service, job_id, statuses, timeout=20.0):
+    """Poll one service's table until the job reaches a wanted status
+    (404s while the job still belongs to another replica are re-polled)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        _http, doc = service.job_status(job_id)
+        if doc.get("job", {}).get("status") in statuses:
+            return doc["job"]
+        time.sleep(0.02)
+    raise AssertionError(
+        f"job {job_id} never reached {statuses}: {service.job_status(job_id)}"
+    )
+
+
+class StubExecutor:
+    """Records executed job ids; optionally blocks (deterministic zombie
+    windows) and publishes a per-job manifest naming which replica ran
+    the job — the manifest-uniqueness probe."""
+
+    def __init__(self, name, block=False, write_manifest=True):
+        self.name = name
+        self.block = block
+        self.write_manifest = write_manifest
+        self.calls = []
+        self.started = threading.Event()
+        self.release = threading.Event()
+        self._lock = threading.Lock()  # lock order: test-local leaf
+
+    def __call__(self, job, run_dir):
+        with self._lock:
+            self.calls.append(job.id)
+        self.started.set()
+        if self.block:
+            assert self.release.wait(timeout=30), "gate never released"
+        manifest_path = None
+        if self.write_manifest:
+            job_dir = os.path.join(run_dir, "jobs", job.id)
+            os.makedirs(job_dir, exist_ok=True)
+            manifest_path = os.path.join(job_dir, "manifest.json")
+            tmp = f"{manifest_path}.{self.name}.tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"by": self.name, "id": job.id}, f)
+            os.replace(tmp, manifest_path)
+        return ExecutionOutcome(
+            result={"by": self.name, "id": job.id},
+            manifest_path=manifest_path,
+            compile_cache="cold",
+        )
+
+
+def _replica(run_dir, name, executor, **kw):
+    """A fast-failover in-process replica. Lease timings are sub-second
+    for test speed but not TOO tight: a loaded CI box can stall a
+    renewal thread for a few hundred ms, and a replica that loses its
+    OWN lease to scheduler noise turns a steal test flaky."""
+    kw.setdefault("lease_seconds", 0.75)
+    kw.setdefault("lease_grace_seconds", 0.25)
+    kw.setdefault("steal_interval_seconds", 0.25)
+    return PcaService(
+        run_dir=str(run_dir),
+        executor=executor,
+        small_slices=0,
+        replica_id=name,
+        **kw,
+    )
+
+
+def _dead_replica_state(
+    run_dir,
+    job_id="job-a-000001",
+    began=False,
+    lease=True,
+    lease_expires_in=0.01,
+    deadline_unix=None,
+):
+    """The on-disk state a SIGKILLed replica ``a`` leaves behind: an
+    accepted (optionally leased / begun) job in the shared journal plus
+    its lease file — exactly what a survivor's steal path consumes."""
+    run_dir = str(run_dir)
+    # A real daemon heartbeats at startup, before its first admission —
+    # so a dead owner always leaves a STALE heartbeat file behind (the
+    # steal scan's liveness discriminator relies on it).
+    LeaseStore(
+        run_dir, "a", lease_seconds=1.0, clock=lambda: time.time() - 60.0
+    ).heartbeat()
+    journal = JobJournal(journal_path(run_dir), replica="a")
+    journal.accepted(
+        job_id, request_doc(TINY_FLAGS), "small", time.time(), deadline_unix
+    )
+    if lease:
+        store = LeaseStore(
+            run_dir, "a", lease_seconds=lease_expires_in, grace_seconds=0.0
+        )
+        assert store.claim(job_id) == 1
+        journal.lease(job_id, 1)
+    if began:
+        journal.began(job_id, epoch=1 if lease else None)
+    journal.close()
+    return job_id
+
+
+# ---------------------------------------------------------- run-dir guard
+
+
+def test_run_dir_guard_solo_is_exclusive(tmp_path):
+    lock = acquire_run_dir_lock(str(tmp_path))
+    with pytest.raises(RunDirBusy, match="distinct --replica-id"):
+        acquire_run_dir_lock(str(tmp_path))
+    with pytest.raises(RunDirBusy, match="without --replica-id"):
+        acquire_run_dir_lock(str(tmp_path), "a")
+    lock.release()
+    # Released: a replica can now claim it.
+    acquire_run_dir_lock(str(tmp_path), "a").release()
+
+
+def test_run_dir_guard_replicas_coexist_duplicates_refused(tmp_path):
+    lock_a = acquire_run_dir_lock(str(tmp_path), "a")
+    lock_b = acquire_run_dir_lock(str(tmp_path), "b")  # coexists by design
+    with pytest.raises(RunDirBusy, match="already running"):
+        acquire_run_dir_lock(str(tmp_path), "a")  # duplicate identity
+    with pytest.raises(RunDirBusy, match="distinct --replica-id"):
+        acquire_run_dir_lock(str(tmp_path))  # solo vs live replicas
+    lock_a.release()
+    lock_b.release()
+
+
+def test_serve_main_second_solo_daemon_exits_2(tmp_path, capsys):
+    """The satellite contract: a second daemon on the same --run-dir
+    WITHOUT --replica-id exits 2 with a clear message (previously it
+    would silently corrupt the journal)."""
+    lock = acquire_run_dir_lock(str(tmp_path))
+    try:
+        rc = serve_main(["--run-dir", str(tmp_path), "--port", "0"])
+    finally:
+        lock.release()
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "--replica-id" in err
+
+
+@pytest.mark.parametrize(
+    "flags",
+    [
+        ["--lease-seconds", "0"],
+        ["--lease-grace-seconds", "-1"],
+        ["--steal-interval-seconds", "0"],
+    ],
+)
+def test_serve_main_rejects_bad_lease_flags(flags):
+    with pytest.raises(SystemExit) as e:
+        serve_main(["--port", "0", *flags])
+    assert e.value.code == 2
+
+
+def test_service_validates_replica_parameters(tmp_path):
+    with pytest.raises(ValueError, match="replica_id"):
+        PcaService(run_dir=str(tmp_path), replica_id="a/b")
+    with pytest.raises(ValueError, match="lease_seconds"):
+        PcaService(run_dir=str(tmp_path), replica_id="a", lease_seconds=0)
+    with pytest.raises(ValueError, match="steal_interval_seconds"):
+        PcaService(
+            run_dir=str(tmp_path), replica_id="a", steal_interval_seconds=0
+        )
+
+
+# ------------------------------------------------------------ lease store
+
+
+def _clocked(tmp_path, replica, now, lease=1.0, grace=0.5):
+    return LeaseStore(
+        str(tmp_path),
+        replica,
+        lease_seconds=lease,
+        grace_seconds=grace,
+        clock=lambda: now[0],
+    )
+
+
+def test_lease_claim_is_exclusive(tmp_path):
+    now = [100.0]
+    a = _clocked(tmp_path, "a", now)
+    b = _clocked(tmp_path, "b", now)
+    assert a.claim("j1") == 1
+    assert b.claim("j1") is None
+    assert b.claim("j1", steal=True) is None  # live, not stealable
+    assert a.still_owner("j1")
+    assert not b.still_owner("j1")
+
+
+def test_lease_steal_requires_expiry_plus_grace(tmp_path):
+    now = [100.0]
+    a = _clocked(tmp_path, "a", now)
+    b = _clocked(tmp_path, "b", now)
+    assert a.claim("j1") == 1  # expires at 101.0, grace to 101.5
+    now[0] = 101.2  # expired, but inside the clock-skew grace window
+    assert b.claim("j1", steal=True) is None
+    now[0] = 101.6  # past expiry + grace: the owner is dead
+    assert b.claim("j1", steal=True) == 2
+    assert b.still_owner("j1")
+    # The deposed owner's next renewal detects the loss and abandons.
+    assert a.renew("j1") is False
+    assert not a.still_owner("j1")
+
+
+def test_two_stealers_exactly_one_wins(tmp_path):
+    now = [100.0]
+    a = _clocked(tmp_path, "a", now)
+    b = _clocked(tmp_path, "b", now)
+    c = _clocked(tmp_path, "c", now)
+    assert a.claim("j1") == 1
+    now[0] = 102.0
+    # Both stealers race epoch 2; the os.link claim admits exactly one —
+    # the loser's raw claim-file attempt fails atomically.
+    assert b.claim("j1", steal=True) == 2
+    assert c._try_claim_file("j1", 2) is False
+    # And via the protocol: b's epoch-2 lease is live, so c gets None.
+    assert c.claim("j1", steal=True) is None
+
+
+def test_lease_renewal_extends_expiry(tmp_path):
+    now = [100.0]
+    a = _clocked(tmp_path, "a", now)
+    assert a.claim("j1") == 1
+    now[0] = 100.9
+    assert a.renew("j1") is True  # new expiry: 101.9
+    now[0] = 101.5
+    assert a.still_owner("j1")
+    now[0] = 102.0
+    assert not a.still_owner("j1")  # lapsed: the owner must abandon
+
+
+def test_own_expired_lease_reclaims_at_higher_epoch(tmp_path):
+    """A restart (same replica id) past its own TTL must NOT renew the
+    stale epoch — a stealer may be mid-claim at epoch+1; re-claiming
+    through the same link primitive lets the race decide exactly once."""
+    now = [100.0]
+    a = _clocked(tmp_path, "a", now)
+    assert a.claim("j1") == 1
+    now[0] = 105.0
+    assert a.claim("j1") == 2
+    # Fast restart (unexpired): adopts the existing epoch instead.
+    b_now = [100.0]
+    b = _clocked(tmp_path, "b", b_now)
+    assert b.claim("j2") == 1
+    b2 = _clocked(tmp_path, "b", b_now)
+    assert b2.claim("j2") == 1
+
+
+def test_release_unlinks_lease_files(tmp_path):
+    now = [100.0]
+    a = _clocked(tmp_path, "a", now)
+    assert a.claim("j1") == 1
+    assert a.current("j1") is not None
+    a.release("j1")
+    assert a.current("j1") is None
+    assert a.owned_jobs() == {}
+
+
+def test_heartbeats_and_peer_liveness(tmp_path):
+    now = [100.0]
+    a = _clocked(tmp_path, "a", now, lease=1.0)
+    b = _clocked(tmp_path, "b", now, lease=1.0)
+    a.heartbeat()
+    b.heartbeat()
+    peers = a.peers()
+    assert [p["id"] for p in peers] == ["b"]
+    assert peers[0]["alive"]
+    assert a.alive_count() == 2
+    now[0] = 110.0  # b is 10s stale against a 3s horizon (3x TTL)
+    a.heartbeat()
+    assert not a.peers()[0]["alive"]
+    assert a.alive_count() == 1
+
+
+# -------------------------------------------------------- fenced journal
+
+
+def test_fold_ignores_stale_epoch_terminal(tmp_path):
+    path = journal_path(str(tmp_path))
+    a = JobJournal(path, replica="a")
+    b = JobJournal(path, replica="b")
+    a.accepted("job-a-000001", request_doc(TINY_FLAGS), "small", 1.0, None)
+    a.lease("job-a-000001", 1)
+    b.lease("job-a-000001", 2, stolen=True)
+    # The zombie's late terminal at the deposed epoch: ignored.
+    a.terminal("job-a-000001", "done", epoch=1)
+    pending, _seq = replay_journal(path)
+    assert [p.job_id for p in pending] == ["job-a-000001"]
+    assert pending[0].lease_epoch == 2
+    assert pending[0].lease_replica == "b"
+    # The stolen run's terminal at the fencing epoch settles the job.
+    b.terminal("job-a-000001", "failed", epoch=2)
+    pending, _seq = replay_journal(path)
+    assert pending == []
+    a.close()
+    b.close()
+
+
+def test_fold_fencing_is_order_insensitive(tmp_path):
+    """The stale terminal may land BEFORE the steal's lease record in
+    the file (concurrent appenders): the verdict must not depend on
+    line order."""
+    path = journal_path(str(tmp_path))
+    a = JobJournal(path, replica="a")
+    a.accepted("job-a-000001", request_doc(TINY_FLAGS), "small", 1.0, None)
+    a.lease("job-a-000001", 1)
+    a.terminal("job-a-000001", "done", epoch=1)  # would settle...
+    b = JobJournal(path, replica="b")
+    b.lease("job-a-000001", 2, stolen=True)  # ...but the fence arrives
+    pending, _seq = replay_journal(path)
+    assert [p.job_id for p in pending] == ["job-a-000001"]
+    a.close()
+    b.close()
+
+
+def test_epochless_terminal_always_settles(tmp_path):
+    """Solo-mode records carry no epoch and fold exactly as before —
+    even next to lease records (a solo journal later adopted by
+    replicas must not resurrect settled jobs)."""
+    path = journal_path(str(tmp_path))
+    j = JobJournal(path)
+    j.accepted("job-000001", request_doc(TINY_FLAGS), "small", 1.0, None)
+    j.terminal("job-000001", "done")
+    pending, seq = replay_journal(path)
+    assert pending == [] and seq == 1
+    j.close()
+
+
+def test_max_seq_parses_replica_stamped_ids(tmp_path):
+    path = journal_path(str(tmp_path))
+    j = JobJournal(path, replica="a")
+    j.accepted("job-a-000007", request_doc(TINY_FLAGS), "small", 1.0, None)
+    _pending, seq = replay_journal(path)
+    assert seq == 7
+    j.close()
+
+
+# ------------------------------------------------------------ compaction
+
+
+def test_compact_shared_skips_when_lock_held(tmp_path):
+    """Only the compaction-lock holder compacts; contenders skip — the
+    satellite's concurrent-writer fix (two rewriters would lose records)."""
+    path = journal_path(str(tmp_path))
+    j = JobJournal(path, replica="a")
+    j.accepted("job-a-000001", request_doc(TINY_FLAGS), "small", 1.0, None)
+    j.close()
+    before = open(path, encoding="utf-8").read()
+    fd = os.open(path + JOURNAL_LOCK_SUFFIX, os.O_CREAT | os.O_RDWR)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        assert compact_journal_shared(path) is False
+        assert open(path, encoding="utf-8").read() == before
+    finally:
+        os.close(fd)
+    assert compact_journal_shared(path) is True
+
+
+def test_compact_shared_preserves_fencing_and_sweeps_leases(tmp_path):
+    run_dir = str(tmp_path)
+    path = journal_path(run_dir)
+    now = [100.0]
+    store_a = _clocked(tmp_path, "a", now)
+    j = JobJournal(path, replica="a")
+    # Pending job leased at epoch 2 (one steal in its history).
+    j.accepted("job-a-000001", request_doc(TINY_FLAGS), "small", 1.0, None)
+    j.lease("job-a-000001", 1)
+    j.lease("job-a-000001", 2, stolen=True)
+    store_a.claim("job-a-000001")
+    # Settled job whose lease files linger.
+    j.accepted("job-a-000002", request_doc(TINY_FLAGS), "small", 1.0, None)
+    j.lease("job-a-000002", 1)
+    store_a.claim("job-a-000002")
+    j.terminal("job-a-000002", "done", epoch=1)
+    j.close()
+    assert compact_journal_shared(path, lease_dir=store_a.lease_dir) is True
+    pending, _seq = replay_journal(path)
+    assert [p.job_id for p in pending] == ["job-a-000001"]
+    # Fencing survives the rewrite: a zombie terminal at epoch 1 is
+    # still stale after compaction.
+    assert pending[0].lease_epoch == 2
+    z = JobJournal(path, replica="zombie")
+    z.terminal("job-a-000001", "done", epoch=1)
+    z.close()
+    pending, _seq = replay_journal(path)
+    assert [p.job_id for p in pending] == ["job-a-000001"]
+    # Settled job's lease files are swept; pending job's remain.
+    assert store_a.current("job-a-000002") is None
+    assert store_a.current("job-a-000001") is not None
+
+
+def test_compact_shared_drops_torn_boundary_record(tmp_path):
+    """Regression: a torn record at the compaction boundary (a replica
+    SIGKILLed mid-append) must neither corrupt the rewrite nor change
+    the pending verdict."""
+    path = journal_path(str(tmp_path))
+    j = JobJournal(path, replica="a")
+    j.accepted("job-a-000001", request_doc(TINY_FLAGS), "small", 1.0, None)
+    j.close()
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"event": "terminal", "id": "job-a-000001", "sta')
+    before, _seq = replay_journal(path)
+    assert [p.job_id for p in before] == ["job-a-000001"]
+    assert compact_journal_shared(path) is True
+    text = open(path, encoding="utf-8").read()
+    assert '"sta' not in text
+    after, _seq = replay_journal(path)
+    assert [p.job_id for p in after] == ["job-a-000001"]
+
+
+def test_appender_reopens_across_compaction(tmp_path):
+    """A concurrent writer whose journal was compacted under it must not
+    keep appending into the dead inode (records would vanish)."""
+    path = journal_path(str(tmp_path))
+    j = JobJournal(path, replica="a")
+    j.accepted("job-a-000001", request_doc(TINY_FLAGS), "small", 1.0, None)
+    compact_journal(path, [])  # another process swaps the file
+    j.accepted("job-a-000002", request_doc(TINY_FLAGS), "small", 1.0, None)
+    j.close()
+    pending, _seq = replay_journal(path)
+    assert [p.job_id for p in pending] == ["job-a-000002"]
+
+
+# ------------------------------------------------- replica service: steal
+
+
+def test_survivor_steals_unbegun_job_and_completes(tmp_path):
+    """Host loss before device work: the survivor re-runs the job (its
+    one requeue consumed) and publishes the only manifest."""
+    jid = _dead_replica_state(tmp_path, began=False)
+    time.sleep(0.1)  # the dead replica's 0.01s lease expires
+    stub = StubExecutor("b")
+    b = _replica(tmp_path, "b", stub).start()
+    try:
+        job = _wait_status(b, jid, {"done"})
+        assert job["result"] == {"by": "b", "id": jid}
+        assert stub.calls == [jid]
+        health = b.healthz()
+        assert health["replica"]["jobs_stolen"] == 1
+        manifest = os.path.join(str(tmp_path), "jobs", jid, "manifest.json")
+        with open(manifest, encoding="utf-8") as f:
+            assert json.load(f)["by"] == "b"
+        pending, _seq = replay_journal(journal_path(str(tmp_path)))
+        assert pending == []  # exactly one terminal state, settled
+    finally:
+        b.stop(timeout=20)
+
+
+def test_survivor_fails_begun_job_structured(tmp_path):
+    """Requeue-once holds ACROSS replica lives: the journaled
+    device_began flag pins the stolen job to a structured failure —
+    the devices are never driven twice, no manifest is published."""
+    jid = _dead_replica_state(tmp_path, began=True)
+    time.sleep(0.1)
+    stub = StubExecutor("b")
+    b = _replica(tmp_path, "b", stub).start()
+    try:
+        job = _wait_status(b, jid, {"failed"})
+        assert job["error"].startswith("replica-failover:")
+        assert "replica a died" in job["error"]
+        assert stub.calls == []  # the executor never ran
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), "jobs", jid, "manifest.json")
+        )
+        pending, _seq = replay_journal(journal_path(str(tmp_path)))
+        assert pending == []
+    finally:
+        b.stop(timeout=20)
+
+
+def test_running_steal_scan_reclaims_after_owner_death(tmp_path):
+    """The survivor is ALREADY serving when the peer dies: its periodic
+    steal scan (not startup replay) reclaims the job."""
+    stub = StubExecutor("b")
+    b = _replica(tmp_path, "b", stub).start()
+    try:
+        # The peer accepts a job and dies: its lease outlives b's replay
+        # (0.25s) so only the running scan can have stolen it.
+        jid = _dead_replica_state(tmp_path, lease_expires_in=0.25)
+        job = _wait_status(b, jid, {"done"})
+        assert job["result"]["by"] == "b"
+        assert b.healthz()["replica"]["jobs_stolen"] == 1
+    finally:
+        b.stop(timeout=20)
+
+
+def test_orphan_accepted_without_lease_is_reclaimed(tmp_path):
+    """A replica can die in the one-record window between journaling
+    ``accepted`` and claiming the lease: the job has no lease file, so
+    the steal scan attributes it via the accepted record's replica stamp
+    and the (absent) heartbeat, and reclaims it."""
+    stub = StubExecutor("b")
+    b = _replica(tmp_path, "b", stub).start()
+    try:
+        jid = _dead_replica_state(tmp_path, lease=False)
+        job = _wait_status(b, jid, {"done"})
+        assert job["result"]["by"] == "b"
+    finally:
+        b.stop(timeout=20)
+
+
+def test_replica_restart_adopts_own_jobs(tmp_path):
+    """Same replica id, fast restart (lease unexpired): the jobs adopt
+    at their existing epoch and complete — no steal, no epoch bump."""
+    jid = _dead_replica_state(tmp_path, lease_expires_in=30.0)
+    stub = StubExecutor("a2")
+    a2 = _replica(tmp_path, "a", stub).start()
+    try:
+        job = _wait_status(a2, jid, {"done"})
+        assert job["result"]["by"] == "a2"
+        assert a2.healthz()["replica"]["jobs_stolen"] == 0
+    finally:
+        a2.stop(timeout=20)
+
+
+# ------------------------------------------------ deadlines across steals
+
+
+def test_deadline_budget_survives_steal_within_window(tmp_path):
+    jid = _dead_replica_state(tmp_path, deadline_unix=time.time() + 30.0)
+    time.sleep(0.1)
+    stub = StubExecutor("b")
+    b = _replica(tmp_path, "b", stub).start()
+    try:
+        job = _wait_status(b, jid, {"done"})
+        assert job["result"]["by"] == "b"
+    finally:
+        b.stop(timeout=20)
+
+
+def test_deadline_expired_across_steal_fails_structured(tmp_path):
+    """A job whose original deadline passed while its owner was dead
+    must fail with the EXISTING structured code at re-dispatch — never
+    run late."""
+    jid = _dead_replica_state(tmp_path, deadline_unix=time.time() + 0.05)
+    time.sleep(0.15)  # deadline AND lease both expire
+    stub = StubExecutor("b")
+    b = _replica(tmp_path, "b", stub).start()
+    try:
+        job = _wait_status(b, jid, {"failed"})
+        assert job["error"].startswith("deadline-exceeded")
+        assert stub.calls == []
+    finally:
+        b.stop(timeout=20)
+
+
+# --------------------------------------------------- zombie epoch fencing
+
+
+def test_zombie_abandons_unpublished_and_stolen_outcome_wins(tmp_path):
+    """The full fencing story in-process: replica a's maintenance stalls
+    mid-job (GC-pause stand-in), b steals the begun job and settles it
+    structurally; a's run finishes AFTER being deposed and must abandon
+    — no terminal record, no result, no manifest from the zombie — and
+    even a forced stale-epoch terminal write is ignored by the fold."""
+    gate = StubExecutor("a", block=True, write_manifest=False)
+    a = _replica(tmp_path, "a", gate).start()
+    b = None
+    try:
+        status, doc = a.submit(request_doc(TINY_FLAGS))
+        assert status == 202, doc
+        jid = doc["job"]["id"]
+        assert gate.started.wait(timeout=10)
+        a._lease_stop.set()  # freeze renewals + heartbeat: the zombie
+        stub = StubExecutor("b")
+        b = _replica(tmp_path, "b", stub).start()
+        stolen = _wait_status(b, jid, {"failed"})
+        assert stolen["error"].startswith("replica-failover:")
+        assert stub.calls == []  # began: never re-run
+        # The zombie wakes and finishes its run: pre-publish fence fires.
+        gate.release.set()
+        abandoned = _wait_status(a, jid, {"failed"})
+        assert abandoned["error"].startswith("lease-lost:")
+        assert abandoned.get("result") is None
+        assert abandoned.get("manifest_path") is None
+        # Exactly one valid terminal: b's, at the fencing epoch. Even a
+        # forced zombie terminal at the stale epoch cannot resurrect or
+        # double-complete the job.
+        path = journal_path(str(tmp_path))
+        pending, _seq = replay_journal(path)
+        assert pending == []
+        z = JobJournal(path, replica="a")
+        z.terminal(jid, "done", epoch=1)
+        z.close()
+        pending, _seq = replay_journal(path)
+        assert pending == []
+        terminals = [
+            json.loads(line)
+            for line in open(path, encoding="utf-8")
+            if '"terminal"' in line
+        ]
+        valid = [t for t in terminals if t.get("epoch", 0) >= 2]
+        assert len(valid) == 1 and valid[0]["replica"] == "b"
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), "jobs", jid, "manifest.json")
+        )
+    finally:
+        gate.release.set()
+        if b is not None:
+            b.stop(timeout=20)
+        a.stop(timeout=20)
+
+
+def test_claim_respects_min_epoch(tmp_path):
+    """A claim made from a fold that saw epoch N must never re-issue an
+    epoch at or below N — even when the previous holder's lease files
+    are already gone (settled + released)."""
+    now = [100.0]
+    a = _clocked(tmp_path, "a", now)
+    assert a.claim("j1", steal=True, min_epoch=5) == 6
+
+
+def test_revalidate_claim_abandons_settled_job(tmp_path):
+    """The stale-fold race: a stealer decides from a snapshot, but the
+    job settles (terminal + lease release) before its claim lands. The
+    post-claim re-fold must abandon the claim — no lease record, no
+    adoption, no second device run."""
+    stub = StubExecutor("c")
+    # Start on an EMPTY run dir with the steal scan effectively off, so
+    # only this test's manual claims drive the lease state.
+    c = _replica(
+        tmp_path,
+        "c",
+        stub,
+        lease_seconds=30.0,
+        steal_interval_seconds=3600.0,
+    ).start()
+    run_dir = str(tmp_path)
+    jid = "job-a-000001"
+    j = JobJournal(journal_path(run_dir), replica="a")
+    j.accepted(jid, request_doc(TINY_FLAGS), "small", time.time(), None)
+    j.lease(jid, 1)
+    j.close()
+    try:
+        epoch = c._lease_store.claim(jid, steal=True, min_epoch=1)
+        assert epoch == 2
+        # The previous holder's terminal lands before our re-validation
+        # (in the real race it landed before our claim even succeeded).
+        z = JobJournal(journal_path(run_dir), replica="a")
+        z.terminal(jid, "done", epoch=1)
+        z.close()
+        assert c._revalidate_claim(jid, epoch) is None
+        assert c._lease_store.current(jid) is None  # claim abandoned
+        # And the positive side: a still-pending job survives re-fold.
+        jid2 = "job-a-000002"
+        j2 = JobJournal(journal_path(run_dir), replica="a")
+        j2.accepted(jid2, request_doc(TINY_FLAGS), "small", time.time(), None)
+        j2.close()
+        epoch2 = c._lease_store.claim(jid2)
+        fresh = c._revalidate_claim(jid2, epoch2)
+        assert fresh is not None and fresh.job_id == jid2
+    finally:
+        c.stop(timeout=20)
+
+
+def test_clean_stop_withdraws_heartbeat_not_degraded(tmp_path):
+    """An intentionally drained replica must leave the pool as a
+    departed member, not a corpse: the survivor's healthz stays 'ok'
+    instead of reporting 'degraded' forever."""
+    a = _replica(tmp_path, "a", StubExecutor("a"), lease_seconds=0.3).start()
+    b = _replica(tmp_path, "b", StubExecutor("b"), lease_seconds=0.3).start()
+    assert {p["id"] for p in a._lease_store.peers()} == {"b"}
+    assert b.stop(timeout=20)
+    time.sleep(1.0)  # past 3x the 0.3s TTL: a corpse would read stale
+    health = a.healthz()
+    try:
+        assert health["status"] == "ok"
+        assert health["replica"]["degraded"] is False
+        assert health["replica"]["peers"] == []
+    finally:
+        a.stop(timeout=20)
+
+
+def test_client_wait_spans_the_failover_404_window(tmp_path):
+    """`submit --wait` against an endpoint list must survive the window
+    where the dead owner's job is not yet in the survivor's table: with
+    more than one endpoint, 404 is non-terminal (bounded by the wait
+    deadline), so the wait resolves once the steal lands."""
+    jid = _dead_replica_state(tmp_path, lease_expires_in=0.4)
+    stub = StubExecutor("b")
+    b = _replica(tmp_path, "b", stub).start()
+    server = start_server(b)
+    try:
+        client = ServeClient(
+            f"http://127.0.0.1:1,{server.url}", max_retries=2
+        )
+        doc = client.wait(jid, timeout=20)
+        assert doc["job"]["status"] == "done"
+        assert doc["job"]["result"]["by"] == "b"
+    finally:
+        server.shutdown()
+        b.stop(timeout=20)
+
+
+# ------------------------------------------------- kill-point integration
+
+
+def test_new_kill_points_registered():
+    assert "serve.lease.pre-renew" in faults.KILL_POINTS
+    assert "serve.steal.pre-claim" in faults.KILL_POINTS
+
+
+def test_crash_at_lease_pre_renew_triggers_failover(tmp_path):
+    """crash@serve.lease.pre-renew kills the owning replica's lease
+    maintenance thread (the in-process host-loss stand-in): its lease
+    lapses and the peer steals the begun job into a structured failure."""
+    faults.configure("crash@serve.lease.pre-renew")
+    gate = StubExecutor("a", block=True, write_manifest=False)
+    a = _replica(tmp_path, "a", gate).start()
+    b = None
+    try:
+        status, doc = a.submit(request_doc(TINY_FLAGS))
+        assert status == 202, doc
+        jid = doc["job"]["id"]
+        assert gate.started.wait(timeout=10)
+        # a's next maintenance tick (it owns a lease now) crashes.
+        stub = StubExecutor("b")
+        b = _replica(tmp_path, "b", stub).start()
+        stolen = _wait_status(b, jid, {"failed"})
+        assert stolen["error"].startswith("replica-failover:")
+    finally:
+        gate.release.set()
+        if b is not None:
+            b.stop(timeout=20)
+        a.stop(timeout=20)
+
+
+def test_crash_at_steal_pre_claim_leaves_job_claimable(tmp_path):
+    """A stealer dying at the pre-claim kill-point must leave no
+    half-taken lease: the job stays claimable and a later replica
+    completes it."""
+    stub_b = StubExecutor("b")
+    b = _replica(tmp_path, "b", stub_b).start()
+    c = None
+    try:
+        faults.configure("crash@serve.steal.pre-claim")
+        jid = _dead_replica_state(tmp_path)
+        # b's steal scan hits the kill-point and its maintenance thread
+        # dies mid-steal — before the epoch claim, so nothing is taken.
+        time.sleep(1.0)
+        assert stub_b.calls == []
+        stub_c = StubExecutor("c")
+        c = _replica(tmp_path, "c", stub_c).start()
+        job = _wait_status(c, jid, {"done"})
+        assert job["result"]["by"] == "c"
+        # b is degraded (dead maintenance thread) but still serves.
+        assert b.healthz()["queue"]["worker_alive"]
+    finally:
+        if c is not None:
+            c.stop(timeout=20)
+        b.stop(timeout=20)
+
+
+# ------------------------------------------------------- client failover
+
+
+def test_client_endpoint_list_parsing():
+    client = ServeClient("http://a:1, http://b:2/")
+    assert client.urls == ["http://a:1", "http://b:2"]
+    assert client.url == "http://a:1"
+    with pytest.raises(ValueError, match="no endpoint"):
+        ServeClient(" , ")
+
+
+def test_client_fails_over_on_connection_refused(tmp_path):
+    """A dead first endpoint (refused connect) fails over for both GETs
+    and the single-shot POST — a refused connect provably never reached
+    a server, so the submit cannot duplicate."""
+    service = PcaService(
+        run_dir=str(tmp_path / "serve"),
+        executor=StubExecutor("solo"),
+        small_slices=0,
+    ).start()
+    server = start_server(service)
+    try:
+        client = ServeClient(
+            f"http://127.0.0.1:1,{server.url}", max_retries=2
+        )
+        doc = client.submit(TINY_FLAGS)
+        assert client.url == server.url  # rotated off the dead endpoint
+        done = client.wait(doc["job"]["id"], timeout=20)
+        assert done["job"]["status"] == "done"
+        assert client.healthz()["status"] in ("ok", "degraded")
+    finally:
+        server.shutdown()
+        service.stop(timeout=20)
+
+
+# ------------------------------------------------------------ telemetry
+
+
+def test_replica_healthz_and_metrics(tmp_path):
+    stub = StubExecutor("a")
+    a = _replica(tmp_path, "a", stub).start()
+    try:
+        status, doc = a.submit(request_doc(TINY_FLAGS))
+        assert status == 202, doc
+        _wait_status(a, doc["job"]["id"], {"done"})
+        health = a.healthz()
+        block = health["replica"]
+        assert block["id"] == "a"
+        assert block["alive"] == 1
+        assert block["degraded"] is False
+        assert block["peers"] == []
+        text = a.metrics_text()
+        assert "serve_replicas_alive 1" in text
+        assert "serve_jobs_stolen_total 0" in text
+        assert "serve_lease_renewals_total" in text
+    finally:
+        a.stop(timeout=20)
+
+
+def test_solo_healthz_has_no_replica_block(tmp_path):
+    service = PcaService(
+        run_dir=str(tmp_path / "serve"),
+        executor=StubExecutor("solo"),
+        small_slices=0,
+    ).start()
+    try:
+        assert service.healthz()["replica"] is None
+    finally:
+        service.stop(timeout=20)

@@ -1,0 +1,274 @@
+"""Two-replica chaos matrix: real daemons, real SIGKILL, byte-compared
+against a single-replica oracle.
+
+The acceptance proof of PR 13: with two live replica daemons sharing one
+run dir, SIGKILL one of them at EVERY registered serve kill-point
+(``serve.worker.claim``, ``serve.worker.mid-job``,
+``serve.lease.pre-renew``, ``serve.steal.pre-claim``) and assert that
+every accepted job reaches exactly ONE terminal state on the survivor:
+
+- a job whose device work never began re-runs on the survivor with its
+  result **byte-identical** to the single-replica oracle run of the same
+  request, and the journal shows exactly one ``began``;
+- a job journaled ``began`` before the kill fails with the structured
+  ``replica-failover:`` error — the devices are never driven twice
+  (requeue-once across replica lives);
+- a stealer killed at ``serve.steal.pre-claim`` leaves no half-taken
+  lease: a later replica claims and settles the job.
+
+Marked slow (each scenario boots 2-3 real daemons); ci.sh stage 5c runs
+this matrix alongside its inline two-replica kill -9 smoke.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from spark_examples_tpu.serve.client import ServeClient, ServeError
+from spark_examples_tpu.serve.journal import journal_path, replay_journal
+from spark_examples_tpu.serve.protocol import TERMINAL_STATUSES
+
+pytestmark = pytest.mark.slow
+
+#: The canonical chaos job: deterministic synthetic cohort, small enough
+#: to finish in seconds on one CPU device, big enough to outlive the
+#: kill windows.
+CHAOS_FLAGS = ["--num-samples", "8", "--references", "1:0:50000"]
+
+#: Sub-second failover timings: a lease this stale means its owner died.
+LEASE_FLAGS = [
+    "--lease-seconds", "1.0",
+    "--lease-grace-seconds", "0.2",
+    "--steal-interval-seconds", "0.2",
+]
+
+
+def _spawn_replica(run_dir, rid, fault_plan=None, replica=True):
+    """One real daemon subprocess; returns (proc, url) once listening."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SPARK_EXAMPLES_TPU_NO_CACHE"] = "1"
+    env.pop("SPARK_EXAMPLES_TPU_FAULTS", None)
+    if fault_plan is not None:
+        env["SPARK_EXAMPLES_TPU_FAULTS"] = fault_plan
+    endpoint = os.path.join(run_dir, f"endpoint.{rid}")
+    argv = [
+        sys.executable, "-m", "spark_examples_tpu", "serve",
+        "--port", "0",
+        "--run-dir", run_dir,
+        "--executor-slices", "0",
+        "--no-persistent-cache",
+        "--endpoint-file", endpoint,
+    ]
+    if replica:
+        argv += ["--replica-id", rid] + LEASE_FLAGS
+    err = open(os.path.join(run_dir, f"daemon.{rid}.err"), "w")
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.DEVNULL, stderr=err, env=env
+    )
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if os.path.exists(endpoint):
+            with open(endpoint, encoding="utf-8") as f:
+                return proc, f.read().strip()
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"replica {rid} exited {proc.returncode} before listening; "
+                f"stderr: {open(err.name).read()[-2000:]}"
+            )
+        time.sleep(0.05)
+    proc.kill()
+    raise AssertionError(f"replica {rid} never published its endpoint")
+
+
+def _stop(proc):
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def _wait_terminal(client, job_id, timeout=300):
+    """Poll the survivor for the job's terminal state; 404s are re-polled
+    — the job only appears in the survivor's table once stolen."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            doc = client.status(job_id)
+        except ServeError as e:
+            if e.status != 404:
+                raise
+        else:
+            if doc["job"]["status"] in TERMINAL_STATUSES:
+                return doc["job"]
+        time.sleep(0.2)
+    raise AssertionError(f"job {job_id} never settled on the survivor")
+
+
+def _journal_facts(run_dir, job_id):
+    """(began_count, valid_terminal_count, settled) for one job id."""
+    lease_epoch = 0
+    began = 0
+    terminals = []
+    with open(journal_path(run_dir), encoding="utf-8") as f:
+        for line in f:
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if record.get("id") != job_id:
+                continue
+            if record["event"] == "began":
+                began += 1
+            elif record["event"] == "lease":
+                lease_epoch = max(lease_epoch, record.get("epoch", 0))
+            elif record["event"] == "terminal":
+                terminals.append(record)
+    valid = [
+        t for t in terminals
+        if t.get("epoch") is None or t["epoch"] >= lease_epoch
+    ]
+    pending, _seq = replay_journal(journal_path(run_dir))
+    settled = job_id not in {p.job_id for p in pending}
+    return began, len(valid), settled
+
+
+@pytest.fixture(scope="module")
+def oracle_lines(tmp_path_factory):
+    """The single-replica oracle: the same request served by a solo
+    daemon — the byte-compare reference for every stolen re-run."""
+    run_dir = str(tmp_path_factory.mktemp("oracle"))
+    proc, url = _spawn_replica(run_dir, "oracle", replica=False)
+    try:
+        client = ServeClient(url, timeout=60)
+        doc = client.submit(CHAOS_FLAGS)
+        job = client.wait(doc["job"]["id"], timeout=300)["job"]
+        assert job["status"] == "done", job
+        return job["result"]["pc_lines"]
+    finally:
+        _stop(proc)
+
+
+def _run_kill_scenario(tmp_path, fault_plan):
+    """Two replicas; ``a`` carries the fault plan and SIGKILLs itself;
+    the client submits to ``a`` then fails over to the survivor ``b``.
+    Returns (terminal job doc, run_dir, a's exit code)."""
+    run_dir = str(tmp_path / "rd")
+    os.makedirs(run_dir, exist_ok=True)
+    a_proc, a_url = _spawn_replica(run_dir, "a", fault_plan=fault_plan)
+    b_proc, b_url = _spawn_replica(run_dir, "b")
+    try:
+        client = ServeClient(a_url, timeout=60)
+        doc = client.submit(CHAOS_FLAGS)
+        job_id = doc["job"]["id"]
+        assert job_id.startswith("job-a-")
+        a_rc = a_proc.wait(timeout=120)
+        survivor = ServeClient(b_url, timeout=60, max_retries=5)
+        job = _wait_terminal(survivor, job_id)
+        return job, run_dir, a_rc
+    finally:
+        _stop(b_proc)
+        if a_proc.poll() is None:
+            a_proc.kill()
+
+
+def test_kill_at_worker_claim_survivor_reruns_byte_identical(
+    tmp_path, oracle_lines
+):
+    """SIGKILL before any device work: the survivor re-runs the job and
+    its eigenvectors are byte-identical to the single-replica oracle."""
+    job, run_dir, a_rc = _run_kill_scenario(
+        tmp_path, "kill@serve.worker.claim"
+    )
+    assert a_rc == -signal.SIGKILL
+    assert job["status"] == "done", job
+    assert job["result"]["pc_lines"] == oracle_lines
+    began, valid_terminals, settled = _journal_facts(run_dir, job["id"])
+    assert settled and valid_terminals == 1
+    assert began == 1  # only the survivor's run touched the devices
+
+
+def test_kill_at_worker_mid_job_survivor_fails_structured(
+    tmp_path, oracle_lines
+):
+    """SIGKILL after ``began`` was journaled: requeue-once holds across
+    replica lives — the survivor settles the job with the structured
+    failover error and never drives the devices a second time."""
+    job, run_dir, a_rc = _run_kill_scenario(
+        tmp_path, "kill@serve.worker.mid-job"
+    )
+    assert a_rc == -signal.SIGKILL
+    assert job["status"] == "failed", job
+    assert job["error"].startswith("replica-failover:")
+    began, valid_terminals, settled = _journal_facts(run_dir, job["id"])
+    assert settled and valid_terminals == 1
+    assert began == 1  # the dead replica's begin; never a second one
+
+
+def test_kill_at_lease_pre_renew_exactly_one_outcome(
+    tmp_path, oracle_lines
+):
+    """SIGKILL at the renewal tick (the canonical host loss): whether
+    the job had begun when the host died decides the outcome — re-run
+    byte-identical, or structured failure — but either way exactly one
+    terminal state and no double device run."""
+    job, run_dir, a_rc = _run_kill_scenario(
+        tmp_path, "kill@serve.lease.pre-renew"
+    )
+    assert a_rc == -signal.SIGKILL
+    began, valid_terminals, settled = _journal_facts(run_dir, job["id"])
+    assert settled and valid_terminals == 1
+    if job["status"] == "done":
+        assert job["result"]["pc_lines"] == oracle_lines
+        assert began == 1
+    else:
+        assert job["status"] == "failed", job
+        assert job["error"].startswith("replica-failover:")
+        assert began == 1
+
+
+def test_kill_at_steal_pre_claim_job_stays_claimable(
+    tmp_path, oracle_lines
+):
+    """The stealer itself dies mid-steal, before the epoch claim: no
+    half-taken lease may remain — a third replica claims the job and
+    completes it byte-identically."""
+    run_dir = str(tmp_path / "rd")
+    os.makedirs(run_dir, exist_ok=True)
+    # a dies the moment its worker claims the job (unbegun, stealable).
+    a_proc, a_url = _spawn_replica(
+        run_dir, "a", fault_plan="kill@serve.worker.claim"
+    )
+    # b dies at the steal's pre-claim kill-point.
+    b_proc, b_url = _spawn_replica(
+        run_dir, "b", fault_plan="kill@serve.steal.pre-claim"
+    )
+    c_proc = None
+    try:
+        client = ServeClient(a_url, timeout=60)
+        job_id = client.submit(CHAOS_FLAGS)["job"]["id"]
+        assert a_proc.wait(timeout=120) == -signal.SIGKILL
+        assert b_proc.wait(timeout=120) == -signal.SIGKILL
+        # Nothing half-taken: a fresh replica adopts and completes.
+        c_proc, c_url = _spawn_replica(run_dir, "c")
+        job = _wait_terminal(
+            ServeClient(c_url, timeout=60, max_retries=5), job_id
+        )
+        assert job["status"] == "done", job
+        assert job["result"]["pc_lines"] == oracle_lines
+        began, valid_terminals, settled = _journal_facts(run_dir, job_id)
+        assert settled and valid_terminals == 1 and began == 1
+    finally:
+        for proc in (c_proc, b_proc, a_proc):
+            if proc is not None and proc.poll() is None:
+                _stop(proc)
