@@ -746,6 +746,14 @@ def _run_config(name: str, device) -> dict:
                 if host_bound is not None
                 else {}
             ),
+            # Prover-conformance pairs straight from the manifest block
+            # (measured vs proven per prover) — BENCH artifacts carry the
+            # regression tripwire verdicts next to the numbers they bound.
+            **(
+                {"prover_conformance": manifest["conformance"]}
+                if manifest.get("conformance")
+                else {}
+            ),
             "block_size": BLOCK,
             "blocks_per_dispatch": k_resolved,
             "compile_seconds_excluded": round(compile_seconds, 3),

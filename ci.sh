@@ -41,7 +41,10 @@
 #      --ingest packed --check-ranges asserts the manifest's
 #      gramian_exactness pair: measured max |accumulator entry| <= the
 #      statically-projected bound (the runtime half of the ranges
-#      contract).
+#      contract). Both runs must also carry the v2-additive conformance
+#      block (prover-conformance pairs) with ok=true for hostmem (and
+#      ranges on the second run); the sharded-ring smoke below asserts
+#      the sched pair the same way.
 #   4. sharded-ring smoke — a 4-virtual-device sharded run (tiny synthetic
 #      cohort) twice: packed ring (--ring-pack-bits on) vs the unpacked
 #      oracle (off). Result rows must be byte-identical and the manifests'
@@ -83,7 +86,13 @@
 #      device_began rule — settles it with the structured
 #      replica-failover error instead of silently re-running the
 #      devices; the comma-separated client endpoint list fails over off
-#      the dead replica; `graftcheck lockgraph` stays acyclic with the
+#      the dead replica; the run dir's flight-recorder segments + journal
+#      are then merged by `trace export` into one Chrome-trace JSON that
+#      must validate well-formed (obs/trace.py:validate_chrome_trace) with
+#      the stolen job's span tree complete across BOTH replica processes:
+#      the killed owner's span closed as truncated, a whole steal flow
+#      arrow, lease epochs and the fenced terminal state present, zero
+#      orphan spans; `graftcheck lockgraph` stays acyclic with the
 #      lease-substrate locks. Then the full two-replica chaos matrix
 #      (tests/test_serve_replicas_chaos.py): SIGKILL at every registered
 #      serve kill-point, survivor results byte-compared against a
@@ -245,10 +254,14 @@ if hm["peak_rss_bytes"] > hm["static_bound_bytes"]:
           f"{hm['peak_rss_bytes']} > {hm['static_bound_bytes']} "
           "(parallel/mesh.py:host_peak_bytes no longer describes reality)")
     sys.exit(1)
+conf = (doc.get("conformance") or {}).get("hostmem")
+if not conf or conf.get("ok") is not True:
+    print(f"manifest conformance block missing/failed for hostmem: {conf}")
+    sys.exit(1)
 print(f"manifest OK ({len(doc['metrics'])} metrics, "
       f"{len(doc['spans'])} root spans; host peak RSS "
       f"{hm['peak_rss_bytes'] >> 20} MiB <= bound "
-      f"{hm['static_bound_bytes'] >> 20} MiB)")
+      f"{hm['static_bound_bytes'] >> 20} MiB; hostmem conformance ok)")
 PYEOF
 else
   echo "obs smoke run failed (rc=$obs_rc):"; tail -20 "$OBS_TMP/stderr.log"
@@ -278,8 +291,15 @@ if ge["entry_max"] > ge["static_entry_bound"]:
           f"{ge['entry_max']} > {ge['static_entry_bound']} "
           "(the GR005-proven projection no longer describes reality)")
     sys.exit(1)
+conf = doc.get("conformance") or {}
+for prover in ("hostmem", "ranges"):
+    pair = conf.get(prover)
+    if not pair or pair.get("ok") is not True:
+        print(f"conformance pair missing/failed for {prover}: {pair}")
+        sys.exit(1)
 print(f"check-ranges smoke OK (entry max {ge['entry_max']} <= "
-      f"projected bound {ge['static_entry_bound']})")
+      f"projected bound {ge['static_entry_bound']}; hostmem+ranges "
+      "conformance ok)")
 PYEOF
   else
     echo "check-ranges smoke run failed (rc=$obs_rc):"
@@ -328,6 +348,11 @@ if not packed or not oracle:
 if oracle < 8 * packed:
     print(f"packed ring traffic not >= 8x smaller: packed={packed} oracle={oracle}")
     sys.exit(1)
+for path in sys.argv[1:3]:
+    pair = (read_manifest(path).get("conformance") or {}).get("sched")
+    if not pair or pair.get("ok") is not True:
+        print(f"sched conformance pair missing/failed in {path}: {pair}")
+        sys.exit(1)
 print(f"ring smoke OK: parity exact, ring bytes {int(oracle)} -> {int(packed)} "
       f"({oracle / packed:.1f}x reduction)")
 PYEOF
@@ -895,6 +920,74 @@ fi
 kill -TERM "$REP_B_PID" 2>/dev/null
 wait "$REP_B_PID" 2>/dev/null
 wait "$REP_A_PID" 2>/dev/null
+if [ "$rep_rc" -eq 0 ]; then
+  # Flight-recorder trace export: the two-replica chaos run above (owner
+  # SIGKILLed mid-device, survivor stole under epoch fencing) must merge
+  # into ONE well-formed Chrome trace — the stolen job's span tree
+  # complete across both replicas, the steal flow arrow whole, epochs
+  # and the fenced terminal state present, zero orphan spans.
+  env JAX_PLATFORMS=cpu python -m spark_examples_tpu trace export \
+    --run-dir "$REP_TMP/rd" --out "$REP_TMP/fleet.trace.json" || rep_rc=$?
+  if [ "$rep_rc" -eq 0 ]; then
+    env JAX_PLATFORMS=cpu python - "$REP_TMP/fleet.trace.json" <<'PYEOF' || rep_rc=$?
+import json, sys
+from spark_examples_tpu.obs.trace import validate_chrome_trace
+
+doc = json.load(open(sys.argv[1]))
+errors = validate_chrome_trace(doc)
+if errors:
+    print("merged trace NOT well-formed:\n  " + "\n  ".join(errors))
+    sys.exit(1)
+jobs = doc["otherData"]["jobs"]
+stolen = {j: f for j, f in jobs.items() if f.get("stolen")}
+if not stolen:
+    print(f"merged trace records no stolen job: {list(jobs)}")
+    sys.exit(1)
+job_id, facts = sorted(stolen.items())[0]
+if facts["status"] != "failed":
+    print(f"stolen job's fenced terminal state wrong: {facts}")
+    sys.exit(1)
+if facts["lease_epoch"] < 2 or not facts.get("trace"):
+    print(f"stolen job missing fencing epoch or trace id: {facts}")
+    sys.exit(1)
+events = doc["traceEvents"]
+job_events = [e for e in events
+              if (e.get("args") or {}).get("job") == job_id]
+pids = {e["pid"] for e in job_events}
+if len(pids) < 2:
+    print(f"stolen job's span tree does not cross both replicas: "
+          f"pids={pids}")
+    sys.exit(1)
+traces = {(e.get("args") or {}).get("trace") for e in job_events}
+if traces - {facts["trace"]}:
+    print(f"stolen job's events carry mixed trace ids: {traces}")
+    sys.exit(1)
+spans = [e for e in job_events if e["ph"] == "X" and e["name"] == "job"]
+if not any(s["args"].get("truncated") for s in spans):
+    print("the killed owner's job span was not closed as truncated: "
+          f"{spans}")
+    sys.exit(1)
+if not any(s["args"].get("epoch") for s in spans):
+    print(f"job spans carry no lease epoch: {spans}")
+    sys.exit(1)
+arrows = [e for e in events
+          if e["ph"] in ("s", "f") and e["name"] == f"steal {job_id}"]
+if {e["ph"] for e in arrows} != {"s", "f"}:
+    print(f"stolen job has no whole steal flow arrow: {arrows}")
+    sys.exit(1)
+terminals = [e for e in job_events if e["name"] == "terminal"
+             and e["args"].get("status") == "failed"]
+if not terminals:
+    print("survivor's terminal event for the stolen job is missing")
+    sys.exit(1)
+print(f"trace export OK: {doc['otherData']['recorder_events']} events, "
+      f"{len(doc['otherData']['replicas'])} replicas, stolen job "
+      f"{job_id} complete across {len(pids)} processes (steal arrow + "
+      f"epoch {facts['lease_epoch']} + fenced terminal "
+      f"'{facts['status']}'), zero orphan spans")
+PYEOF
+  fi
+fi
 if [ "$rep_rc" -ne 0 ]; then
   echo "replica smoke failed (rc=$rep_rc):"
   tail -20 "$REP_TMP"/daemon.*.err 2>/dev/null

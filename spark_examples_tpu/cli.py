@@ -72,11 +72,23 @@ Observability (``obs/``; README "Observability"): ``--heartbeat-seconds N``
 emits a stderr progress line every N seconds (sites/sec, partition ETA,
 prefetch queue, dispatch depth, device memory); ``--metrics-json PATH``
 writes the schema-versioned run manifest (config echo, stage spans, all
-metrics, I/O stats, overlap accounting) that ``bench.py`` and CI consume;
-``--profile-dir`` adds the ``jax.profiler`` device trace:
+metrics, I/O stats, overlap accounting, prover-conformance pairs) that
+``bench.py`` and CI consume; ``--profile-dir`` adds the ``jax.profiler``
+device trace:
 
     python -m spark_examples_tpu variants-pca --all-references \\
         --heartbeat-seconds 30 --metrics-json run.json
+
+Distributed tracing (``obs/trace.py``/``obs/recorder.py``; README
+"Tracing"): every served job carries a trace id from client submit
+through journal records and replica steals, every replica daemon keeps a
+crash-durable flight recorder under ``<run-dir>/trace/``, and ``trace
+export`` merges journal + recorder segments into one Chrome-trace JSON
+(replicas as processes, executor slices as threads, steals as flow
+arrows — load it in chrome://tracing or https://ui.perfetto.dev):
+
+    python -m spark_examples_tpu trace export --run-dir /tmp/serve \\
+        --out fleet.trace.json
 """
 
 from __future__ import annotations
@@ -170,6 +182,15 @@ def _submit(argv):
     return submit_main(argv)
 
 
+def _trace(argv):
+    # Post-mortem tooling (obs/trace.py): merges a serve fleet's journal
+    # + flight-recorder segments into one Chrome-trace JSON. Pure file
+    # I/O — dispatched before the platform/cache setup like graftcheck.
+    from spark_examples_tpu.obs.trace import export_main
+
+    return export_main(argv)
+
+
 COMMANDS = {
     "variants-pca": lambda argv: pca_driver.run(argv),
     "grm": _grm,
@@ -178,6 +199,7 @@ COMMANDS = {
     "graftcheck": _graftcheck,
     "serve": _serve,
     "submit": _submit,
+    "trace": _trace,
     "search-variants-klotho": _variants_cmd(variants_examples.run_klotho),
     "search-variants-brca1": _variants_cmd(variants_examples.run_brca1),
     "search-reads-example-1": _reads_cmd(reads_examples.run_example1, ["readset"]),
@@ -201,11 +223,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if command not in COMMANDS:
         print(f"unknown command: {command}", file=sys.stderr)
         return 2
-    if command in ("graftcheck", "submit"):
+    if command in ("graftcheck", "submit", "trace"):
         # Analysis-only / client-only: no platform override, no compile
         # cache — graftcheck must run identically on devices-free CI
-        # boxes, and `submit` talks to a (possibly remote) daemon without
-        # initializing a local backend. Exit codes propagate.
+        # boxes, `submit` talks to a (possibly remote) daemon without
+        # initializing a local backend, and `trace export` is pure file
+        # I/O over a run dir. Exit codes propagate.
         return int(COMMANDS[command](rest))
     # After the help/unknown early-outs: only real commands pay (and benefit
     # from) the process-global platform/cache configuration.

@@ -42,6 +42,10 @@ Well-known metric names sampled (producers register them; see DESIGN.md §9):
   concurrency (busy == total reads as saturation), and
   ``serve_batches_total``/``serve_batch_jobs_total`` (counters) — the
   continuous-batching yield
+- ``serve_replicas_alive`` (gauge) with ``serve_jobs_stolen_total`` /
+  ``serve_lease_renewals_total`` (counters) — the multi-replica lease
+  substrate's liveness, so a replica daemon's heartbeat shows the pool
+  thinning (and its own steals) the moment a peer stops renewing
 - ``compile_cache_geometry_hits`` / ``..._misses`` (function-backed
   gauges) — the warm-geometry ledger (``utils/cache.py``), the resident
   service's compile-once promise per tick
@@ -80,7 +84,10 @@ from spark_examples_tpu.obs.metrics import (
     SERVE_BATCHES,
     SERVE_JOBS_DONE,
     SERVE_JOBS_INFLIGHT,
+    SERVE_JOBS_STOLEN,
+    SERVE_LEASE_RENEWALS,
     SERVE_QUEUE_DEPTH,
+    SERVE_REPLICAS_ALIVE,
     SERVE_SLICES,
     SERVE_SLICES_BUSY,
 )
@@ -279,6 +286,25 @@ class Heartbeat:
             busy = self.registry.value(SERVE_SLICES_BUSY)
             if busy is not None and busy == busy:
                 parts.append(f"slices {int(busy)}/{int(slices)} busy")
+
+        # Multi-replica liveness (serve/journal.py lease substrate): how
+        # many replicas are heartbeating against the shared run dir (self
+        # included — a lone 1 reads as "my peers are gone"), plus this
+        # replica's steal and lease-renewal counters. Solo daemons export
+        # replicas=0 and the segment stays silent.
+        replicas = self.registry.value(SERVE_REPLICAS_ALIVE)
+        if replicas is not None and replicas == replicas and replicas > 0:
+            segment = f"replicas {int(replicas)} alive"
+            extras = []
+            stolen = self.registry.value(SERVE_JOBS_STOLEN)
+            if stolen:
+                extras.append(f"stolen {int(stolen)}")
+            renewals = self.registry.value(SERVE_LEASE_RENEWALS)
+            if renewals:
+                extras.append(f"lease renewals {int(renewals)}")
+            if extras:
+                segment += " (" + ", ".join(extras) + ")"
+            parts.append(segment)
 
         # Continuous-batching yield: dispatch groups that coalesced more
         # than one compatible small job, and the jobs they carried.

@@ -48,7 +48,15 @@ factorization, and the STATIC ring-byte projection next to the
 per-flush-accounted total — the predicted-vs-measured pair ``bench.py``
 reports so BENCH rounds catch formula drift (``graftcheck sched`` proves
 the same formulas against the traced kernel jaxprs). Null on dense/host
-runs.
+runs. Still v2 (additive): the optional ``conformance`` block —
+``{prover: {measured, proven, ok} | null}`` for ``hostmem`` (peak RSS vs
+``host_peak_bytes``), ``sched`` (accounted ring bytes vs the schedule's
+static projection), and ``ranges`` (max |Gramian entry| vs the
+GR005-proven projection) — the prover-conformance telemetry the driver's
+epilogue registers (``obs/metrics.py:record_prover_conformance``); ``ok``
+is the measured<=proven verdict (null when no bound was provable). Null
+on runs without conformance telemetry, so existing consumers are
+untouched.
 
 Multi-host: under ``jax.distributed`` each process carries per-process
 I/O counters. :func:`build_run_manifest` aggregates them across processes
@@ -193,6 +201,7 @@ def build_manifest(
     resume: Optional[Dict] = None,
     analysis: Optional[Dict] = None,
     schedule: Optional[Dict] = None,
+    conformance: Optional[Dict] = None,
 ) -> Dict:
     """Assemble a manifest from already-snapshotted parts (the low-level
     form; :func:`build_run_manifest` snapshots a live driver). The
@@ -218,6 +227,7 @@ def build_manifest(
         "resume": resume,
         "analysis": analysis,
         "schedule": schedule,
+        "conformance": conformance,
         "compile_cache": _compile_cache_block(),
         "process": _process_block(),
         "multihost": multihost,
@@ -254,6 +264,11 @@ def build_run_manifest(conf=None, spans=None, registry=None, io_stats=None,
             "process_count": process["count"],
             "io_stats_global": dict(zip(IO_STAT_FIELDS, totals)),
         }
+    conf_block = None
+    if registry is not None:
+        from spark_examples_tpu.obs.metrics import conformance_block
+
+        conf_block = conformance_block(registry)
     return build_manifest(
         config=config,
         spans=spans.as_list() if spans is not None else [],
@@ -266,6 +281,7 @@ def build_run_manifest(conf=None, spans=None, registry=None, io_stats=None,
         resume=resume,
         analysis=analysis,
         schedule=schedule,
+        conformance=conf_block,
     )
 
 
@@ -418,6 +434,57 @@ def validate_manifest(doc) -> List[str]:
                     errors.append(
                         f"analysis.{field} is neither null nor a "
                         f"non-negative int: {value!r}"
+                    )
+
+    conformance = doc.get("conformance")
+    if conformance is not None:
+        if not isinstance(conformance, Mapping):
+            errors.append("'conformance' is neither null nor an object")
+        else:
+            for prover, pair in conformance.items():
+                if prover not in ("hostmem", "sched", "ranges"):
+                    errors.append(
+                        f"conformance names unknown prover {prover!r}"
+                    )
+                    continue
+                if pair is None:
+                    continue
+                if not isinstance(pair, Mapping):
+                    errors.append(
+                        f"conformance.{prover} is neither null nor an object"
+                    )
+                    continue
+                measured = pair.get("measured", "absent")
+                if (
+                    measured == "absent"
+                    or not isinstance(measured, int)
+                    or isinstance(measured, bool)
+                    or measured < 0
+                ):
+                    errors.append(
+                        f"conformance.{prover}.measured missing or not a "
+                        f"non-negative int: {measured!r}"
+                    )
+                proven = pair.get("proven", "absent")
+                if proven == "absent" or (
+                    proven is not None
+                    and (
+                        not isinstance(proven, int)
+                        or isinstance(proven, bool)
+                        or proven < 0
+                    )
+                ):
+                    errors.append(
+                        f"conformance.{prover}.proven is neither null nor "
+                        f"a non-negative int: {proven!r}"
+                    )
+                ok = pair.get("ok", "absent")
+                if ok == "absent" or (
+                    ok is not None and not isinstance(ok, bool)
+                ):
+                    errors.append(
+                        f"conformance.{prover}.ok is neither null nor a "
+                        f"bool: {ok!r}"
                     )
 
     schedule = doc.get("schedule")

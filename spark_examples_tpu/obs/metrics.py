@@ -151,6 +151,22 @@ HOST_STATIC_BOUND_BYTES = "host_static_bound_bytes"
 ANALYSIS_SITES_TESTED = "analysis_sites_tested"
 ANALYSIS_SITES_KEPT = "analysis_sites_kept"
 
+#: Prover-conformance pair: for each static prover with a runtime-measurable
+#: subject, the MEASURED value next to the PROVEN bound, as one labeled
+#: gauge family (``prover="hostmem" | "sched" | "ranges"``). The provers:
+#: ``hostmem`` — peak process RSS vs ``parallel/mesh.py:host_peak_bytes``;
+#: ``sched`` — per-flush-accounted ring bytes vs the schedule's static
+#: projection (``graftcheck sched`` GI005/GS002 certify the same formula
+#: device-free); ``ranges`` — max |Gramian accumulator entry| vs the
+#: GR005-proven conversion-trigger projection (``--check-ranges``).
+#: Registered by the driver's epilogue, embedded in the run manifest's
+#: ``conformance`` block, mirrored into the serve registry per completed
+#: job so ``GET /metrics`` exports the fleet's latest pair per prover —
+#: the regression tripwire: measured must NEVER exceed proven.
+PROVER_CONFORMANCE_MEASURED = "prover_conformance_measured"
+PROVER_CONFORMANCE_PROVEN = "prover_conformance_proven"
+CONFORMANCE_PROVERS = ("hostmem", "sched", "ranges")
+
 _WELL_KNOWN_GAUGE_HELP = {
     INGEST_SITES_SCANNED: (
         "Candidate sites scanned so far (heartbeat progress)."
@@ -297,6 +313,101 @@ def well_known_counter(registry: "MetricsRegistry", name: str):
     flush telemetry and the driver's device-ingest epilogue), the heartbeat,
     bench.py, and CI's manifest assertions."""
     return registry.counter(name, _WELL_KNOWN_COUNTER_HELP[name])
+
+
+_CONFORMANCE_HELP = {
+    PROVER_CONFORMANCE_MEASURED: (
+        "Measured value of a static prover's runtime subject, by prover "
+        "(hostmem: peak RSS bytes; sched: accounted ring bytes; ranges: "
+        "max |Gramian entry|). Must stay <= prover_conformance_proven."
+    ),
+    PROVER_CONFORMANCE_PROVEN: (
+        "Statically-proven bound of the same subject, by prover "
+        "(hostmem: host_peak_bytes; sched: the schedule's ring-byte "
+        "projection; ranges: the GR005-proven entry projection)."
+    ),
+}
+
+
+def record_prover_conformance(
+    registry: "MetricsRegistry",
+    prover: str,
+    measured: float,
+    proven: Optional[float],
+) -> None:
+    """Register one prover's measured/proven pair as the labeled
+    conformance gauges (idempotent; re-recording overwrites — the pair is
+    a run-level snapshot, not an accumulator). ``proven=None`` records the
+    measured side only: an unprovable configuration (a declared-unbounded
+    ingest path) reports honestly instead of inventing a bound."""
+    if prover not in CONFORMANCE_PROVERS:
+        raise MetricError(
+            f"unknown conformance prover {prover!r} "
+            f"(one of {CONFORMANCE_PROVERS})"
+        )
+    registry.gauge(
+        PROVER_CONFORMANCE_MEASURED,
+        _CONFORMANCE_HELP[PROVER_CONFORMANCE_MEASURED],
+        labelnames=("prover",),
+    ).labels(prover=prover).set(float(measured))
+    # proven=None SETS NaN rather than skipping: re-recording over an
+    # earlier pair must never leave a stale proven bound behind (the
+    # serve mirror is last-write-wins per prover — pairing one job's
+    # measured with another job's proven would fabricate verdicts).
+    registry.gauge(
+        PROVER_CONFORMANCE_PROVEN,
+        _CONFORMANCE_HELP[PROVER_CONFORMANCE_PROVEN],
+        labelnames=("prover",),
+    ).labels(prover=prover).set(
+        float(proven) if proven is not None else float("nan")
+    )
+
+
+def conformance_block(registry: "MetricsRegistry") -> Optional[Dict]:
+    """The run manifest's ``conformance`` block, read back from the
+    labeled gauges: ``{prover: {measured, proven, ok} | null}`` per
+    registered prover (``ok`` is null when no bound was provable), or
+    ``None`` when no prover recorded a pair — manifests of runs without
+    conformance telemetry are unchanged."""
+    out: Dict[str, Optional[Dict]] = {}
+    any_present = False
+    for prover in CONFORMANCE_PROVERS:
+        measured = registry.value(
+            PROVER_CONFORMANCE_MEASURED, labels={"prover": prover}
+        )
+        if measured is None or measured != measured:
+            out[prover] = None
+            continue
+        any_present = True
+        proven = registry.value(
+            PROVER_CONFORMANCE_PROVEN, labels={"prover": prover}
+        )
+        has_bound = proven is not None and proven == proven
+        if has_bound:
+            # The verdict compares the RAW floats; the displayed ints
+            # (the validator's int contract) then round in the verdict's
+            # direction — floor/ceil chosen so `measured <= proven` over
+            # the INTS holds iff `ok` does. Consumers re-deriving the
+            # comparison from the block (or from a re-recorded mirror of
+            # it, serve/daemon.py:_mirror_conformance) can never see a
+            # violated bound read as a pass, or the reverse.
+            ok = bool(measured <= proven)
+            if ok:
+                measured_int = int(math.floor(measured))
+                proven_int: Optional[int] = int(math.ceil(proven))
+            else:
+                measured_int = int(math.ceil(measured))
+                proven_int = int(math.floor(proven))
+        else:
+            ok = None
+            measured_int = int(round(measured))
+            proven_int = None
+        out[prover] = {
+            "measured": measured_int,
+            "proven": proven_int,
+            "ok": ok,
+        }
+    return out if any_present else None
 
 
 def read_host_peak_rss_bytes() -> Optional[int]:
@@ -648,7 +759,9 @@ class MetricsRegistry:
             families = sorted(self._families.values(), key=lambda f: f.name)
         for family in families:
             if family.help:
-                lines.append(f"# HELP {family.name} {family.help}")
+                lines.append(
+                    f"# HELP {family.name} {escape_help_text(family.help)}"
+                )
             lines.append(f"# TYPE {family.name} {family.kind}")
             for child in family.children():
                 label_text = _label_text(child.labels_dict)
@@ -685,13 +798,31 @@ def _label_text(labels: Mapping[str, str]) -> str:
     if not labels:
         return ""
     body = ",".join(
-        f'{k}="{_escape(v)}"' for k, v in sorted(labels.items())
+        f'{k}="{escape_label_value(v)}"' for k, v in sorted(labels.items())
     )
     return "{" + body + "}"
 
 
-def _escape(value: str) -> str:
-    return str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+def escape_label_value(value: str) -> str:
+    """Label-value escaping per the text exposition format (v0.0.4):
+    backslash FIRST (the escape character itself, so the later
+    replacements cannot double-escape their own output), then the
+    double-quote delimiter, then newline — the three characters the
+    format names."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def escape_help_text(value: str) -> str:
+    """HELP-line escaping per the exposition format: backslash and
+    newline only (a ``#`` or quote is legal inside help text, but a raw
+    newline would terminate the comment mid-help and turn the remainder
+    into an unparseable exposition line)."""
+    return str(value).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 __all__ = [
@@ -733,7 +864,14 @@ __all__ = [
     "SERVE_REPLICAS_ALIVE",
     "HOST_PEAK_RSS_BYTES",
     "HOST_STATIC_BOUND_BYTES",
+    "PROVER_CONFORMANCE_MEASURED",
+    "PROVER_CONFORMANCE_PROVEN",
+    "CONFORMANCE_PROVERS",
+    "conformance_block",
+    "escape_help_text",
+    "escape_label_value",
     "read_host_peak_rss_bytes",
+    "record_prover_conformance",
     "well_known_gauge",
     "well_known_counter",
 ]

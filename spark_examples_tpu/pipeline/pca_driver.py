@@ -1224,6 +1224,7 @@ def run_pipeline(
             conf, kind="similarity" if similarity_only else "pca"
         )
     )
+    _register_prover_conformance(driver)
     lines = driver.emit_result(result) if result is not None else []
     driver.report_io_stats()
     if conf.profile_dir:
@@ -1289,6 +1290,61 @@ def run_pipeline(
         manifest=manifest_doc,
         manifest_path=manifest_path,
     )
+
+
+def _register_prover_conformance(driver: "VariantsPcaDriver") -> None:
+    """The run-epilogue prover-conformance snapshot: for every static
+    prover with a runtime-measured subject this run produced, register the
+    measured/proven pair as the labeled conformance gauges
+    (``obs/metrics.py:record_prover_conformance``) — the manifest's
+    ``conformance`` block and the serve fleet's ``/metrics`` mirror both
+    read these. Pairs: ``hostmem`` (peak RSS vs the ``host_peak_bytes``
+    bound the driver proved at startup — measured always recorded, bound
+    null on declared-unbounded paths), ``sched`` (the sharded
+    accumulator's per-flush-accounted ring bytes vs its static
+    projection), ``ranges`` (the ``--check-ranges`` entry-max sample vs
+    the GR005-proven projection). Best-effort: telemetry must never take
+    down a completed run."""
+    from spark_examples_tpu.obs.metrics import (
+        GRAMIAN_ENTRY_MAX,
+        GRAMIAN_STATIC_ENTRY_BOUND,
+        HOST_PEAK_RSS_BYTES,
+        HOST_STATIC_BOUND_BYTES,
+        record_prover_conformance,
+    )
+
+    registry = driver.registry
+    try:
+        measured_rss = registry.value(HOST_PEAK_RSS_BYTES)
+        if measured_rss is not None and measured_rss == measured_rss:
+            bound = registry.value(HOST_STATIC_BOUND_BYTES)
+            record_prover_conformance(
+                registry,
+                "hostmem",
+                measured_rss,
+                bound if bound is not None and bound == bound else None,
+            )
+        sched = driver._sched_block
+        if sched is not None:
+            record_prover_conformance(
+                registry,
+                "sched",
+                sched["measured_ring_bytes"],
+                sched["predicted_ring_bytes"],
+            )
+        entry_max = registry.value(GRAMIAN_ENTRY_MAX)
+        if entry_max is not None and entry_max == entry_max:
+            entry_bound = registry.value(GRAMIAN_STATIC_ENTRY_BOUND)
+            record_prover_conformance(
+                registry,
+                "ranges",
+                entry_max,
+                entry_bound
+                if entry_bound is not None and entry_bound == entry_bound
+                else None,
+            )
+    except Exception:
+        pass
 
 
 def _export_compile_cache_gauges(registry) -> None:

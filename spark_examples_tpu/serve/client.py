@@ -132,7 +132,11 @@ class ServeClient:
         self._sleep(delay)
 
     def _request(
-        self, method: str, path: str, doc: Optional[Dict] = None
+        self,
+        method: str,
+        path: str,
+        doc: Optional[Dict] = None,
+        extra_headers: Optional[Dict[str, str]] = None,
     ) -> Tuple[int, object, str, Optional[Dict]]:
         """One HTTP exchange. GETs (``status``/``/metrics``/``/healthz``)
         retry connection resets and 5xx responses with bounded backoff —
@@ -144,6 +148,8 @@ class ServeClient:
         (once per extra endpoint per request) when one is given."""
         data = None
         headers = {"Accept": "application/json"}
+        if extra_headers:
+            headers.update(extra_headers)
         if doc is not None:
             data = json.dumps(doc).encode("utf-8")
             headers["Content-Type"] = "application/json"
@@ -207,9 +213,15 @@ class ServeClient:
         return status, None, text, headers
 
     def _json_with_headers(
-        self, method: str, path: str, doc: Optional[Dict] = None
+        self,
+        method: str,
+        path: str,
+        doc: Optional[Dict] = None,
+        extra_headers: Optional[Dict[str, str]] = None,
     ) -> Tuple[Dict, Optional[Dict]]:
-        status, body, text, headers = self._request(method, path, doc)
+        status, body, text, headers = self._request(
+            method, path, doc, extra_headers=extra_headers
+        )
         if status >= 400:
             raise ServeError(status, body if body is not None else text)
         if not isinstance(body, dict):
@@ -224,8 +236,16 @@ class ServeClient:
             )
         return body, headers
 
-    def _json(self, method: str, path: str, doc: Optional[Dict] = None) -> Dict:
-        return self._json_with_headers(method, path, doc)[0]
+    def _json(
+        self,
+        method: str,
+        path: str,
+        doc: Optional[Dict] = None,
+        extra_headers: Optional[Dict[str, str]] = None,
+    ) -> Dict:
+        return self._json_with_headers(
+            method, path, doc, extra_headers=extra_headers
+        )[0]
 
     # ----------------------------------------------------------------- verbs
 
@@ -235,16 +255,27 @@ class ServeClient:
         kind: str = "pca",
         deadline_seconds: Optional[float] = None,
         tag: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> Dict:
         """Submit one job; returns the job envelope (``doc["job"]["id"]``
         is the handle). Raises :class:`ServeError` on every rejection —
-        ``.body["plan"]`` carries the admission validator's facts."""
+        ``.body["plan"]`` carries the admission validator's facts.
+
+        This is where a trace is BORN: the client mints a trace id (or
+        forwards the caller's — a batch harness correlating many submits)
+        and sends it as the ``X-Trace-Id`` header; the server stamps it
+        on the job, its journal record, and every flight-recorder event,
+        and echoes it back as ``doc["job"]["trace"]``."""
+        from spark_examples_tpu.obs.trace import TRACE_HEADER, mint_trace_id
+
+        trace = trace_id if trace_id is not None else mint_trace_id()
         return self._json(
             "POST",
             "/v1/jobs",
             request_doc(
                 flags, kind=kind, deadline_seconds=deadline_seconds, tag=tag
             ),
+            extra_headers={TRACE_HEADER: trace},
         )
 
     def status(self, job_id: str) -> Dict:

@@ -76,6 +76,7 @@ from spark_examples_tpu.serve.protocol import (
     parse_request,
     request_doc,
 )
+from spark_examples_tpu.obs.trace import mint_trace_id, normalize_trace_id
 from spark_examples_tpu.serve.queue import (
     DEFAULT_BATCH_LINGER_SECONDS,
     DEFAULT_BATCH_MAX_JOBS,
@@ -307,6 +308,10 @@ class PcaService:
         )
         self._guard_run_dir = bool(guard_run_dir)
         self._run_dir_lock: Optional[RunDirLock] = None
+        #: Flight recorder (obs/recorder.py): every lifecycle transition
+        #: of every job this replica touches, crash-durably flushed — the
+        #: per-replica half of the fleet's merged trace.
+        self._recorder = None
         self._lease_store: Optional[LeaseStore] = None
         self._lease_thread: Optional[threading.Thread] = None
         self._lease_stop = threading.Event()
@@ -433,6 +438,41 @@ class PcaService:
             )
         )
 
+    # ------------------------------------------------------------- tracing
+
+    def _flush_recorder(self) -> None:
+        """The fault-hook target (``utils/faults.add_flush_hook``): make
+        the ring durable before an injected fault fires. fsync'd — this
+        may be the last Python the process executes."""
+        if self._recorder is not None:
+            self._recorder.flush(fsync=True)
+
+    def _trace_event(
+        self,
+        name: str,
+        ph: str = "i",
+        job: Optional[Job] = None,
+        job_id: Optional[str] = None,
+        trace: Optional[str] = None,
+        tid: str = "control",
+        flush: bool = False,
+        **args,
+    ) -> None:
+        """Record one flight-recorder event (no-op before :meth:`start`).
+        ``flush=True`` drains the ring with a buffered write (no fsync:
+        a ``kill -9`` keeps OS page-cache writes, and the fault hook
+        fsyncs before injected kills) — cheap enough for every terminal
+        transition."""
+        recorder = self._recorder
+        if recorder is None:
+            return
+        if job is not None:
+            job_id = job.id
+            trace = trace if trace is not None else job.trace_id
+        recorder.record(name, ph=ph, trace=trace, job=job_id, tid=tid, **args)
+        if flush:
+            recorder.flush(fsync=False)
+
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> "PcaService":
@@ -456,6 +496,19 @@ class PcaService:
             self._run_dir_lock = acquire_run_dir_lock(
                 self.run_dir, self.replica_id
             )
+        # The flight recorder comes up BEFORE journal replay so replayed
+        # adoptions and startup steals are on the record; its ring is
+        # flushed at every registered fault kill-point (the hook below
+        # runs as the last Python before an injected SIGKILL), at every
+        # terminal transition, and at drain — the chaos harness's
+        # `kill -9` always lands on a segment holding the events that
+        # led up to it.
+        from spark_examples_tpu.obs.recorder import FlightRecorder
+
+        self._recorder = FlightRecorder(
+            self.run_dir, name=self.replica_id or "solo"
+        )
+        faults.add_flush_hook(self._flush_recorder)
         if self.replica_id is not None:
             self._lease_store = LeaseStore(
                 self.run_dir,
@@ -591,6 +644,16 @@ class PcaService:
                 self._journal.lease(record.job_id, epoch, stolen=stolen)
                 if stolen:
                     self._jobs_stolen.inc(1)
+                    # The merged trace's steal edge: a flow arrow from
+                    # the dead owner's last recorded event to this claim.
+                    self._trace_event(
+                        "steal",
+                        job_id=record.job_id,
+                        trace=record.trace_id,
+                        flush=True,
+                        epoch=epoch,
+                        **{"from": record.lease_replica},
+                    )
             if self._adopt_pending(record, stolen=stolen):
                 requeued.append(record)
         if self._lease_store is not None:
@@ -651,10 +714,22 @@ class PcaService:
             # worker crash on the adopted copy must fail it, not loop
             # it through a third life.
             requeues=1,
+            # The journaled trace id keeps the stolen/replayed job in the
+            # SAME span tree its submit opened; pre-tracing journals get
+            # a fresh id so every adopted job is still traceable.
+            trace_id=record.trace_id or mint_trace_id(),
         )
         if count_replayed:
             self._journal_replayed.inc(1)
             self._replayed_jobs += 1
+        self._trace_event(
+            "adopt",
+            job=job,
+            flush=True,
+            stolen=stolen,
+            device_began=record.device_began,
+            from_replica=record.lease_replica,
+        )
         if record.device_began:
             # The requeue-once boundary holds ACROSS replica lives: the
             # journaled began flag was written by whichever life started
@@ -705,6 +780,9 @@ class PcaService:
         still run to completion."""
         self._draining.set()
         self._queue.close()
+        # SIGTERM rides through here (serve/http.py's signal handler):
+        # the drain decision itself becomes durable immediately.
+        self._trace_event("drain-begin", flush=True)
 
     @property
     def draining(self) -> bool:
@@ -762,6 +840,10 @@ class PcaService:
             # heartbeat so surviving peers do not report the pool
             # degraded over a clean scale-down.
             self._lease_store.retire()
+        if self._recorder is not None:
+            self._trace_event("drained")
+            faults.remove_flush_hook(self._flush_recorder)
+            self._recorder.close()
         if self._run_dir_lock is not None:
             self._run_dir_lock.release()
             self._run_dir_lock = None
@@ -792,8 +874,11 @@ class PcaService:
                 return worker.spec.device_count
         return self.device_count
 
-    def submit(self, doc) -> Tuple[int, Dict]:
-        """One ``POST /v1/jobs`` body → ``(http_status, response_doc)``."""
+    def submit(self, doc, trace_id: Optional[str] = None) -> Tuple[int, Dict]:
+        """One ``POST /v1/jobs`` body → ``(http_status, response_doc)``.
+        ``trace_id`` is the client's ``X-Trace-Id`` header (malformed or
+        absent → a server-minted id): the job's whole fleet-side life is
+        recorded under it."""
         if self.draining:
             self._rejected.labels(code="draining").inc()
             return 503, error_doc(
@@ -891,6 +976,7 @@ class PcaService:
             ),
             plan_geometry=dict(report.geometry),
             batch_key=self._batch_key(conf, request.kind),
+            trace_id=normalize_trace_id(trace_id) or mint_trace_id(),
         )
         with self._lock:
             self._table[job.id] = job
@@ -904,6 +990,13 @@ class PcaService:
         # device work; a rejected put below appends a terminal tombstone
         # so the record cannot resurrect.
         self._journal_accepted(job)
+        self._trace_event(
+            "accepted",
+            job=job,
+            flush=True,
+            job_class=job.job_class,
+            kind=job.request.kind,
+        )
         if self._lease_store is not None:
             # Lease the job the moment it is durably accepted: from here
             # on a dead replica's work is visibly expired, stealable
@@ -930,6 +1023,7 @@ class PcaService:
                 )
             if self._journal is not None:
                 self._journal.lease(job.id, epoch)
+            self._trace_event("lease", job=job, epoch=epoch)
         try:
             self._queue.put(job)
         except QueueFull as e:
@@ -965,6 +1059,7 @@ class PcaService:
             job_class=job.job_class,
             submitted_unix=job.submitted_unix,
             deadline_unix=job.deadline_unix,
+            trace_id=job.trace_id,
         )
 
     def _lease_epoch(self, job_id: str) -> Optional[int]:
@@ -981,6 +1076,13 @@ class PcaService:
             )
         if self._lease_store is not None:
             self._lease_store.release(job.id)
+        self._trace_event(
+            "terminal",
+            job=job,
+            flush=True,
+            status=job.status,
+            **({"error": job.error} if job.error else {}),
+        )
 
     def _journal_tombstone(self, job: Job) -> None:
         """Admission-path tombstone: the accepted record may not replay."""
@@ -990,6 +1092,7 @@ class PcaService:
             )
         if self._lease_store is not None:
             self._lease_store.release(job.id)
+        self._trace_event("terminal", job=job, flush=True, status="rejected")
 
     # --------------------------------------------------------------- lookup
 
@@ -1158,6 +1261,7 @@ class PcaService:
             plan_geometry=job.plan_geometry,
             slice_name=job.slice,
             batch_size=job.batch_size,
+            trace=job.trace_id,
         )
 
     # --------------------------------------------------------------- worker
@@ -1228,6 +1332,9 @@ class PcaService:
                     "run decides the outcome",
                 )
             self._completed.labels(status="failed").inc()
+            self._trace_event(
+                "abandoned", job=job, flush=True, reason="lease-lost"
+            )
             return
         with self._lock:
             job.status = "running"
@@ -1236,6 +1343,24 @@ class PcaService:
             worker.running_job_id = job.id
             self._inflight += 1
         self._slice_inflight.labels(slice=worker.spec.name).set(1)
+        # The job span opens on the slice's thread lane; flushed so an
+        # arbitrary-time kill still leaves the B durable (the exporter
+        # closes a B whose E died with the process as a truncated span).
+        self._trace_event(
+            "job",
+            ph="B",
+            job=job,
+            tid=worker.spec.name,
+            flush=True,
+            job_class=job.job_class,
+            kind=job.request.kind,
+            batch_size=job.batch_size,
+            **(
+                {"epoch": self._lease_epoch(job.id)}
+                if self._lease_store is not None
+                else {}
+            ),
+        )
         # Registered kill-point: job claimed and flipped to running, BEFORE
         # any device work — the requeue-eligible window (a crash here is
         # side-effect-free; the watchdog re-puts the job once).
@@ -1248,6 +1373,17 @@ class PcaService:
         # replays or steals it.
         if self._journal is not None:
             self._journal.began(job.id, epoch=self._lease_epoch(job.id))
+        self._trace_event(
+            "device-began",
+            job=job,
+            tid=worker.spec.name,
+            flush=True,
+            **(
+                {"epoch": self._lease_epoch(job.id)}
+                if self._lease_store is not None
+                else {}
+            ),
+        )
         # Registered kill-point: device work marked begun, executor about
         # to run — a crash from here on must NOT be requeued (device state
         # under a crashed update cannot be trusted for a silent retry).
@@ -1292,6 +1428,15 @@ class PcaService:
                 )
             self._slice_inflight.labels(slice=worker.spec.name).set(0)
             self._completed.labels(status="failed").inc()
+            self._trace_event(
+                "job",
+                ph="E",
+                job=job,
+                tid=worker.spec.name,
+                flush=True,
+                status="failed",
+                abandoned="lease-lost",
+            )
             return
         with self._lock:
             job.finished_unix = time.time()
@@ -1308,9 +1453,46 @@ class PcaService:
                 job.manifest_path = outcome.manifest_path
                 job.compile_cache = outcome.compile_cache
         self._slice_inflight.labels(slice=worker.spec.name).set(0)
+        self._trace_event(
+            "job",
+            ph="E",
+            job=job,
+            tid=worker.spec.name,
+            status=job.status,
+            compile_cache=job.compile_cache,
+            **({"error": error} if error else {}),
+        )
+        if outcome is not None and outcome.conformance:
+            self._mirror_conformance(outcome.conformance)
         self._journal_terminal(job)
         self._completed.labels(status=job.status).inc()
         self._job_seconds.labels(job_class=job.job_class).observe(seconds)
+
+    def _mirror_conformance(self, block: Dict) -> None:
+        """Mirror a completed job's manifest ``conformance`` block into
+        the SERVICE registry (last-write-wins per prover), so ``GET
+        /metrics`` exports the fleet's latest measured-vs-proven pair —
+        a scrape sees prover conformance without chasing per-job
+        manifests. Best-effort: a malformed block is dropped, never a
+        job failure."""
+        from spark_examples_tpu.obs.metrics import record_prover_conformance
+
+        for prover, pair in block.items():
+            if not isinstance(pair, dict):
+                continue
+            measured = pair.get("measured")
+            if not isinstance(measured, (int, float)):
+                continue
+            proven = pair.get("proven")
+            try:
+                record_prover_conformance(
+                    self.registry,
+                    prover,
+                    measured,
+                    proven if isinstance(proven, (int, float)) else None,
+                )
+            except Exception:
+                continue
 
     # ------------------------------------------------------------- watchdog
 
@@ -1379,6 +1561,18 @@ class PcaService:
             untouched = list(worker.pending_batch)
             worker.pending_batch = []
         self._slice_inflight.labels(slice=worker.spec.name).set(0)
+        if crashed is not None:
+            # Close the dead worker's open job span (the B was recorded
+            # on the worker thread; pairing is by (replica, job, name),
+            # so this E from the watchdog thread closes it cleanly).
+            self._trace_event(
+                "job",
+                ph="E",
+                job=crashed,
+                tid=worker.spec.name,
+                flush=True,
+                status="worker-crashed",
+            )
         # Replacement FIRST, job settlement second: a client that observes
         # the crashed job's terminal status (or its requeue) must never
         # then find healthz reporting a dead worker — the failure and the
@@ -1514,6 +1708,9 @@ class PcaService:
                 "decides the outcome",
             )
         self._completed.labels(status="failed").inc()
+        self._trace_event(
+            "abandoned", job=job, flush=True, reason="lease-lost"
+        )
 
     def _steal_expired(self) -> None:
         """Scan for jobs whose lease expired because their owner died,
@@ -1573,6 +1770,14 @@ class PcaService:
             return  # settled between our fold and our claim
         self._journal.lease(record.job_id, epoch, stolen=True)
         self._jobs_stolen.inc(1)
+        self._trace_event(
+            "steal",
+            job_id=record.job_id,
+            trace=fresh.trace_id,
+            flush=True,
+            epoch=epoch,
+            **{"from": record.lease_replica},
+        )
         self._adopt_pending(fresh, stolen=True, count_replayed=False)
 
     def _maybe_compact(self) -> None:

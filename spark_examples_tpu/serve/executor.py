@@ -72,6 +72,11 @@ class ExecutionOutcome:
     result: Dict
     manifest_path: Optional[str]
     compile_cache: str  # "warm" | "cold"
+    #: The run manifest's prover-conformance block (measured-vs-proven
+    #: per prover; ``obs/metrics.py:conformance_block``) — the daemon
+    #: mirrors it into the service registry so ``GET /metrics`` exports
+    #: the fleet's latest pair per prover.
+    conformance: Optional[Dict] = None
 
 
 def job_directory(run_dir: str, job_id: str) -> str:
@@ -150,6 +155,11 @@ def execute_job(job: Job, run_dir: str) -> ExecutionOutcome:
         result=result,
         manifest_path=manifest_path,
         compile_cache="warm" if warm else "cold",
+        conformance=(
+            manifest_doc.get("conformance")
+            if isinstance(manifest_doc, dict)
+            else None
+        ),
     )
 
 

@@ -180,7 +180,16 @@ class ServeHandler(BaseHTTPRequestHandler):
             doc = self._read_json_body()
             if doc is None:
                 return
-            status, body = service.submit(doc)
+            # Trace-context propagation (obs/trace.py): the client's
+            # X-Trace-Id header rides into the admission, the journal,
+            # and every flight-recorder event of the job's life — a
+            # malformed or absent id gets a server-minted replacement
+            # inside submit(), never a rejection.
+            from spark_examples_tpu.obs.trace import TRACE_HEADER
+
+            status, body = service.submit(
+                doc, trace_id=self.headers.get(TRACE_HEADER)
+            )
             self._send_json(status, body)
             return
         self._drain_body()

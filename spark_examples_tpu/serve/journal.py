@@ -108,6 +108,10 @@ class PendingJob:
     #: epoch+1 and to name the dead owner in a structured failure.
     lease_epoch: int = 0
     lease_replica: Optional[str] = None
+    #: Trace id minted at submit (rides the ``accepted`` record, so one
+    #: job stays one span tree across replica steals; ``None`` on
+    #: journals written before tracing existed).
+    trace_id: Optional[str] = None
 
 
 class JobJournal:
@@ -179,24 +183,26 @@ class JobJournal:
         job_class: str,
         submitted_unix: float,
         deadline_unix: Optional[float],
+        trace_id: Optional[str] = None,
     ) -> None:
         # The replica stamp lets the steal scan attribute a job that was
         # accepted but never leased (its owner died in the one-record
         # window between this append and the lease claim) to a dead peer
-        # via the heartbeat file instead of leaving it orphaned.
-        self._append(
-            self._stamped(
-                {
-                    "event": "accepted",
-                    "id": job_id,
-                    "request": request_doc,
-                    "job_class": job_class,
-                    "submitted_unix": submitted_unix,
-                    "deadline_unix": deadline_unix,
-                },
-                None,
-            )
-        )
+        # via the heartbeat file instead of leaving it orphaned. The
+        # trace id rides the same record so a stolen job keeps ONE span
+        # tree across replica lives (compaction rewrites accepted records
+        # verbatim, so it survives every rewrite for free).
+        record = {
+            "event": "accepted",
+            "id": job_id,
+            "request": request_doc,
+            "job_class": job_class,
+            "submitted_unix": submitted_unix,
+            "deadline_unix": deadline_unix,
+        }
+        if trace_id is not None:
+            record["trace"] = trace_id
+        self._append(self._stamped(record, None))
 
     def began(self, job_id: str, epoch: Optional[int] = None) -> None:
         self._append(self._stamped({"event": "began", "id": job_id}, epoch))
@@ -271,6 +277,13 @@ def _iter_records(path: str):
                 yield record
 
 
+def iter_journal_records(path: str):
+    """Public raw-record iterator (the ``trace export`` verb correlates the
+    journal's admission/lease/terminal facts with flight-recorder events;
+    the fold below stays the replay semantics)."""
+    return _iter_records(path)
+
+
 def replay_journal(path: str) -> Tuple[List[PendingJob], int]:
     """Fold the journal into ``(pending_jobs, max_seq)``: every accepted
     job without a VALID terminal record, in admission order, with its
@@ -314,6 +327,7 @@ def replay_journal(path: str) -> Tuple[List[PendingJob], int]:
                 job_class, str
             ):
                 continue
+            trace = record.get("trace")
             pending[job_id] = PendingJob(
                 job_id=job_id,
                 request_doc=request,
@@ -325,6 +339,7 @@ def replay_journal(path: str) -> Tuple[List[PendingJob], int]:
                     else None
                 ),
                 accepted_record=record,
+                trace_id=trace if isinstance(trace, str) else None,
             )
         elif event == "began":
             began.add(job_id)
@@ -891,6 +906,7 @@ __all__ = [
     "acquire_run_dir_lock",
     "compact_journal",
     "compact_journal_shared",
+    "iter_journal_records",
     "journal_path",
     "replay_journal",
 ]

@@ -223,15 +223,20 @@ def job_doc(
     plan_geometry: Optional[Mapping] = None,
     slice_name: Optional[str] = None,
     batch_size: Optional[int] = None,
+    trace: Optional[str] = None,
 ) -> Dict:
     """The job envelope (submit response and ``GET /v1/jobs/<id>``).
     ``slice``/``batch_size`` are execution attribution (which executor
-    slice ran the job, how many jobs rode its dispatch group) — additive
-    response fields; request-side strictness is unchanged."""
+    slice ran the job, how many jobs rode its dispatch group);
+    ``trace`` echoes the job's distributed-tracing id (the client-sent
+    ``X-Trace-Id`` when one rode the submit, a server-minted id
+    otherwise) — additive response fields; request-side strictness is
+    unchanged."""
     return {
         "protocol": protocol_block(),
         "job": {
             "id": job_id,
+            "trace": trace,
             "kind": kind,
             "class": job_class,
             "status": status,

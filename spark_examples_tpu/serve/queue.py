@@ -125,6 +125,11 @@ class Job:
     #: executor passes them into ``run_pipeline(devices=...)`` so the job
     #: runs on its slice's sub-mesh only.
     slice_devices: Optional[object] = None
+    #: Distributed-tracing id (``obs/trace.py``): minted at client submit
+    #: (or at admission when the client sent none), journaled with the
+    #: accepted record, stamped on every flight-recorder event — one job
+    #: is one span tree across restarts and replica steals.
+    trace_id: Optional[str] = None
 
 
 def classify_conf(conf, small_site_limit: int = SMALL_JOB_MAX_SITES) -> str:

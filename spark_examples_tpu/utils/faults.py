@@ -223,6 +223,39 @@ _plan_entries: object = _UNSET  # _UNSET | None | List[_Entry]
 _hits: Dict[str, int] = {}
 _injected = 0
 
+#: Pre-fire flush hooks (``obs/recorder.py``'s crash durability): every
+#: registered hook runs IMMEDIATELY BEFORE a matched fault fires — for the
+#: ``kill`` action that is the last Python the process executes, so the
+#: flight recorder's ring reaches disk before the SIGKILL the chaos
+#: harness is about to assert recovery from. Hooks must be cheap, must
+#: not raise (exceptions are swallowed: a telemetry bug must not turn a
+#: deterministic kill-point into a different crash), and run on the
+#: faulting thread.
+_flush_hooks: List[Callable[[], None]] = []
+
+
+def add_flush_hook(fn: Callable[[], None]) -> None:
+    """Register a pre-fire flush hook (idempotent per callable)."""
+    with _lock:
+        if fn not in _flush_hooks:
+            _flush_hooks.append(fn)
+
+
+def remove_flush_hook(fn: Callable[[], None]) -> None:
+    with _lock:
+        if fn in _flush_hooks:
+            _flush_hooks.remove(fn)
+
+
+def _run_flush_hooks() -> None:
+    with _lock:
+        hooks = list(_flush_hooks)
+    for fn in hooks:
+        try:
+            fn()
+        except Exception:
+            pass
+
 
 def configure(spec: Optional[str]) -> None:
     """(Re)configure the process-wide fault plan. ``None``/empty disables.
@@ -295,6 +328,7 @@ def kill_point(site: str) -> None:
         raise KeyError(f"unregistered kill-point {site!r}")
     entry = _match(site)
     if entry is not None:
+        _run_flush_hooks()
         _fire_control(entry)
 
 
@@ -308,6 +342,7 @@ def io_point(site: str, data: Optional[bytes] = None) -> Optional[bytes]:
     if entry is None:
         return data
     if entry.action == "kill":
+        _run_flush_hooks()
         os.kill(os.getpid(), signal.SIGKILL)
     if entry.action == "ioerror":
         raise OSError(f"injected IO error at {site}")
@@ -337,6 +372,8 @@ __all__ = [
     "configure",
     "active",
     "injected_count",
+    "add_flush_hook",
+    "remove_flush_hook",
     "kill_point",
     "io_point",
     "snapshot",

@@ -639,3 +639,163 @@ def test_gramian_flush_telemetry():
     (ingest,) = [s for s in rec.as_list() if s["name"] == "ingest+similarity"]
     names = [c["name"] for c in ingest["children"]]
     assert names == ["dispatch", "reduce-flush"]
+
+
+# ----------------------------------------------- exposition-format escaping
+
+
+def test_prometheus_label_value_escaping():
+    """Regression: label values carrying the three characters the text
+    exposition format names — backslash, double-quote, newline — must
+    escape per the spec, backslash first (so the later replacements
+    cannot double-escape their own output)."""
+    reg = MetricsRegistry()
+    gauge = reg.gauge("escape_test", "", labelnames=("path",))
+    gauge.labels(path='C:\\temp\\"quoted"\nnext').set(1)
+    text = reg.prometheus_text()
+    line = next(l for l in text.splitlines() if l.startswith("escape_test"))
+    assert line == (
+        'escape_test{path="C:\\\\temp\\\\\\"quoted\\"\\nnext"} 1'
+    )
+    # Exactly one physical line: the raw newline never leaks through.
+    assert sum(1 for l in text.splitlines() if "escape_test" in l) == 2
+    # A literal backslash-n sequence stays distinguishable from a real
+    # newline after escaping (the round-trip-ability the spec is for).
+    gauge2 = reg.gauge("escape_test_2", "", labelnames=("v",))
+    gauge2.labels(v="a\\nb").set(1)
+    assert 'escape_test_2{v="a\\\\nb"} 1' in reg.prometheus_text()
+
+
+def test_prometheus_help_text_escaping():
+    """HELP lines escape backslash and newline (a raw newline would
+    terminate the comment mid-help and leave an unparseable line)."""
+    reg = MetricsRegistry()
+    reg.counter("help_test", "line one\nline two \\ backslash").inc()
+    text = reg.prometheus_text()
+    assert "# HELP help_test line one\\nline two \\\\ backslash" in text
+    for line in text.splitlines():
+        assert line.startswith(("#", "help_test"))
+
+
+def test_escape_helpers_are_exact():
+    from spark_examples_tpu.obs.metrics import (
+        escape_help_text,
+        escape_label_value,
+    )
+
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value("a\\b") == "a\\\\b"
+    assert escape_label_value("a\nb") == "a\\nb"
+    assert escape_label_value("\\\n\"") == '\\\\\\n\\"'
+    assert escape_help_text('a"b') == 'a"b'  # quotes legal in help
+    assert escape_help_text("a\\\nb") == "a\\\\\\nb"
+
+
+# ----------------------------------------- span recorder under concurrency
+
+
+def test_span_recorder_thread_safety_under_concurrent_slices():
+    """The serve daemon's slice workers nest spans concurrently in ONE
+    recorder (each slice its own thread): per-thread stacks must keep
+    every tree correctly nested with zero cross-thread adoption and zero
+    lost spans under a start-barrier stampede."""
+    rec = SpanRecorder()
+    workers, jobs_per_worker = 8, 25
+    barrier = threading.Barrier(workers)
+    errors = []
+
+    def slice_worker(idx):
+        try:
+            barrier.wait(timeout=10)
+            for j in range(jobs_per_worker):
+                with rec.span(f"job w{idx}-{j}") as outer:
+                    with rec.span("admission"):
+                        pass
+                    with rec.span("device"):
+                        with rec.span("flush"):
+                            pass
+                # Closed and attached as this thread's root: never
+                # adopted by another thread's open span.
+                assert outer.seconds is not None
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=slice_worker, args=(i,))
+        for i in range(workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    roots = rec.as_list()
+    assert len(roots) == workers * jobs_per_worker
+    for root in roots:
+        assert re.fullmatch(r"job w\d+-\d+", root["name"])
+        assert [c["name"] for c in root["children"]] == [
+            "admission",
+            "device",
+        ]
+        assert [c["name"] for c in root["children"][1]["children"]] == [
+            "flush"
+        ]
+        assert root["seconds"] is not None
+    # Per-worker ordering survives the interleaving (roots attach at
+    # close time, but each worker's own jobs close in order).
+    for idx in range(workers):
+        mine = [
+            r["name"] for r in roots if r["name"].startswith(f"job w{idx}-")
+        ]
+        assert mine == [f"job w{idx}-{j}" for j in range(jobs_per_worker)]
+    # The per-thread stacks drained: nothing left open.
+    assert rec._stacks == {}
+
+
+def test_span_recorder_concurrent_add_and_span():
+    """Pre-measured add() aggregates from worker threads land as roots
+    (or under that thread's open span), never under another thread's."""
+    rec = SpanRecorder()
+    stop = threading.Event()
+
+    def adder():
+        while not stop.is_set():
+            rec.add("flush-aggregate", 0.001)
+
+    t = threading.Thread(target=adder)
+    t.start()
+    try:
+        for _ in range(50):
+            with rec.span("driver-stage"):
+                pass
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    for root in rec.as_list():
+        if root["name"] == "driver-stage":
+            assert root["children"] == []
+
+
+# --------------------------------------------- heartbeat replica segments
+
+
+def test_heartbeat_replica_lease_steal_segments():
+    from spark_examples_tpu.obs.metrics import (
+        SERVE_JOBS_STOLEN,
+        SERVE_LEASE_RENEWALS,
+        SERVE_REPLICAS_ALIVE,
+        well_known_counter,
+        well_known_gauge,
+    )
+
+    reg = MetricsRegistry()
+    hb = Heartbeat(60.0, reg)
+    well_known_gauge(reg, SERVE_REPLICAS_ALIVE).set(0)
+    # Solo mode (0 replicas heartbeating): the segment stays silent.
+    assert "replicas" not in hb.line()
+    well_known_gauge(reg, SERVE_REPLICAS_ALIVE).set(2)
+    assert "replicas 2 alive" in hb.line()
+    well_known_counter(reg, SERVE_JOBS_STOLEN).inc(3)
+    well_known_counter(reg, SERVE_LEASE_RENEWALS).inc(17)
+    line = hb.line()
+    assert "replicas 2 alive (stolen 3, lease renewals 17)" in line

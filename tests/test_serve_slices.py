@@ -707,7 +707,7 @@ def test_client_wait_honors_retry_after(monkeypatch):
         (200, {"job": {"status": "done"}}, "", {}),
     ]
 
-    def fake_request(method, path, doc=None):
+    def fake_request(method, path, doc=None, extra_headers=None):
         assert method == "GET" and path == "/v1/jobs/j1"
         return responses.pop(0)
 

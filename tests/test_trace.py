@@ -1,0 +1,810 @@
+"""Distributed tracing + flight recorder + prover conformance (obs/trace,
+obs/recorder, the serve-side propagation, and the `trace export` verb):
+recorder ring/flush/torn-tail semantics, kill-point flush hooks, the
+Chrome-trace merge (span pairing, truncated-span closure, steal flow
+arrows) and its validator, trace-id propagation client → HTTP → journal →
+steal, and the conformance gauges/manifest block."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from spark_examples_tpu.obs.metrics import (
+    CONFORMANCE_PROVERS,
+    MetricsRegistry,
+    PROVER_CONFORMANCE_MEASURED,
+    PROVER_CONFORMANCE_PROVEN,
+    conformance_block,
+    record_prover_conformance,
+)
+from spark_examples_tpu.obs.recorder import (
+    FlightRecorder,
+    read_segments,
+    trace_dir,
+)
+from spark_examples_tpu.obs.trace import (
+    TRACE_HEADER,
+    export_main,
+    merge_run_trace,
+    mint_trace_id,
+    normalize_trace_id,
+    validate_chrome_trace,
+)
+from spark_examples_tpu.utils import faults
+
+TINY_FLAGS = ["--num-samples", "8", "--references", "1:0:50000"]
+
+
+# ------------------------------------------------------------ trace ids
+
+
+def test_trace_id_mint_and_normalize():
+    tid = mint_trace_id()
+    assert normalize_trace_id(tid) == tid
+    assert normalize_trace_id(tid.upper()) == tid
+    assert normalize_trace_id("  " + tid + "  ") == tid
+    # Malformed ids are rejected, never raised on — the caller mints.
+    for bad in (None, 42, "", "short", "g" * 32, "a b c d e f a b"):
+        assert normalize_trace_id(bad) is None
+    assert mint_trace_id() != mint_trace_id()
+
+
+# ------------------------------------------------------------- recorder
+
+
+def test_recorder_round_trip(tmp_path):
+    rec = FlightRecorder(str(tmp_path), "a", clock=lambda: 10.0)
+    rec.record("accepted", job="job-1", trace="ab" * 16, job_class="small")
+    rec.begin("job", job="job-1", tid="small-0")
+    rec.end("job", job="job-1", tid="small-0", status="done")
+    assert rec.flush() == 3
+    events = read_segments(str(tmp_path))
+    assert [e["name"] for e in events] == ["accepted", "job", "job"]
+    assert [e["ph"] for e in events] == ["i", "B", "E"]
+    assert events[0]["args"] == {"job_class": "small"}
+    assert events[0]["trace"] == "ab" * 16
+    assert events[1]["tid"] == "small-0"
+    assert events[0]["replica"] == "a"
+    rec.close()
+
+
+def test_recorder_ring_bound_drops_oldest(tmp_path):
+    rec = FlightRecorder(str(tmp_path), "a", capacity=3)
+    for i in range(7):
+        rec.record(f"e{i}")
+    assert rec.flush() == 4  # 3 survivors + the ring-overflow marker
+    events = read_segments(str(tmp_path))
+    assert events[0]["name"] == "ring-overflow"
+    assert events[0]["args"]["dropped"] == 4
+    assert [e["name"] for e in events[1:]] == ["e4", "e5", "e6"]
+    rec.close()
+
+
+def test_recorder_torn_tail_skipped(tmp_path):
+    rec = FlightRecorder(str(tmp_path), "a")
+    rec.record("whole")
+    rec.flush()
+    rec.close()
+    with open(rec.path, "a", encoding="utf-8") as f:
+        f.write('{"ts": 1.0, "name": "torn", "ph": "i", "repl')
+    events = read_segments(str(tmp_path))
+    assert [e["name"] for e in events] == ["whole"]
+
+
+def test_recorder_closed_ignores_and_bad_phase_raises(tmp_path):
+    rec = FlightRecorder(str(tmp_path), "a")
+    with pytest.raises(ValueError):
+        rec.record("x", ph="Q")
+    rec.close()
+    rec.record("late")
+    assert rec.flush() == 0
+    assert read_segments(str(tmp_path)) == []
+
+
+def test_recorder_two_incarnations_do_not_collide(tmp_path):
+    """Same replica name, distinct segment files per pid-suffixed path
+    (here: two recorder instances — their events both survive)."""
+    a1 = FlightRecorder(str(tmp_path), "a")
+    a1.record("first-life")
+    a1.flush()
+    a1.close()
+    a2 = FlightRecorder(str(tmp_path), "a")
+    assert a2.path == a1.path  # same pid in tests — appends, still whole
+    a2.record("second-life")
+    a2.flush()
+    a2.close()
+    names = [e["name"] for e in read_segments(str(tmp_path))]
+    assert names == ["first-life", "second-life"]
+
+
+def test_fault_kill_point_flushes_recorder(tmp_path):
+    """The crash-durability contract: a registered flush hook runs BEFORE
+    an injected fault fires, so the ring reaches disk ahead of the kill
+    the chaos harness is about to assert recovery from."""
+    rec = FlightRecorder(str(tmp_path), "a")
+    faults.add_flush_hook(rec.flush)
+    try:
+        faults.configure("raise@serve.worker.mid-job")
+        rec.record("about-to-die", job="job-1")
+        with pytest.raises(faults.InjectedFault):
+            faults.kill_point("serve.worker.mid-job")
+        # NOT via rec.flush() here: the hook must already have drained it.
+        events = read_segments(str(tmp_path))
+        assert [e["name"] for e in events] == ["about-to-die"]
+    finally:
+        faults.remove_flush_hook(rec.flush)
+        faults.configure(None)
+        rec.close()
+
+
+def test_fault_flush_hook_errors_are_swallowed():
+    def bad_hook():
+        raise RuntimeError("telemetry bug")
+
+    faults.add_flush_hook(bad_hook)
+    try:
+        faults.configure("raise@serve.worker.claim")
+        with pytest.raises(faults.InjectedFault):
+            faults.kill_point("serve.worker.claim")
+    finally:
+        faults.remove_flush_hook(bad_hook)
+        faults.configure(None)
+
+
+# ------------------------------------------------------- merge + validate
+
+
+def _write_segment(tmp_path, replica, events):
+    directory = trace_dir(str(tmp_path))
+    os.makedirs(directory, exist_ok=True)
+    with open(
+        os.path.join(directory, f"{replica}.1.jsonl"), "w", encoding="utf-8"
+    ) as f:
+        for event in events:
+            base = {"replica": replica, "pid": 1, "tid": "control"}
+            base.update(event)
+            f.write(json.dumps(base) + "\n")
+
+
+def _write_journal(tmp_path, records):
+    from spark_examples_tpu.serve.journal import journal_path
+
+    with open(journal_path(str(tmp_path)), "w", encoding="utf-8") as f:
+        for record in records:
+            f.write(json.dumps(record) + "\n")
+
+
+def test_merge_two_replica_steal_trace(tmp_path):
+    """The acceptance shape, in miniature: owner `a` accepts + begins a
+    job and dies (its `job` span never ends); stealer `b` steals and
+    settles it. The merged trace holds the complete story: a truncated
+    span on a, the steal flow arrow a→b, b's terminal — and validates
+    with zero orphan spans and zero orphan arrows."""
+    trace = mint_trace_id()
+    job = "job-a-000001"
+    _write_segment(
+        tmp_path,
+        "a",
+        [
+            {"ts": 1.0, "name": "accepted", "ph": "i", "trace": trace,
+             "job": job},
+            {"ts": 1.1, "name": "job", "ph": "B", "trace": trace,
+             "job": job, "tid": "all-0", "args": {"epoch": 1}},
+            {"ts": 1.2, "name": "device-began", "ph": "i", "trace": trace,
+             "job": job, "tid": "all-0", "args": {"epoch": 1}},
+            # ... kill -9: no E ever lands on a.
+        ],
+    )
+    _write_segment(
+        tmp_path,
+        "b",
+        [
+            {"ts": 3.0, "name": "steal", "ph": "i", "trace": trace,
+             "job": job, "args": {"from": "a", "epoch": 2}},
+            {"ts": 3.1, "name": "adopt", "ph": "i", "trace": trace,
+             "job": job, "args": {"stolen": True, "device_began": True}},
+            {"ts": 3.2, "name": "terminal", "ph": "i", "trace": trace,
+             "job": job, "args": {"status": "failed"}},
+        ],
+    )
+    _write_journal(
+        tmp_path,
+        [
+            {"event": "accepted", "id": job, "request": {}, "job_class":
+             "large", "submitted_unix": 1.0, "trace": trace,
+             "replica": "a"},
+            {"event": "lease", "id": job, "epoch": 1, "replica": "a"},
+            {"event": "began", "id": job, "replica": "a", "epoch": 1},
+            {"event": "lease", "id": job, "epoch": 2, "replica": "b",
+             "stolen": True},
+            {"event": "terminal", "id": job, "status": "failed",
+             "replica": "b", "epoch": 2},
+        ],
+    )
+    doc = merge_run_trace(str(tmp_path))
+    assert validate_chrome_trace(doc) == []
+    events = doc["traceEvents"]
+    pids = {
+        e["args"]["name"]: e["pid"]
+        for e in events
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert set(pids) == {"replica a", "replica b"}
+    # The owner's killed span closed as truncated, on its own process.
+    spans = [e for e in events if e["ph"] == "X"]
+    assert len(spans) == 1
+    assert spans[0]["name"] == "job"
+    assert spans[0]["pid"] == pids["replica a"]
+    assert spans[0]["args"]["truncated"] is True
+    assert spans[0]["args"]["epoch"] == 1
+    # The steal edge: one whole flow arrow from a's lane to b's.
+    s = [e for e in events if e["ph"] == "s"]
+    f = [e for e in events if e["ph"] == "f"]
+    assert len(s) == 1 and len(f) == 1
+    assert s[0]["id"] == f[0]["id"]
+    assert s[0]["pid"] == pids["replica a"]
+    assert f[0]["pid"] == pids["replica b"]
+    # Journal summary: fenced final state + both epochs.
+    facts = doc["otherData"]["jobs"][job]
+    assert facts["status"] == "failed"
+    assert facts["stolen"] is True
+    assert facts["lease_epoch"] == 2
+    assert facts["trace"] == trace
+    assert doc["otherData"]["steal_arrows"] == 1
+    assert doc["otherData"]["truncated_spans"] == 1
+
+
+def test_merge_fences_zombie_terminal(tmp_path):
+    """The journal summary applies the same epoch fencing as the replay
+    fold: a deposed owner's late terminal does not become the merged
+    trace's final state."""
+    job = "job-a-000001"
+    _write_segment(
+        tmp_path, "a", [{"ts": 1.0, "name": "accepted", "ph": "i",
+                         "job": job}]
+    )
+    _write_journal(
+        tmp_path,
+        [
+            {"event": "accepted", "id": job, "request": {},
+             "job_class": "small", "submitted_unix": 1.0, "replica": "a"},
+            {"event": "lease", "id": job, "epoch": 2, "replica": "b"},
+            # Zombie a's fenced terminal (epoch 1) vs b's valid one.
+            {"event": "terminal", "id": job, "status": "done",
+             "replica": "a", "epoch": 1},
+            {"event": "terminal", "id": job, "status": "failed",
+             "replica": "b", "epoch": 2},
+        ],
+    )
+    doc = merge_run_trace(str(tmp_path))
+    assert doc["otherData"]["jobs"][job]["status"] == "failed"
+
+
+def test_merge_pairs_requeued_job_spans(tmp_path):
+    """A requeued job (crash before device work) runs twice on one
+    replica: two B/E pairs become two complete X spans."""
+    job = "job-000001"
+    _write_segment(
+        tmp_path,
+        "solo",
+        [
+            {"ts": 1.0, "name": "job", "ph": "B", "job": job},
+            {"ts": 1.5, "name": "job", "ph": "E", "job": job,
+             "args": {"status": "worker-crashed"}},
+            {"ts": 2.0, "name": "job", "ph": "B", "job": job},
+            {"ts": 3.0, "name": "job", "ph": "E", "job": job,
+             "args": {"status": "done"}},
+        ],
+    )
+    doc = merge_run_trace(str(tmp_path))
+    assert validate_chrome_trace(doc) == []
+    spans = sorted(
+        (e for e in doc["traceEvents"] if e["ph"] == "X"),
+        key=lambda e: e["ts"],
+    )
+    assert len(spans) == 2
+    assert spans[0]["args"]["status"] == "worker-crashed"
+    assert spans[1]["args"]["status"] == "done"
+    assert spans[0]["dur"] == 500_000 and spans[1]["dur"] == 1_000_000
+
+
+def test_merge_unmatched_end_becomes_instant(tmp_path):
+    _write_segment(
+        tmp_path,
+        "solo",
+        [{"ts": 1.0, "name": "job", "ph": "E", "job": "job-1"}],
+    )
+    doc = merge_run_trace(str(tmp_path))
+    assert validate_chrome_trace(doc) == []
+    instants = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert len(instants) == 1
+    assert instants[0]["args"]["unmatched_end"] is True
+
+
+def test_merge_empty_run_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        merge_run_trace(str(tmp_path))
+
+
+def test_validator_catches_malformed_traces():
+    assert validate_chrome_trace([]) != []
+    assert validate_chrome_trace({"traceEvents": "nope"}) != []
+    ok = {
+        "traceEvents": [
+            {"ph": "B", "name": "s", "pid": 1, "tid": 1, "ts": 0},
+            {"ph": "E", "name": "s", "pid": 1, "tid": 1, "ts": 5},
+        ]
+    }
+    assert validate_chrome_trace(ok) == []
+    orphan_b = {
+        "traceEvents": [{"ph": "B", "name": "s", "pid": 1, "tid": 1, "ts": 0}]
+    }
+    assert any("orphan span" in e for e in validate_chrome_trace(orphan_b))
+    orphan_e = {
+        "traceEvents": [{"ph": "E", "name": "s", "pid": 1, "tid": 1, "ts": 0}]
+    }
+    assert any("orphan end" in e for e in validate_chrome_trace(orphan_e))
+    crossed = {
+        "traceEvents": [
+            {"ph": "B", "name": "outer", "pid": 1, "tid": 1, "ts": 0},
+            {"ph": "B", "name": "inner", "pid": 1, "tid": 1, "ts": 1},
+            {"ph": "E", "name": "outer", "pid": 1, "tid": 1, "ts": 2},
+            {"ph": "E", "name": "inner", "pid": 1, "tid": 1, "ts": 3},
+        ]
+    }
+    assert any(
+        "mismatched nesting" in e for e in validate_chrome_trace(crossed)
+    )
+    orphan_flow = {
+        "traceEvents": [
+            {"ph": "s", "name": "arrow", "id": 7, "pid": 1, "tid": 1, "ts": 0}
+        ]
+    }
+    assert any(
+        "orphan flow arrow" in e for e in validate_chrome_trace(orphan_flow)
+    )
+    bad_dur = {
+        "traceEvents": [
+            {"ph": "X", "name": "s", "pid": 1, "tid": 1, "ts": 0, "dur": -1}
+        ]
+    }
+    assert any("bad dur" in e for e in validate_chrome_trace(bad_dur))
+    bad_ph = {"traceEvents": [{"ph": "?", "name": "s", "ts": 0}]}
+    assert any("unknown phase" in e for e in validate_chrome_trace(bad_ph))
+
+
+# ------------------------------------------------------------ CLI verb
+
+
+def test_trace_export_cli(tmp_path, capsys):
+    job = "job-000001"
+    _write_segment(
+        tmp_path,
+        "solo",
+        [
+            {"ts": 1.0, "name": "job", "ph": "B", "job": job},
+            {"ts": 2.0, "name": "job", "ph": "E", "job": job,
+             "args": {"status": "done"}},
+        ],
+    )
+    out = tmp_path / "merged.json"
+    rc = export_main(
+        ["export", "--run-dir", str(tmp_path), "--out", str(out)]
+    )
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert validate_chrome_trace(doc) == []
+    assert any(e["ph"] == "X" for e in doc["traceEvents"])
+    # Default output path lands under <run-dir>/trace/.
+    assert export_main(["export", "--run-dir", str(tmp_path)]) == 0
+    assert os.path.exists(
+        os.path.join(trace_dir(str(tmp_path)), "merged.trace.json")
+    )
+
+
+def test_trace_export_cli_exit_codes(tmp_path):
+    assert export_main([]) == 2  # no subcommand
+    assert export_main(["frobnicate"]) == 2  # unknown subcommand
+    missing = str(tmp_path / "nope")
+    assert export_main(["export", "--run-dir", missing]) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert export_main(["export", "--run-dir", str(empty)]) == 1
+
+
+def test_trace_cli_verb_registered():
+    from spark_examples_tpu.cli import COMMANDS, main
+
+    assert "trace" in COMMANDS
+    assert main(["trace"]) == 2
+
+
+# ---------------------------------------------------------- conformance
+
+
+def test_record_and_read_conformance_block():
+    registry = MetricsRegistry()
+    assert conformance_block(registry) is None
+    record_prover_conformance(registry, "hostmem", 100, 200)
+    record_prover_conformance(registry, "sched", 64, 64)
+    record_prover_conformance(registry, "ranges", 8, None)
+    block = conformance_block(registry)
+    assert block == {
+        "hostmem": {"measured": 100, "proven": 200, "ok": True},
+        "sched": {"measured": 64, "proven": 64, "ok": True},
+        "ranges": {"measured": 8, "proven": None, "ok": None},
+    }
+    # A regression reads as ok=False, never as a silent clamp.
+    record_prover_conformance(registry, "hostmem", 300, 200)
+    assert conformance_block(registry)["hostmem"]["ok"] is False
+    with pytest.raises(Exception):
+        record_prover_conformance(registry, "mystery", 1, 2)
+
+
+def test_conformance_gauges_export_on_prometheus_text():
+    registry = MetricsRegistry()
+    record_prover_conformance(registry, "hostmem", 100, 200)
+    text = registry.prometheus_text()
+    assert (
+        f'{PROVER_CONFORMANCE_MEASURED}{{prover="hostmem"}} 100' in text
+    )
+    assert f'{PROVER_CONFORMANCE_PROVEN}{{prover="hostmem"}} 200' in text
+
+
+def test_manifest_validator_conformance_block():
+    from spark_examples_tpu.obs.manifest import (
+        build_manifest,
+        validate_manifest,
+    )
+
+    doc = build_manifest(
+        conformance={
+            "hostmem": {"measured": 1, "proven": 2, "ok": True},
+            "ranges": None,
+        }
+    )
+    assert validate_manifest(doc) == []
+    assert validate_manifest(build_manifest(conformance=None)) == []
+    bad = build_manifest(conformance={"mystery": {"measured": 1}})
+    assert any("unknown prover" in e for e in validate_manifest(bad))
+    bad = build_manifest(conformance={"hostmem": {"proven": 2}})
+    assert any(
+        "hostmem.measured" in e for e in validate_manifest(bad)
+    )
+    bad = build_manifest(
+        conformance={"hostmem": {"measured": -1, "proven": None, "ok": None}}
+    )
+    assert any("hostmem.measured" in e for e in validate_manifest(bad))
+    bad = build_manifest(
+        conformance={"hostmem": {"measured": 1, "proven": 2, "ok": "yes"}}
+    )
+    assert any("hostmem.ok" in e for e in validate_manifest(bad))
+
+
+def test_run_pipeline_registers_hostmem_conformance(tmp_path):
+    """Driver e2e: a bounded synthetic run's manifest carries the hostmem
+    conformance pair with measured <= proven (the CI tripwire's shape)."""
+    from spark_examples_tpu.config import PcaConf
+    from spark_examples_tpu.pipeline.pca_driver import run_pipeline
+
+    manifest_path = str(tmp_path / "manifest.json")
+    conf = PcaConf.parse(TINY_FLAGS + ["--metrics-json", manifest_path])
+    result = run_pipeline(conf)
+    doc = result.manifest
+    block = doc.get("conformance")
+    assert block is not None
+    hostmem = block["hostmem"]
+    assert hostmem is not None
+    assert hostmem["proven"] is not None
+    assert 0 < hostmem["measured"] <= hostmem["proven"]
+    assert hostmem["ok"] is True
+    from spark_examples_tpu.obs.manifest import validate_manifest
+
+    assert validate_manifest(doc) == []
+
+
+@pytest.mark.slow
+def test_run_pipeline_check_ranges_conformance(tmp_path):
+    """--check-ranges adds the ranges pair next to hostmem's."""
+    from spark_examples_tpu.config import PcaConf
+    from spark_examples_tpu.pipeline.pca_driver import run_pipeline
+
+    conf = PcaConf.parse(
+        TINY_FLAGS
+        + [
+            "--ingest", "packed", "--check-ranges",
+            "--metrics-json", str(tmp_path / "m.json"),
+        ]
+    )
+    block = run_pipeline(conf).manifest["conformance"]
+    assert block["ranges"] is not None
+    assert block["ranges"]["ok"] is True
+    assert block["hostmem"]["ok"] is True
+
+
+# -------------------------------------------------- serve-side propagation
+
+
+class _InstantExecutor:
+    def __init__(self, conformance=None):
+        self.conformance = conformance
+
+    def __call__(self, job, run_dir):
+        from spark_examples_tpu.serve.executor import ExecutionOutcome
+
+        return ExecutionOutcome(
+            result={"ok": True},
+            manifest_path=None,
+            compile_cache="cold",
+            conformance=self.conformance,
+        )
+
+
+def test_serve_trace_propagation_and_recorder(tmp_path):
+    """One in-process service: a client-sent trace id is echoed on the
+    job envelope, journaled on the accepted record, stamped on every
+    recorder event, and the drained run dir exports a valid Chrome trace
+    holding the job's complete span."""
+    from spark_examples_tpu.serve.daemon import PcaService
+    from spark_examples_tpu.serve.journal import (
+        iter_journal_records,
+        journal_path,
+    )
+    from spark_examples_tpu.serve.protocol import request_doc
+
+    run_dir = str(tmp_path / "serve")
+    service = PcaService(run_dir=run_dir, executor=_InstantExecutor()).start()
+    try:
+        trace = mint_trace_id()
+        status, doc = service.submit(request_doc(TINY_FLAGS), trace_id=trace)
+        assert status == 202
+        assert doc["job"]["trace"] == trace
+        job_id = doc["job"]["id"]
+        deadline = time.monotonic() + 10
+        while service.job_status(job_id)[1]["job"]["status"] != "done":
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        # A malformed client header gets a minted replacement, not a 4xx.
+        status, doc2 = service.submit(
+            request_doc(TINY_FLAGS), trace_id="NOT HEX!"
+        )
+        assert status == 202
+        assert normalize_trace_id(doc2["job"]["trace"]) is not None
+        assert doc2["job"]["trace"] != trace
+    finally:
+        assert service.stop(timeout=30)
+    accepted = [
+        r
+        for r in iter_journal_records(journal_path(run_dir))
+        if r.get("event") == "accepted" and r.get("id") == job_id
+    ]
+    # Compaction may have dropped the settled record; the recorder is
+    # the durable timeline either way.
+    for record in accepted:
+        assert record["trace"] == trace
+    events = read_segments(run_dir)
+    job_events = [e for e in events if e.get("job") == job_id]
+    assert {"accepted", "job", "terminal"} <= {e["name"] for e in job_events}
+    assert all(e.get("trace") == trace for e in job_events)
+    doc = merge_run_trace(run_dir)
+    assert validate_chrome_trace(doc) == []
+    spans = [
+        e
+        for e in doc["traceEvents"]
+        if e["ph"] == "X" and e["args"].get("job") == job_id
+    ]
+    assert len(spans) == 1
+    assert spans[0]["args"]["status"] == "done"
+    assert spans[0]["args"]["trace"] == trace
+    assert not spans[0]["args"].get("truncated")
+
+
+def test_serve_mirrors_job_conformance_into_metrics(tmp_path):
+    from spark_examples_tpu.serve.daemon import PcaService
+    from spark_examples_tpu.serve.protocol import request_doc
+
+    block = {
+        "hostmem": {"measured": 123, "proven": 456, "ok": True},
+        "sched": None,
+        "ranges": None,
+    }
+    service = PcaService(
+        run_dir=str(tmp_path / "serve"),
+        executor=_InstantExecutor(conformance=block),
+    ).start()
+    try:
+        status, doc = service.submit(request_doc(TINY_FLAGS))
+        assert status == 202
+        job_id = doc["job"]["id"]
+        deadline = time.monotonic() + 10
+        while service.job_status(job_id)[1]["job"]["status"] != "done":
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        text = service.metrics_text()
+        assert f'{PROVER_CONFORMANCE_MEASURED}{{prover="hostmem"}} 123' in text
+        assert f'{PROVER_CONFORMANCE_PROVEN}{{prover="hostmem"}} 456' in text
+    finally:
+        assert service.stop(timeout=30)
+
+
+def test_client_sends_trace_header(tmp_path):
+    """HTTP e2e: ServeClient mints the X-Trace-Id header; the server
+    echoes it through the job envelope."""
+    from spark_examples_tpu.serve.daemon import PcaService
+    from spark_examples_tpu.serve.client import ServeClient
+    from spark_examples_tpu.serve.http import start_server
+
+    service = PcaService(
+        run_dir=str(tmp_path / "serve"), executor=_InstantExecutor()
+    ).start()
+    server = start_server(service, port=0)
+    try:
+        client = ServeClient(server.url)
+        doc = client.submit(TINY_FLAGS, trace_id="f" * 32)
+        assert doc["job"]["trace"] == "f" * 32
+        minted = client.submit(TINY_FLAGS)
+        assert normalize_trace_id(minted["job"]["trace"]) is not None
+    finally:
+        server.shutdown()
+        service.stop(timeout=30)
+
+
+def test_replayed_job_keeps_journaled_trace(tmp_path):
+    """Restart e2e: a job accepted by one service life is replayed by the
+    next with the SAME trace id — one job, one span tree across lives."""
+    from spark_examples_tpu.serve.daemon import PcaService
+    from spark_examples_tpu.serve.protocol import request_doc
+
+    run_dir = str(tmp_path / "serve")
+
+    class _Gate:
+        def __init__(self):
+            self.release = threading.Event()
+
+        def __call__(self, job, run_dir):
+            from spark_examples_tpu.serve.executor import ExecutionOutcome
+
+            assert self.release.wait(timeout=30)
+            return ExecutionOutcome(
+                result={"ok": True}, manifest_path=None, compile_cache="cold"
+            )
+
+    gate = _Gate()
+    first = PcaService(run_dir=run_dir, executor=gate).start()
+    status, doc = first.submit(request_doc(TINY_FLAGS), trace_id="ab" * 16)
+    assert status == 202
+    job_id = doc["job"]["id"]
+    # Abandon the first life without draining (the restart story); the
+    # worker is parked on the gate so the job never began device work.
+    second = PcaService(run_dir=run_dir, executor=_InstantExecutor()).start()
+    try:
+        status, doc = second.job_status(job_id)
+        assert status == 200
+        assert doc["job"]["trace"] == "ab" * 16
+    finally:
+        gate.release.set()
+        second.stop(timeout=30)
+
+
+# ------------------------------------------------- review-hardening fixes
+
+
+def test_conformance_rerecord_clears_stale_proven():
+    """Last-write-wins mirroring: a later unprovable pair must not keep
+    the earlier job's proven bound (which would fabricate verdicts from
+    two different jobs)."""
+    registry = MetricsRegistry()
+    record_prover_conformance(registry, "hostmem", 100, 200)
+    record_prover_conformance(registry, "hostmem", 700, None)
+    block = conformance_block(registry)
+    assert block["hostmem"] == {"measured": 700, "proven": None, "ok": None}
+
+
+def test_conformance_verdict_compares_raw_floats():
+    """The ok verdict is computed on the raw floats — rounding for the
+    manifest's int contract must never turn a violated bound into a
+    pass."""
+    registry = MetricsRegistry()
+    record_prover_conformance(registry, "ranges", 1000.4, 1000.2)
+    block = conformance_block(registry)
+    # The displayed ints round in the verdict's direction, so the int
+    # pair re-derives the same verdict (the serve mirror re-records the
+    # ints — a violated bound must stay violated on /metrics too).
+    assert block["ranges"]["ok"] is False
+    assert block["ranges"]["measured"] == 1001
+    assert block["ranges"]["proven"] == 1000
+    assert block["ranges"]["measured"] > block["ranges"]["proven"]
+    record_prover_conformance(registry, "ranges", 0.4, 0.5)
+    block = conformance_block(registry)
+    assert block["ranges"]["ok"] is True
+    assert block["ranges"]["measured"] <= block["ranges"]["proven"]
+    # Mirror round trip: re-recording the displayed ints preserves the
+    # verdict in both directions.
+    for measured, proven, verdict in ((1000.4, 1000.2, False), (3.0, 7.0, True)):
+        record_prover_conformance(registry, "sched", measured, proven)
+        pair = conformance_block(registry)["sched"]
+        mirror = MetricsRegistry()
+        record_prover_conformance(
+            mirror, "sched", pair["measured"], pair["proven"]
+        )
+        assert conformance_block(mirror)["sched"]["ok"] is verdict
+
+
+def test_recorder_failed_flush_retains_events(tmp_path):
+    """A flush that cannot reach the disk must restore the drained ring
+    (and drop accounting) for the next attempt, never discard it."""
+    # A FILE named `trace` makes the segment directory uncreatable.
+    blocker = tmp_path / "trace"
+    blocker.write_text("in the way")
+    rec = FlightRecorder(str(tmp_path), "a", capacity=2)
+    rec.record("one")
+    rec.record("two")
+    rec.record("three")  # overflows: "one" dropped
+    assert rec.flush() == 0
+    assert rec.dropped == 1  # the drop count survived the failure
+    blocker.unlink()
+    assert rec.flush() == 3  # overflow marker + the two retained events
+    events = read_segments(str(tmp_path))
+    assert [e["name"] for e in events] == ["ring-overflow", "two", "three"]
+    assert events[0]["args"]["dropped"] == 1
+    rec.close()
+
+
+def test_steal_arrow_anchors_at_or_before_the_steal(tmp_path):
+    """A deposed-but-alive zombie owner keeps recording after the steal;
+    the arrow must anchor on its last event AT OR BEFORE the steal, not
+    be dropped because the owner's globally-last event postdates it."""
+    job = "job-a-000001"
+    _write_segment(
+        tmp_path,
+        "a",
+        [
+            {"ts": 1.0, "name": "job", "ph": "B", "job": job},
+            # The zombie wakes AFTER b's steal and abandons.
+            {"ts": 5.0, "name": "job", "ph": "E", "job": job,
+             "args": {"status": "failed", "abandoned": "lease-lost"}},
+            {"ts": 5.1, "name": "abandoned", "ph": "i", "job": job},
+        ],
+    )
+    _write_segment(
+        tmp_path,
+        "b",
+        [
+            {"ts": 3.0, "name": "steal", "ph": "i", "job": job,
+             "args": {"from": "a", "epoch": 2}},
+            {"ts": 3.5, "name": "terminal", "ph": "i", "job": job,
+             "args": {"status": "failed"}},
+        ],
+    )
+    doc = merge_run_trace(str(tmp_path))
+    assert validate_chrome_trace(doc) == []
+    s = [e for e in doc["traceEvents"] if e["ph"] == "s"]
+    f = [e for e in doc["traceEvents"] if e["ph"] == "f"]
+    assert len(s) == 1 and len(f) == 1
+    # Anchored at the owner's B (ts 1.0) — at-or-before the steal's 3.0.
+    assert s[0]["ts"] <= f[0]["ts"]
+
+
+def test_read_segments_skips_foreign_jsonl(tmp_path):
+    """A foreign JSONL dropped into trace/ (valid JSON, not our event
+    schema) is skipped like a torn tail — the export must not crash."""
+    _write_segment(
+        tmp_path,
+        "solo",
+        [{"ts": 1.0, "name": "job", "ph": "B", "job": "job-1"},
+         {"ts": 2.0, "name": "job", "ph": "E", "job": "job-1"}],
+    )
+    with open(
+        os.path.join(trace_dir(str(tmp_path)), "foreign.jsonl"),
+        "w",
+        encoding="utf-8",
+    ) as f:
+        f.write('{"ts": 1.5, "name": "alien", "ph": "i"}\n')  # no replica
+        f.write('{"totally": "unrelated"}\n')
+    events = read_segments(str(tmp_path))
+    assert [e["name"] for e in events] == ["job", "job"]
+    doc = merge_run_trace(str(tmp_path))
+    assert validate_chrome_trace(doc) == []
