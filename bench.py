@@ -287,6 +287,70 @@ def _run_serve_load_config(device) -> dict:
     }
 
 
+# --------------------------------------------------------- multihost bench
+# Pod ingest scaling: a REAL 2-process gloo fleet (parallel/multihost.py)
+# running the unmodified variants-pca CLI with HOST-SHARDED ingest — each
+# process reads only its contig partition. The headline number is the
+# largest per-host share of the solo run's ingested reference bases: ~1/H
+# means ingest bandwidth scales linearly with hosts (the PR's claim), 1.0
+# would mean every host still reads everything. Correctness rides along:
+# the report is only accepted when the fleet's PC rows are byte-identical
+# to the solo oracle and every per-host conformance bound holds.
+
+MULTIHOST_PROCESSES = 2
+MULTIHOST_LOCAL_DEVICES = 2
+
+
+def _run_multihost_config(device) -> dict:
+    from spark_examples_tpu.parallel.multihost import verify_multihost
+
+    report = verify_multihost(
+        num_processes=MULTIHOST_PROCESSES,
+        local_devices=MULTIHOST_LOCAL_DEVICES,
+    )
+    if not report.get("ok"):
+        raise RuntimeError(
+            "multihost fleet rehearsal failed: "
+            + json.dumps({k: v for k, v in report.items() if k != "children"})
+        )
+    bases = report["fleet_io_reference_bases"]
+    solo_bases = int(bases["solo"])
+    per_process = [int(b) for b in bases["per_process"]]
+    fractions = [round(b / solo_bases, 4) for b in per_process]
+    max_fraction = max(fractions)
+    return {
+        "metric": (
+            f"host-sharded pod ingest: largest per-host share of solo "
+            f"ingest bytes ({MULTIHOST_PROCESSES}-process gloo fleet, "
+            "PC rows byte-identical to the solo oracle)"
+        ),
+        "value": max_fraction,
+        "unit": "fraction of solo ingest per host",
+        # Baseline: the pre-host-sharding data path, where every host read
+        # the whole input (fraction 1.0 per host).
+        "vs_baseline": round(1.0 / max_fraction, 2) if max_fraction else None,
+        "details": {
+            "num_processes": MULTIHOST_PROCESSES,
+            "local_devices_per_process": MULTIHOST_LOCAL_DEVICES,
+            "solo_reference_bases": solo_bases,
+            "per_process_reference_bases": per_process,
+            "per_process_fraction_of_solo": fractions,
+            "partition_sum_exact": sum(per_process) == solo_bases,
+            "wall_seconds": report.get("fleet_wall_seconds"),
+            "cli_outputs_identical": report["cli_outputs_identical"],
+            "cli_pc_lines": report["cli_pc_lines"],
+            "hier_gramian_ok": report["hier_gramian_ok"],
+            "fleet_conformance_ok": report["fleet_conformance_ok"],
+            "fleet_trace_ok": report["fleet_trace_ok"],
+            "device": str(device),
+            "baseline": (
+                "every host reading the whole input (per-host fraction 1.0; "
+                "the pre-pod-ingest data path)"
+            ),
+        },
+    }
+
+
 def _write_bench_phenotypes(path: str, conf) -> None:
     """A balanced case/control TSV over the synthetic cohort's real
     callset names (the assoc verb's strict both-ways coverage check)."""
@@ -788,12 +852,13 @@ def main() -> None:
     parser.add_argument(
         "--config",
         choices=sorted(CONFIGS)
-        + ["ingest", "serve-load"]
+        + ["ingest", "serve-load", "multihost"]
         + sorted(ANALYSIS_CONFIGS),
         default=None,
         help=(
-            "Run ONE benchmark config (PCA device configs, 'ingest', or an "
-            "analyses/ config: grm, ld-prune, assoc-scan). Default: run ALL "
+            "Run ONE benchmark config (PCA device configs, 'ingest', "
+            "'serve-load', 'multihost', or an analyses/ config: grm, "
+            "ld-prune, assoc-scan). Default: run ALL "
             "configs and print the whole-genome headline with every "
             "config's result embedded in details.configs — each README "
             "number gets a driver-verified artifact."
@@ -815,6 +880,8 @@ def main() -> None:
                 payload = _run_ingest_config(device)
             elif args.config == "serve-load":
                 payload = _run_serve_load_config(device)
+            elif args.config == "multihost":
+                payload = _run_multihost_config(device)
             elif args.config in ANALYSIS_CONFIGS:
                 payload = _run_analysis_config(args.config, device)
             else:

@@ -27,6 +27,15 @@
 #      via SPARK_EXAMPLES_TPU_HIER_HOSTS) must produce byte-identical
 #      result rows, valid manifest schedule blocks with predicted ==
 #      measured ring bytes, and hier DCN bytes strictly below flat's.
+#   2c3. multihost — a REAL 2-process x 2-virtual-device gloo fleet
+#      (parallel/multihost.py): coordinator-connected child checks (global
+#      mesh, cross-process ring, hierarchical ring) all byte-identical to
+#      the host oracle, then the full variants-pca CLI as a fleet with
+#      HOST-SHARDED ingest — per-process ingested reference bases ~1/H of
+#      the solo oracle's (summing exactly to it), PC rows byte-identical
+#      to solo, per-host conformance bounds ok in every process manifest,
+#      and the per-process flight-recorder segments merged into one
+#      validate_chrome_trace-clean Chrome trace.
 #   2d. hostmem — graftcheck hostmem (AST host-memory audit: the tree must
 #      be clean, every O(file) site a justified hostmem(unbounded)
 #      declaration) + the --host-mem-budget smoke on the 4-virtual-device
@@ -208,6 +217,46 @@ else
   echo "sched smoke failed (rc=$sched_rc):"; tail -20 "$SCHED_TMP"/*.err
 fi
 rm -rf "$SCHED_TMP"
+
+echo "== multihost stage (2-process gloo fleet: host-sharded ingest parity) =="
+mh_rc=0
+MH_TMP=$(mktemp -d)
+env JAX_PLATFORMS=cpu python -m spark_examples_tpu.parallel.multihost \
+    --num-processes 2 --local-devices 2 --artifact "$MH_TMP/report.json" \
+    > "$MH_TMP/report.out" 2> "$MH_TMP/report.err" || mh_rc=$?
+if [ "$mh_rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python - "$MH_TMP/report.json" <<'PYEOF' || mh_rc=$?
+import json, sys
+doc = json.load(open(sys.argv[1]))
+checks = ("gramian_ok", "ring_gramian_ok", "hier_gramian_ok",
+          "result_spans_processes", "cli_ok", "cli_outputs_identical",
+          "fleet_host_sharded", "fleet_io_ok", "fleet_conformance_ok",
+          "fleet_trace_ok", "ok")
+bad = [k for k in checks if doc.get(k) is not True]
+if bad:
+    print(f"multihost report failed checks: {bad}")
+    print(json.dumps({k: doc.get(k) for k in checks}))
+    sys.exit(1)
+bases = doc["fleet_io_reference_bases"]
+solo, per = bases["solo"], bases["per_process"]
+H = doc["num_processes"]
+# ~1/H of solo per process: the fair share plus at most the one contig
+# that closes a partition (the split rule's documented overshoot), and
+# the partition property exact — local reads sum to the solo total.
+if sum(per) != solo or any(
+        not (0 < b <= solo * (1.0 / H + 0.26)) for b in per):
+    print(f"per-process ingest not ~1/{H} of solo: {per} vs {solo}")
+    sys.exit(1)
+shares = [round(b / solo, 3) for b in per]
+print(f"multihost smoke OK: {H} processes, PC rows byte-identical to the "
+      f"solo oracle, per-host ingest {shares} of solo ({solo} bases), "
+      "hier ring exact, merged fleet trace valid")
+PYEOF
+else
+  echo "multihost fleet run failed (rc=$mh_rc):"
+  tail -20 "$MH_TMP/report.err"; tail -5 "$MH_TMP/report.out"
+fi
+rm -rf "$MH_TMP"
 
 echo "== hostmem stage (graftcheck hostmem + host-memory budget) =="
 hm_rc=0
@@ -1122,6 +1171,7 @@ if [ "$lint_rc" -ne 0 ]; then exit "$lint_rc"; fi
 if [ "$ir_rc" -ne 0 ]; then exit "$ir_rc"; fi
 if [ "$rg_rc" -ne 0 ]; then exit "$rg_rc"; fi
 if [ "$sched_rc" -ne 0 ]; then exit "$sched_rc"; fi
+if [ "$mh_rc" -ne 0 ]; then exit "$mh_rc"; fi
 if [ "$hm_rc" -ne 0 ]; then exit "$hm_rc"; fi
 if [ "$obs_rc" -ne 0 ]; then exit "$obs_rc"; fi
 if [ "$ring_rc" -ne 0 ]; then exit "$ring_rc"; fi

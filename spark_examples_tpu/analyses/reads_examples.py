@@ -29,6 +29,7 @@ from spark_examples_tpu.ops.depth import (
     encode_bases,
 )
 from spark_examples_tpu.pipeline.datasets import ReadsDataset
+from spark_examples_tpu.pipeline.sitewriter import SiteOutputWriter
 from spark_examples_tpu.sharding.partitioners import (
     FixedSplits,
     ReadsPartitioner,
@@ -133,10 +134,13 @@ def run_example3(
     sequence: str = "21",
     region: Optional[Tuple[int, int]] = None,
     readset: str = Examples.GOOGLE_EXAMPLE_READSET,
-) -> List[str]:
+) -> str:
     """Per-base read depth (``SearchReadsExample.scala:140-167``): dense
-    scatter-add per shard with boundary carry; emits ``(pos,depth)`` lines
-    for covered positions, ascending, saved under ``coverage_<chr>``."""
+    scatter-add per shard with boundary carry; ``(pos,depth)`` lines for
+    covered positions stream, ascending, through the bounded per-site
+    writer into ``coverage_<chr>/part-00000`` (the reference's
+    ``saveAsTextFile`` bytes, headerless) — peak host memory is O(shard
+    window), never O(region). Returns the part-file path."""
     out_path = conf.output_path or "."
     length = Examples.HUMAN_CHROMOSOMES[sequence]
     if region is None:
@@ -148,51 +152,57 @@ def run_example3(
             {sequence: region}, TargetSizeSplits(100, 5, 1024, 16 * 1024 * 1024)
         ),
     )
-    lines: List[str] = []
+    part_path = os.path.join(out_path, f"coverage_{sequence}", "part-00000")
     carry = np.zeros(0, dtype=np.int64)
     carry_start = None
-    for part, shard in dataset.iter_shards():
-        span = int(part.end - part.start)
-        positions = lengths = None
-        read_pad = 64
-        if shard:
-            positions, lengths = _shard_reads_arrays(shard)
-            read_pad = _pad_read_length(int(lengths.max()))
-        # The window covers the shard span plus the longest read's overhang
-        # (and any carry from the previous shard) — no truncation cap.
-        overhang = carry_start + len(carry) - part.start if carry_start is not None else 0
-        window = max(span + read_pad, int(overhang))
-        # Fresh per-shard window (O(window), reset every iteration — the
-        # carry below is the only state crossing shards).
-        if shard:
-            counts = np.asarray(
-                depth_counts(
-                    jnp.asarray(positions),
-                    jnp.asarray(lengths),
-                    jnp.int32(part.start),
-                    window,
-                    read_pad,
-                ),
-                dtype=np.int64,
+    # Each shard's covered (pos,depth) rows stream straight into the
+    # bounded writer — the whole-region in-memory line list (the last
+    # hostmem(unbounded) surface of analyses/) is retired.
+    with SiteOutputWriter(part_path) as writer:
+        for part, shard in dataset.iter_shards():
+            span = int(part.end - part.start)
+            positions = lengths = None
+            read_pad = 64
+            if shard:
+                positions, lengths = _shard_reads_arrays(shard)
+                read_pad = _pad_read_length(int(lengths.max()))
+            # The window covers the shard span plus the longest read's
+            # overhang (and any carry from the previous shard) — no
+            # truncation cap.
+            overhang = carry_start + len(carry) - part.start if carry_start is not None else 0
+            window = max(span + read_pad, int(overhang))
+            # Fresh per-shard window (O(window), reset every iteration — the
+            # carry below is the only state crossing shards).
+            if shard:
+                counts = np.asarray(
+                    depth_counts(
+                        jnp.asarray(positions),
+                        jnp.asarray(lengths),
+                        jnp.int32(part.start),
+                        window,
+                        read_pad,
+                    ),
+                    dtype=np.int64,
+                )
+            else:
+                counts = np.zeros(window, dtype=np.int64)
+            if carry_start is not None and len(carry):
+                off = carry_start - part.start
+                lo, hi = max(0, off), min(window, off + len(carry))
+                if hi > lo:
+                    counts[lo:hi] += carry[lo - off : hi - off]
+            covered = np.nonzero(counts[:span] > 0)[0]
+            writer.write_rows(
+                (f"({part.start + i},{counts[i]})",) for i in covered
             )
-        else:
-            counts = np.zeros(window, dtype=np.int64)
-        if carry_start is not None and len(carry):
-            off = carry_start - part.start
-            lo, hi = max(0, off), min(window, off + len(carry))
-            if hi > lo:
-                counts[lo:hi] += carry[lo - off : hi - off]
-        covered = np.nonzero(counts[:span] > 0)[0]
-        # graftcheck: hostmem(unbounded) -- the reads examples replicate the reference's saveAsTextFile result surface (whole-region (pos,depth) lines in memory); small-region demos by contract — the per-site streaming writer (pipeline/sitewriter.py) is the analyses/ path for genome-scale outputs
-        lines.extend(f"({part.start + i},{counts[i]})" for i in covered)
-        carry = counts[span:].copy()
-        carry_start = part.end
-    if carry_start is not None:
-        for i in np.nonzero(carry > 0)[0]:
-            # graftcheck: hostmem(unbounded) -- same whole-region result surface as the shard loop above (reference saveAsTextFile shape; small-region demos)
-            lines.append(f"({carry_start + i},{carry[i]})")
-    _write_part_file(os.path.join(out_path, f"coverage_{sequence}"), lines)
-    return lines
+            carry = counts[span:].copy()
+            carry_start = part.end
+        if carry_start is not None:
+            writer.write_rows(
+                (f"({carry_start + i},{carry[i]})",)
+                for i in np.nonzero(carry > 0)[0]
+            )
+    return part_path
 
 
 def _base_frequencies(

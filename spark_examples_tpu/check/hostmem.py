@@ -676,6 +676,7 @@ def conf_host_peak_bytes(
     conf: Any,
     device_count: Optional[int] = None,
     num_samples: Optional[int] = None,
+    num_hosts: int = 1,
 ) -> Optional[int]:
     """``host_peak_bytes`` for one parsed configuration, or ``None`` when
     the configured ingest path is O(file) — no static bound exists for it.
@@ -683,6 +684,9 @@ def conf_host_peak_bytes(
     ``num_samples`` overrides the flag value with the DISCOVERED cohort
     width (file sources carry their cohort in the data; the driver passes
     its resolved matrix size, the static plan validator the declared flag).
+    ``num_hosts > 1`` charges the host-sharded ingest merge term — a
+    PER-HOST bound (the driver passes ``jax.process_count()``; offline
+    validation stays at 1).
 
     Bounded paths (the formula's domain):
 
@@ -761,6 +765,7 @@ def conf_host_peak_bytes(
             if isinstance(conf, LdConf)
             else 0
         ),
+        num_hosts=int(num_hosts),
     )
 
 

@@ -1001,6 +1001,94 @@ def devicegen_ring_spec(
     )
 
 
+def devicegen_hier_spec(
+    data: int,
+    hosts: int,
+    devices_per_host: int,
+    num_samples: int,
+    block_size: int,
+    blocks_per_dispatch: int,
+    pack: bool = True,
+) -> KernelSpec:
+    """The fused generation ring under the hierarchical two-level schedule
+    — ``ops/devicegen.py:_ring_update`` traced over an abstract
+    ``data x hosts x samples`` mesh (the mesh in the memo key selects the
+    schedule, exactly as at runtime). The ring contracts hold UNCHANGED
+    with ``samples_axis = hosts x devices_per_host``: ``(H-1) + H x (D-1)
+    = S - 1`` permutes per pass (GI006) and flat-equal total bytes
+    (GI005), split across link classes by ``check/sched.py``."""
+    from spark_examples_tpu.parallel.mesh import padded_cohort
+
+    samples = hosts * devices_per_host
+    padded = padded_cohort(num_samples, samples, pack=pack)
+    n_local = padded // samples
+
+    def build() -> Tuple[Callable[..., Any], Tuple[Any, ...]]:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import AbstractMesh
+
+        from spark_examples_tpu.ops.devicegen import _ring_update
+        from spark_examples_tpu.parallel.mesh import (
+            DATA_AXIS,
+            HOST_AXIS,
+            SAMPLES_AXIS,
+        )
+
+        mesh = AbstractMesh(
+            (
+                (DATA_AXIS, data),
+                (HOST_AXIS, hosts),
+                (SAMPLES_AXIS, devices_per_host),
+            )
+        )
+        pops = np.zeros(padded, dtype=np.int32)
+        update = _ring_update.__wrapped__(
+            (0x5EED,),
+            pops.tobytes(),
+            0xFACADE,
+            100,
+            0.1,
+            None,
+            block_size,
+            blocks_per_dispatch,
+            "int8",
+            num_samples,
+            padded,
+            1,
+            mesh,
+            None,
+            pack,
+        )
+        G = jax.ShapeDtypeStruct((data, padded, padded), jnp.int32)
+        rows = jax.ShapeDtypeStruct((data, 1), jnp.int64)
+        kept = jax.ShapeDtypeStruct((data,), jnp.int64)
+        offsets = jax.ShapeDtypeStruct((data,), jnp.int64)
+        valids = jax.ShapeDtypeStruct((data,), jnp.int64)
+        return update, (G, rows, kept, offsets, valids)
+
+    return KernelSpec(
+        name=(
+            f"devicegen-hier[data={data},hosts={hosts},"
+            f"devices={devices_per_host},N={num_samples},B={block_size},"
+            f"K={blocks_per_dispatch},pack={'on' if pack else 'off'}]"
+        ),
+        build=build,
+        samples_axis=samples,
+        total_devices=data * samples,
+        packed=pack,
+        ring=True,
+        ring_passes=blocks_per_dispatch,
+        rows_per_call=data * blocks_per_dispatch * block_size,
+        n_local=n_local,
+        acc_invar=0,
+        donation=DonationSite(
+            _devicegen_file(), "_ring_update", "ops/devicegen.py"
+        ),
+        liveness_scope="per-device",
+    )
+
+
 #: The default mesh matrix: enough shapes that an axis-size-dependent
 #: regression (a hardcoded D, a ragged-width assumption) cannot hide.
 DEFAULT_MESHES: Tuple[Tuple[int, int], ...] = ((1, 2), (1, 4), (2, 2))
@@ -1048,6 +1136,11 @@ def default_specs(
                     1, hosts, per_host, num_samples, block_size, pack
                 )
             )
+        specs.append(
+            devicegen_hier_spec(
+                1, hosts, per_host, num_samples, block_size, 2
+            )
+        )
     return specs
 
 
@@ -1132,6 +1225,7 @@ __all__ = [
     "counts_kernel_spec",
     "default_specs",
     "dense_kernel_spec",
+    "devicegen_hier_spec",
     "devicegen_ring_spec",
     "gc005_justified_functions",
     "hier_kernel_spec",

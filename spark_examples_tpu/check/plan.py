@@ -736,11 +736,15 @@ def _check_schedule(
         rows=static_rows,
         budget_seconds=sched_budget_seconds,
         selected=True,
+        # Prove the kernel the run would actually dispatch: device ingest
+        # rides the fused generation ring, not the host-fed Gramian ring.
+        kernel="devicegen" if conf.ingest == "device" else "gramian",
     )
     for finding in audit.findings:
         report.error(f"sched-{finding.rule_id}", finding.detail)
     report.geometry["sched_topology"] = topology.describe()
     report.geometry["sched_schedule"] = schedule
+    report.geometry["sched_kernel"] = audit.facts.get("kernel")
     report.geometry["sched_ici_bytes"] = audit.facts.get("ici_bytes")
     report.geometry["sched_dcn_bytes"] = audit.facts.get("dcn_bytes")
     report.geometry["sched_rows"] = audit.facts.get("sim_rows")
@@ -1159,18 +1163,6 @@ def validate_plan(
         resolve_reduce_schedule(getattr(conf, "reduce_schedule", "auto"), 1)
     except ValueError as e:
         report.error("reduce-schedule", str(e))
-    if (
-        getattr(conf, "reduce_schedule", "auto") == "hier"
-        and conf.ingest == "device"
-    ):
-        # Mirrors the runtime reject in pca_driver.get_similarity_device_gen:
-        # the fused generation ring pins the flat schedule.
-        report.error(
-            "reduce-schedule-device-ingest",
-            "--reduce-schedule hier is not available for --ingest device "
-            "(the fused generation ring runs the flat schedule); use "
-            "--ingest packed or wire, or leave the schedule on auto",
-        )
     if sched_budget_seconds is not None and topology is None:
         report.error(
             "sched-budget-seconds",
@@ -1295,6 +1287,37 @@ def validate_plan(
             f"least 2, resolved mesh has samples={samples} "
             "(use --mesh-shape data,samples)",
         )
+    if getattr(conf, "reduce_schedule", "auto") == "hier" and conf.mesh_shape:
+        # hier serves BOTH ingest families — the host-fed accumulators and
+        # the fused generation ring (``ops/devicegen.py:_ring_update`` runs
+        # the two-level tile exchange when its mesh carries a host axis) —
+        # so device ingest no longer rejects it. What IS statically
+        # checkable is the factorization invariant: the host factor must
+        # divide the DECLARED samples axis (without --mesh-shape the
+        # topology implies the mesh and divides by construction). Offline,
+        # the factor is the declared topology's host count, else the
+        # rehearsal env override; absent both it is a runtime fact (the
+        # process count) that ``resolve_hier_hosts`` enforces loudly at
+        # accumulator construction.
+        import os
+
+        from spark_examples_tpu.parallel.mesh import HIER_HOSTS_ENV
+
+        hier_hosts = None
+        if topology is not None:
+            hier_hosts = int(topology.hosts)
+        else:
+            env = os.environ.get(HIER_HOSTS_ENV, "")
+            if env.isdigit():
+                hier_hosts = int(env)
+        if hier_hosts is not None and hier_hosts > 1 and samples % hier_hosts:
+            report.error(
+                "hier-hosts-samples-axis",
+                f"--reduce-schedule hier needs the host factor "
+                f"({hier_hosts}) to divide the mesh samples axis "
+                f"({samples}); choose a mesh whose samples axis is a "
+                "multiple of the host count",
+            )
     if n_shards is not None and n_shards < data:
         report.warn(
             "data-axis-starvation",

@@ -70,6 +70,7 @@ from spark_examples_tpu.ops.contracts import (
     DECLARED_MAX_SITES,
     HAS_VARIATION,
     PACKED_BYTE,
+    SITE_INDEX,
     RangeContract,
     exact_int_window,
     exactness_headroom_sites,
@@ -557,6 +558,54 @@ class Interpreter:
             frame.write(eqn.outvars[0], AbsVal(0.0, float(size - 1), True))
         else:
             frame.write(eqn.outvars[0], TOP)
+
+    def _prim_gather(
+        self, frame: _Frame, eqn: Any, trips: int, collect: bool
+    ) -> None:
+        # Every gathered element IS an element of the operand, so the
+        # operand's interval carries over verbatim; under FILL mode the
+        # out-of-bounds fill value joins the hull (the declared fill when
+        # present, the dtype range otherwise). The index operand cannot
+        # influence VALUES — only which ones — so it contributes nothing
+        # to the interval (taint provenance still flows via _eval_eqn).
+        a = frame.read(eqn.invars[0])
+        out = AbsVal(a.lo, a.hi, a.integer)
+        mode = eqn.params.get("mode")
+        if mode is not None and "FILL" in str(mode).upper():
+            fill = eqn.params.get("fill_value")
+            if fill is not None:
+                f = float(fill)
+                out = _hull(out, AbsVal(f, f, float(f).is_integer()))
+            else:
+                out = _hull(out, self._dtype_range(eqn.outvars[0]))
+        frame.write(eqn.outvars[0], out)
+
+    def _prim_psum(
+        self, frame: _Frame, eqn: Any, trips: int, collect: bool
+    ) -> None:
+        # A psum over named axes is a sum of ``size`` per-device terms,
+        # each inside the operand's interval: [size·lo, size·hi].
+        axes = eqn.params.get("axes", ())
+        size = 1
+        for ax in axes:
+            if isinstance(ax, int):
+                shape = eqn.invars[0].aval.shape
+                size *= int(shape[ax]) if ax < len(shape) else 0
+            else:
+                size *= self.axis_sizes.get(ax, 0)
+        for iv, ov in zip(eqn.invars, eqn.outvars):
+            a = frame.read(iv)
+            if size > 0:
+                frame.write(
+                    ov,
+                    AbsVal(
+                        _mul_bound(float(size), a.lo),
+                        _mul_bound(float(size), a.hi),
+                        a.integer,
+                    ),
+                )
+            else:
+                frame.write(ov, TOP)
 
     def _prim_convert_element_type(
         self, frame: _Frame, eqn: Any, trips: int, collect: bool
@@ -1322,6 +1371,16 @@ def audit_range_kernel(
     for conv in interp.converts:
         if not conv.src.integer:
             continue
+        if np.dtype(conv.src_dtype).kind in ("i", "u"):
+            info = np.iinfo(np.dtype(conv.src_dtype))
+            if conv.src.lo <= info.min and conv.src.hi >= info.max:
+                # Full-dtype-range source: pure bit entropy (hash/RNG
+                # mixing), carrying no magnitude claim a narrowing could
+                # lose — the int→int truncation IS the modular semantics
+                # there. A magnitude that matters downstream still reaches
+                # the accumulator dot and is bounded (or flagged) by
+                # GR001/GR002/GR004.
+                continue
         src_window = exact_int_window(conv.src_dtype)
         effective = conv.src.magnitude
         if src_window is not None:
@@ -1531,6 +1590,84 @@ def hier_range_spec(
     )
 
 
+def devicegen_range_spec(
+    data: int,
+    samples: int,
+    num_samples: int,
+    block_size: int,
+    blocks_per_dispatch: int = 2,
+    pack: bool = True,
+) -> RangeKernelSpec:
+    """The fused generate-and-ring-accumulate dispatch
+    (``ops/devicegen.py:_ring_update``) under the flat schedule. The
+    genotype operands are GENERATED on device — their {0,1} range is not a
+    declared input contract but the comparison lattice's own inference
+    (``Interpreter``: a compare yields [0, 1] integer), so the dot
+    operands arrive contracted without any input declaration and GR005's
+    one-partial-per-entry-per-pass proof runs on the same dus pattern as
+    the host-fed ring. The scalar invars (row counters, kept-site counts,
+    dispatch offsets, valid-site counts) carry the SITE_INDEX contract —
+    all are bounded by the declared production geometry."""
+    from spark_examples_tpu.check.ir import devicegen_ring_spec
+    from spark_examples_tpu.parallel.mesh import DATA_AXIS, SAMPLES_AXIS
+
+    ir_spec = devicegen_ring_spec(
+        data, samples, num_samples, block_size, blocks_per_dispatch, pack
+    )
+    return RangeKernelSpec(
+        name=f"ranges:{ir_spec.name}",
+        build=ir_spec.build,
+        input_contracts=(None, SITE_INDEX, SITE_INDEX, SITE_INDEX, SITE_INDEX),
+        axis_sizes={DATA_AXIS: data, SAMPLES_AXIS: samples},
+        rows_per_flush=data * blocks_per_dispatch * block_size,
+        max_count=HAS_VARIATION.hi,
+        operand_window_dtype="int8",
+        accum_dtype="int32",
+    )
+
+
+def devicegen_hier_range_spec(
+    hosts: int,
+    devices_per_host: int,
+    num_samples: int,
+    block_size: int,
+    blocks_per_dispatch: int = 2,
+    pack: bool = True,
+    data: int = 1,
+) -> RangeKernelSpec:
+    """The fused generation ring under the hierarchical two-level
+    schedule (``graftcheck ranges --topology H,D``): the same two-radix
+    owner index ``((h + k) mod H) * D + ((d + j) mod D)`` as the host-fed
+    hier kernel (``Interpreter._peel_two_radix``), so one Gramian entry
+    still takes exactly ONE dot partial per ring pass (GR005) — the
+    devicegen/hier seam is proven, not assumed."""
+    from spark_examples_tpu.check.ir import devicegen_hier_spec
+    from spark_examples_tpu.parallel.mesh import (
+        DATA_AXIS,
+        HOST_AXIS,
+        SAMPLES_AXIS,
+    )
+
+    ir_spec = devicegen_hier_spec(
+        data, hosts, devices_per_host, num_samples, block_size,
+        blocks_per_dispatch, pack,
+    )
+    return RangeKernelSpec(
+        name=f"ranges:{ir_spec.name}",
+        build=ir_spec.build,
+        input_contracts=(None, SITE_INDEX, SITE_INDEX, SITE_INDEX, SITE_INDEX),
+        axis_sizes={
+            DATA_AXIS: data,
+            HOST_AXIS: hosts,
+            SAMPLES_AXIS: devices_per_host,
+        },
+        rows_per_flush=data * blocks_per_dispatch * block_size,
+        max_count=HAS_VARIATION.hi,
+        operand_window_dtype="int8",
+        accum_dtype="int32",
+    )
+
+
 def default_specs(
     num_samples: int = 64,
     block_size: int = 8,
@@ -1538,10 +1675,11 @@ def default_specs(
     topologies: Sequence[Tuple[int, int]] = (),
 ) -> List[RangeKernelSpec]:
     """The shipped matrix: dense + counts per data-axis size, the ring
-    kernel over every mesh shape × {packed, unpacked} × {int8, bf16}, and
-    the count-valued (same-set-join) unpacked ring per mesh shape.
-    ``topologies`` append the hierarchical two-level kernel per declared
-    ``hosts,devices_per_host`` pair (packed × {int8, bf16})."""
+    kernel over every mesh shape × {packed, unpacked} × {int8, bf16}, the
+    count-valued (same-set-join) unpacked ring per mesh shape, and the
+    fused device-generation ring per mesh shape. ``topologies`` append the
+    hierarchical two-level kernel per declared ``hosts,devices_per_host``
+    pair (packed × {int8, bf16}) plus the hier devicegen ring."""
     specs: List[RangeKernelSpec] = []
     for data in sorted({d for d, _ in meshes}):
         specs.append(dense_range_spec(data, num_samples, block_size))
@@ -1562,6 +1700,9 @@ def default_specs(
                 counts=True,
             )
         )
+        specs.append(
+            devicegen_range_spec(data, samples, num_samples, block_size)
+        )
     for hosts, per_host in topologies:
         if hosts * per_host < 2:
             continue
@@ -1571,6 +1712,11 @@ def default_specs(
                     hosts, per_host, num_samples, block_size, True, exact_int
                 )
             )
+        specs.append(
+            devicegen_hier_range_spec(
+                hosts, per_host, num_samples, block_size
+            )
+        )
     return specs
 
 
@@ -1644,6 +1790,8 @@ __all__ = [
     "counts_range_spec",
     "default_specs",
     "dense_range_spec",
+    "devicegen_hier_range_spec",
+    "devicegen_range_spec",
     "hier_range_spec",
     "ring_range_spec",
     "run_audit",

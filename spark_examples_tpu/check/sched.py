@@ -60,6 +60,8 @@ from spark_examples_tpu.check.ir import (
     _upstream_eqns,
     _walk_eqns,
     audit_kernel,
+    devicegen_hier_spec,
+    devicegen_ring_spec,
     hier_kernel_spec,
     ring_kernel_spec,
     trace_kernel,
@@ -275,11 +277,39 @@ def schedule_kernel_spec(
     data: int = 1,
     pack: bool = True,
     exact_int: bool = False,
+    kernel: str = "gramian",
+    blocks_per_dispatch: int = 2,
 ) -> KernelSpec:
     """The IR kernel spec for one schedule on one topology — the flat ring
     over a ``data x S`` abstract mesh, or the two-level ring over the
-    host-major ``data x hosts x samples`` factorization. Both are the
-    runtime's own constructors."""
+    host-major ``data x hosts x samples`` factorization. ``kernel``
+    selects the subject: the host-fed gramian update
+    (``ops/gramian.py``) or the fused device-generation ring
+    (``ops/devicegen.py:_ring_update``, ``blocks_per_dispatch`` ring
+    passes per call). All four are the runtime's own constructors."""
+    if kernel == "devicegen":
+        if schedule == "hier":
+            return devicegen_hier_spec(
+                data,
+                topology.hosts,
+                topology.devices_per_host,
+                num_samples,
+                block_size,
+                blocks_per_dispatch,
+                pack,
+            )
+        return devicegen_ring_spec(
+            data,
+            topology.devices,
+            num_samples,
+            block_size,
+            blocks_per_dispatch,
+            pack,
+        )
+    if kernel != "gramian":
+        raise ValueError(
+            f"kernel must be 'gramian' or 'devicegen', got {kernel!r}"
+        )
     if schedule == "hier":
         return hier_kernel_spec(
             data,
@@ -309,6 +339,7 @@ def audit_schedule(
     selected: bool = True,
     traced: Optional[Any] = None,
     hbm_budget_bytes: Optional[int] = None,
+    kernel: str = "gramian",
 ) -> ScheduleAudit:
     """Trace (or reuse ``traced``), IR-audit, extract, and simulate one
     schedule on one topology; enforce the GS rules.
@@ -325,13 +356,15 @@ def audit_schedule(
     )
 
     spec = schedule_kernel_spec(
-        topology, schedule, num_samples, block_size, data, pack, exact_int
+        topology, schedule, num_samples, block_size, data, pack, exact_int,
+        kernel=kernel,
     )
     audit = ScheduleAudit(
         f"sched[{topology.describe()},{schedule},{spec.name}]"
     )
     audit.facts["topology"] = topology.describe()
     audit.facts["schedule"] = schedule
+    audit.facts["kernel"] = kernel
     audit.facts["selected"] = bool(selected)
     if traced is None:
         try:
@@ -520,7 +553,8 @@ class SchedReport:
                     lines.append(f"  {f.format()}")
         for comp in self.comparisons:
             lines.append(
-                f"  compared: {comp['topology']}: hier DCN "
+                f"  compared: {comp['topology']} "
+                f"{comp.get('kernel', 'gramian')}: hier DCN "
                 f"{comp['hier_dcn_bytes']} B < flat DCN "
                 f"{comp['flat_dcn_bytes']} B "
                 f"({comp['dcn_reduction']:.1f}x less on the slow link)"
@@ -539,9 +573,12 @@ def run_audit(
     reduce_schedule: str = "auto",
     budget_seconds: Optional[float] = None,
 ) -> SchedReport:
-    """Prove the schedule matrix: for every topology, audit the schedule
-    the ``--reduce-schedule`` resolution would build (GS001 armed) AND,
-    on multi-host topologies, the flat ring as the reference subject
+    """Prove the schedule matrix: for every topology and BOTH ring
+    kernels (the host-fed gramian update and the fused device-generation
+    ring — ``ops/devicegen.py`` runs the same two-level schedule since the
+    devicegen/hier seam closed), audit the schedule the
+    ``--reduce-schedule`` resolution would build (GS001 armed) AND, on
+    multi-host topologies, the flat ring as the reference subject
     (facts + GS002/GS003 — its contracts must hold even where it is the
     wrong choice), then record the flat-vs-hier DCN comparison. Pure
     tracing — zero device buffers survive the call (test-asserted)."""
@@ -552,37 +589,41 @@ def run_audit(
         if topo.devices < 2:
             continue
         chosen = resolve_reduce_schedule(reduce_schedule, topo.hosts)
-        chosen_audit = audit_schedule(
-            topo,
-            chosen,
-            num_samples=num_samples,
-            block_size=block_size,
-            budget_seconds=budget_seconds,
-            selected=True,
-        )
-        report.audits.append(chosen_audit)
-        if topo.hosts > 1 and chosen == "hier":
-            flat_audit = audit_schedule(
+        for kernel in ("gramian", "devicegen"):
+            chosen_audit = audit_schedule(
                 topo,
-                "flat",
+                chosen,
                 num_samples=num_samples,
                 block_size=block_size,
-                selected=False,
+                budget_seconds=budget_seconds,
+                selected=True,
+                kernel=kernel,
             )
-            report.audits.append(flat_audit)
-            flat_dcn = int(flat_audit.facts.get("dcn_bytes", 0))
-            hier_dcn = int(chosen_audit.facts.get("dcn_bytes", 0))
-            report.comparisons.append(
-                {
-                    "topology": topo.describe(),
-                    "flat_dcn_bytes": flat_dcn,
-                    "hier_dcn_bytes": hier_dcn,
-                    "dcn_reduction": (
-                        flat_dcn / hier_dcn if hier_dcn else float("inf")
-                    ),
-                    "hier_strictly_below": hier_dcn < flat_dcn,
-                }
-            )
+            report.audits.append(chosen_audit)
+            if topo.hosts > 1 and chosen == "hier":
+                flat_audit = audit_schedule(
+                    topo,
+                    "flat",
+                    num_samples=num_samples,
+                    block_size=block_size,
+                    selected=False,
+                    kernel=kernel,
+                )
+                report.audits.append(flat_audit)
+                flat_dcn = int(flat_audit.facts.get("dcn_bytes", 0))
+                hier_dcn = int(chosen_audit.facts.get("dcn_bytes", 0))
+                report.comparisons.append(
+                    {
+                        "topology": topo.describe(),
+                        "kernel": kernel,
+                        "flat_dcn_bytes": flat_dcn,
+                        "hier_dcn_bytes": hier_dcn,
+                        "dcn_reduction": (
+                            flat_dcn / hier_dcn if hier_dcn else float("inf")
+                        ),
+                        "hier_strictly_below": hier_dcn < flat_dcn,
+                    }
+                )
     return report
 
 

@@ -168,6 +168,18 @@ def _build_base_parser(parser: argparse.ArgumentParser) -> argparse.ArgumentPars
             "consume this instead of scraping stdout."
         ),
     )
+    parser.add_argument(
+        "--trace-dir",
+        default=None,
+        metavar="DIR",
+        help=(
+            "Record crash-durable per-stage flight-recorder events under "
+            "DIR/trace (obs/recorder.py): one append-only segment per "
+            "process, so a multi-process run's stage timelines merge into "
+            "ONE Chrome trace with `python -m spark_examples_tpu trace "
+            "export --run-dir DIR` (obs/trace.py). Off by default."
+        ),
+    )
     # Robustness (pipeline/checkpoint.py): crash-consistent Gramian
     # checkpointing + resume. The Gramian is additive over variants, so a
     # preempted/killed analysis pass resumes at O(remaining) device cost
@@ -262,6 +274,7 @@ class GenomicsConf:
     seed: int = 42
     heartbeat_seconds: float = 0.0
     metrics_json: Optional[str] = None
+    trace_dir: Optional[str] = None
     gramian_checkpoint_dir: Optional[str] = None
     checkpoint_every_sites: Optional[int] = None
     resume_from: Optional[str] = None

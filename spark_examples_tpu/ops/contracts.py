@@ -139,6 +139,20 @@ def exact_int_window(dtype) -> Optional[int]:
 #: and the plan validator's ``gramian_entry_bound`` facts cover.
 DECLARED_MAX_SITES = 40_000_000
 
+#: Site-grid scalars: dispatch offsets, valid-site counts, and per-set row
+#: counters are all bounded by the declared production geometry. This is
+#: the contract of the fused device-generation kernel's scalar operands
+#: (``ops/devicegen.py:_ring_update``) — without it the range prover would
+#: treat a grid offset as unbounded and taint the whole generation chain
+#: (every generated genotype is a function of the site position).
+SITE_INDEX = RangeContract(
+    "site_index",
+    0,
+    DECLARED_MAX_SITES,
+    "site-grid offset / site count (declared geometry ceiling)",
+)
+CONTRACTS[SITE_INDEX.name] = SITE_INDEX
+
 #: f32 accumulation is exact for integers up to 2^24; past a projected
 #: per-entry count of this limit the accumulators losslessly convert to the
 #: int8->int32 MXU path. Defined here (the dtype-window registry) and
@@ -181,6 +195,7 @@ __all__ = [
     "PACKED_BYTE",
     "RangeContract",
     "SAME_SET_JOIN_MAX_COUNT",
+    "SITE_INDEX",
     "exact_int_window",
     "exactness_headroom_sites",
     "flush_entry_increment",

@@ -954,19 +954,41 @@ def _ring_update(
     on device (8 genotypes/byte) before the first ``ppermute``, so the ring
     moves ⅛ the ICI bytes; requires ``padded`` to satisfy the pack-width
     invariant (local width a multiple of 8 —
-    ``parallel/mesh.py:padded_cohort``)."""
+    ``parallel/mesh.py:padded_cohort``). Passing a hierarchical
+    ``data x hosts x samples`` mesh selects the two-level reduction
+    schedule (``ops/gramian.py:_hier_ring_tiles``): generation is
+    schedule-independent (each device still generates its flat column
+    slot) and only the tile circulation changes, so flat and hier runs are
+    byte-identical (CI-asserted)."""
     from spark_examples_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from spark_examples_tpu.ops.gramian import _pack_bits_device, _ring_tiles
-    from spark_examples_tpu.parallel.mesh import DATA_AXIS, SAMPLES_AXIS
+    from spark_examples_tpu.ops.gramian import (
+        _hier_ring_tiles,
+        _pack_bits_device,
+        _ring_tiles,
+    )
+    from spark_examples_tpu.parallel.mesh import (
+        DATA_AXIS,
+        HOST_AXIS,
+        SAMPLES_AXIS,
+    )
 
     operand_dtype = np.dtype(operand_name)
     pops_padded = np.frombuffer(pops_bytes, dtype=np.int32)
-    n_local = padded // mesh.shape[SAMPLES_AXIS]
+    # A hierarchical (data x hosts x samples) mesh selects the two-level
+    # schedule: the host-major factorization IS the schedule choice
+    # (parallel/mesh.py:hierarchical_mesh), exactly as in
+    # ops/gramian.py:build_hierarchical_update — no extra flag, and the
+    # memo key stays this same positional tuple.
+    hier = HOST_AXIS in mesh.shape
+    hier_hosts = mesh.shape[HOST_AXIS] if hier else 1
+    inner_devices = mesh.shape[SAMPLES_AXIS]
+    n_local = padded // (hier_hosts * inner_devices)
     K, B = blocks_per_dispatch, block_size
     data_axis = DATA_AXIS if DATA_AXIS in mesh.shape else None
-    g_spec = P(data_axis, SAMPLES_AXIS, None)
+    sample_axes = (HOST_AXIS, SAMPLES_AXIS) if hier else SAMPLES_AXIS
+    g_spec = P(data_axis, sample_axes, None)
     s_spec = P(data_axis)
     r_spec = P(data_axis, None)
     n_sets = len(vs_keys)
@@ -987,6 +1009,12 @@ def _ring_update(
             # g: (1, n_local, padded); offset/n_valid/kept: (1,);
             # rows: (1, n_sets)
             s_idx = jax.lax.axis_index(SAMPLES_AXIS)
+            if hier:
+                # Flat slot of this device in the host-major factorization:
+                # it owns the same column tile the flat ring would give it
+                # (hierarchical_mesh reshapes without reordering devices),
+                # so generation is schedule-independent by construction.
+                s_idx = jax.lax.axis_index(HOST_AXIS) * inner_devices + s_idx
             col_start = (s_idx * n_local).astype(jnp.int64)
             cols = col_start + jnp.arange(n_local, dtype=jnp.int64)
             pops_local = jax.lax.dynamic_slice(
@@ -1034,7 +1062,7 @@ def _ring_update(
                     ],
                     axis=1,
                 )  # (B, n_sets)
-                total_any = jax.lax.psum(per_set_local, SAMPLES_AXIS)
+                total_any = jax.lax.psum(per_set_local, sample_axes)
                 rows_l += jnp.sum(total_any > 0, axis=0).astype(rows_l.dtype)
                 # Same materialization barrier as the dense update: the ring
                 # exchange dots the local column block against every rotated
@@ -1053,9 +1081,15 @@ def _ring_update(
                     x_cols = jax.lax.optimization_barrier(
                         hv.astype(operand_dtype)
                     )
-                g_l = _ring_tiles(
-                    g_l, x_cols, SAMPLES_AXIS, operand_dtype, packed=pack
-                )
+                if hier:
+                    g_l = _hier_ring_tiles(
+                        g_l, x_cols, HOST_AXIS, SAMPLES_AXIS,
+                        operand_dtype, packed=pack,
+                    )
+                else:
+                    g_l = _ring_tiles(
+                        g_l, x_cols, SAMPLES_AXIS, operand_dtype, packed=pack
+                    )
                 return (g_l, rows_l, kept_l), None
 
             (g_l, rows_l, kept_l), _ = jax.lax.scan(
@@ -1112,6 +1146,8 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
         set_sizes: Optional[Sequence[int]] = None,
         pops_per_set: Optional[Sequence[np.ndarray]] = None,
         pack_bits: str = "auto",
+        reduce_schedule: str = "auto",
+        hier_hosts: Optional[int] = None,
     ):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -1122,7 +1158,10 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
         from spark_examples_tpu.parallel.mesh import (
             DATA_AXIS,
             SAMPLES_AXIS,
+            hierarchical_mesh,
             padded_cohort,
+            resolve_hier_hosts,
+            resolve_reduce_schedule,
         )
 
         if SAMPLES_AXIS not in mesh.shape or mesh.shape[SAMPLES_AXIS] < 2:
@@ -1167,6 +1206,30 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
             self.total_columns = self.num_samples
         self.samples_parallel = mesh.shape[SAMPLES_AXIS]
         self.data_parallel = mesh.shape.get(DATA_AXIS, 1)
+        # --reduce-schedule on the fused generation ring: the SAME
+        # resolution rule as the host-fed accumulator
+        # (ops/gramian.py:ShardedGramianAccumulator) — auto = hier iff the
+        # samples axis spans more than one host, explicit hier with a
+        # non-dividing host factor fails loudly. Everything outside the
+        # tile circulation — G, generation, finalize — is
+        # schedule-independent, so flat and hier are byte-identical.
+        resolve_reduce_schedule(reduce_schedule, 1)  # validate the spelling
+        try:
+            self.hier_hosts = resolve_hier_hosts(
+                self.samples_parallel, hier_hosts
+            )
+        except ValueError:
+            if reduce_schedule == "hier":
+                raise  # an explicit hier request must not silently degrade
+            self.hier_hosts = 1
+        self.reduce_schedule = resolve_reduce_schedule(
+            reduce_schedule, self.hier_hosts
+        )
+        self._hier_mesh = (
+            hierarchical_mesh(mesh, self.hier_hosts)
+            if self.reduce_schedule == "hier"
+            else None
+        )
         # Packed wire format pads the column space to 8× the samples axis
         # (pack-width invariant); pad columns generate all-zero and finalize
         # trims them, exactly like the plain samples-axis padding.
@@ -1216,7 +1279,11 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
             int(n_pops)
             if n_pops is not None
             else int(np.asarray(pops, dtype=np.int32).max()) + 1,
-            mesh,
+            # The mesh in the memo key selects the schedule: the
+            # hierarchical factorization shards the same rows over the same
+            # devices in the same order (identical HloSharding), so G and
+            # the scalar operands need no reshard at the jit boundary.
+            self._hier_mesh if self._hier_mesh is not None else mesh,
             self.set_sizes,
             self.pack,
         )
@@ -1242,29 +1309,44 @@ class DeviceGenRingGramianAccumulator(_GridDispatchAccumulator):
 
     def schedule_block(self) -> dict:
         """The manifest ``schedule`` block for the fused device-generation
-        ring — always the FLAT schedule (the hierarchical two-level
-        schedule currently serves the host-fed accumulators;
-        ``ops/gramian.py:build_hierarchical_update``). Unlike the
-        host-fed accumulator, this path has no independent per-flush
-        accounting: ``ring_bytes_total`` IS the closed-form projection
-        over dispatched capacity, so predicted == measured here by
-        construction and the pair's drift signal lives on the host-fed
-        side (``ShardedGramianAccumulator.schedule_block``)."""
-        from spark_examples_tpu.parallel.mesh import resolve_hier_hosts
+        ring: which reduction schedule ran (flat, or the two-level
+        hierarchical schedule over the host-major factorization) and its
+        provable per-link-class byte split. Unlike the host-fed
+        accumulator, this path has no independent per-flush accounting:
+        ``ring_bytes_total`` IS the closed-form projection over dispatched
+        capacity, so predicted == measured here by construction and the
+        pair's drift signal lives on the host-fed side
+        (``ShardedGramianAccumulator.schedule_block``)."""
+        from spark_examples_tpu.parallel.mesh import (
+            hierarchical_traffic_bytes,
+        )
 
-        try:
-            hosts = resolve_hier_hosts(self.samples_parallel)
-        except ValueError:
-            hosts = 1
         predicted = int(self.ring_bytes_total)
+        if self.reduce_schedule == "hier":
+            level = hierarchical_traffic_bytes(
+                self.sites_capacity,
+                self.hier_hosts,
+                self.samples_parallel // self.hier_hosts,
+                self.n_local,
+                self.pack,
+            )
+            ici, dcn = int(level.ici_bytes), int(level.dcn_bytes)
+        elif self.hier_hosts == 1:
+            ici, dcn = predicted, 0
+        else:
+            # Flat ring spanning hosts: no byte is provably intra-host
+            # (parallel/mesh.py:flat_traffic_split) — the GS001 premise.
+            ici, dcn = 0, predicted
         return {
-            "kind": "flat",
-            "hosts": int(hosts),
-            "devices_per_host": int(self.samples_parallel // hosts),
+            "kind": self.reduce_schedule,
+            "hosts": int(self.hier_hosts),
+            "devices_per_host": int(
+                self.samples_parallel // self.hier_hosts
+            ),
             "predicted_ring_bytes": predicted,
             "measured_ring_bytes": predicted,
-            "predicted_ici_bytes": predicted if hosts == 1 else 0,
-            "predicted_dcn_bytes": 0 if hosts == 1 else predicted,
+            "predicted_ici_bytes": ici,
+            "predicted_dcn_bytes": dcn,
         }
 
     def finalize_sharded(self) -> jax.Array:

@@ -336,6 +336,7 @@ def host_peak_bytes(
     host_accumulator: bool = False,
     grm_finalize: bool = False,
     ld_window_sites: int = 0,
+    num_hosts: int = 1,
     baseline_bytes: int = HOST_RUNTIME_BASELINE_BYTES,
 ) -> int:
     """Closed-form peak host-memory bound of one bounded-ingest run — the
@@ -377,6 +378,15 @@ def host_peak_bytes(
       squared numerator and its cast temp, the r² result — next to the
       fetched int32 stats; 56 W² bounds the lot) plus the (W, N) uint8
       window buffer; zero when the run has no LD window.
+    - **pod merge** — ``(num_hosts + 1) * 8 * N²`` when ``num_hosts > 1``:
+      host-sharded ingest closes out by all-gathering every process's
+      dense N×N partial Gramian onto each host and summing them exactly
+      (``pipeline/pca_driver.py:_merge_host_partials``) — the gathered
+      stack (``num_hosts`` partials) plus the 8-byte exact-sum working
+      copy sit on host simultaneously. This is a PER-HOST bound: each
+      process pays it locally, so the pod-wide peak is ``num_hosts``
+      times this formula while each host stays within it. Zero for
+      single-process runs.
     - **baseline** — :data:`HOST_RUNTIME_BASELINE_BYTES`.
     """
     n = int(num_samples)
@@ -389,6 +399,8 @@ def host_peak_bytes(
     grm_term = 21 * n * n if grm_finalize else 0
     w = int(ld_window_sites)
     ld_term = 56 * w * w + w * n if w > 0 else 0
+    hosts = int(num_hosts)
+    merge_term = (hosts + 1) * 8 * n * n if hosts > 1 else 0
     return int(
         baseline_bytes
         + parse_window
@@ -398,6 +410,7 @@ def host_peak_bytes(
         + host_matrix
         + grm_term
         + ld_term
+        + merge_term
     )
 
 

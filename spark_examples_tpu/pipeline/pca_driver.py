@@ -175,6 +175,11 @@ class VariantsPcaDriver:
         # predicted-vs-measured ring bytes), stashed from the sharded
         # accumulator when one runs; None on dense/host runs.
         self._sched_block: Optional[Dict] = None
+        # Host-sharded pod ingest (sharding/contig.py:host_partition):
+        # resolved ONCE per run by _plan_host_sharded_ingest (every contig
+        # enumeration and the finalize merge must agree on the same
+        # decision); None = not yet resolved, 1 = whole-cohort ingest.
+        self._ingest_hosts: Optional[int] = None
         self._gramian_resume: Optional[Dict] = None
         self._ckpt_fingerprint = ""
         if getattr(conf, "gramian_checkpoint_dir", None) or getattr(
@@ -241,16 +246,29 @@ class VariantsPcaDriver:
             # only caps the default mesh's data axis, so jax stays
             # uninitialized here unless a mesh decision truly needs it.
             device_count = None
+            num_hosts = 1
             if self.devices is not None:
                 device_count = len(self.devices)
             elif not getattr(self.conf, "mesh_shape", None):
                 import jax
 
                 device_count = jax.device_count()
+            import sys
+
+            if "jax" in sys.modules:
+                # PER-HOST bound: under multi-process init every process
+                # registers the same formula with the merge term charged
+                # (conservative for ring runs, exact for host-sharded
+                # ingest — the merge gather is the peak either way). The
+                # probe never forces a backend into being on its own.
+                import jax
+
+                num_hosts = jax.process_count()
             bound = conf_host_peak_bytes(
                 self.conf,
                 device_count=device_count,
                 num_samples=len(self.indexes) or None,
+                num_hosts=num_hosts,
             )
         except Exception:
             bound = None
@@ -267,7 +285,9 @@ class VariantsPcaDriver:
         list, or a checkpoint reader under ``--input-path``."""
         if self.conf.input_path:
             return [load_variants(self.conf.input_path)]
-        contigs = self.conf.get_contigs(self.source, self.conf.variant_set_id)
+        contigs = self._host_contigs(
+            self.conf.get_contigs(self.source, self.conf.variant_set_id)
+        )
         partitioner = VariantsPartitioner(contigs, self.conf.bases_per_partition)
         return [
             VariantsDataset(
@@ -461,6 +481,113 @@ class VariantsPcaDriver:
             sharded = False
         return sharded
 
+    def _plan_host_sharded_ingest(self) -> int:
+        """Resolve ONCE whether this run ingests host-sharded, and over how
+        many hosts — the pod-scale ingest split (``sharding/contig.py:
+        partition_contigs_by_host``).
+
+        Host-sharded ingest engages only when ALL of:
+
+        - the run is multi-process (``jax.process_count() > 1``);
+        - the resolved similarity strategy is DENSE — the sharded ring is a
+          global SPMD program whose every process must feed the identical
+          site stream in lockstep, so it keeps the full-cohort ingest;
+        - the device path owns the data plane (``--pca-backend tpu``) with a
+          live source (no ``--input-path`` resume, no ``--save-variants``
+          wire materialization, no Gramian checkpoint cursor — a
+          fast-forward cursor over a PARTITION would not match the artifact
+          of a differently-sized fleet).
+
+        When it engages, each process ingests only its contig partition on
+        a process-local mesh and the partial Gramians are merged exactly at
+        finalize (``_merge_host_partials``) — byte-identical to the
+        single-process run, with per-host ingest bytes ~1/H of it.
+        """
+        if self._ingest_hosts is not None:
+            return self._ingest_hosts
+        hosts = 1
+        conf = self.conf
+        if (
+            getattr(conf, "pca_backend", "tpu") == "tpu"
+            and not getattr(conf, "input_path", None)
+            and not getattr(conf, "save_variants", None)
+            and not getattr(conf, "gramian_checkpoint_dir", None)
+            and not getattr(conf, "resume_from", None)
+        ):
+            import jax
+
+            if jax.process_count() > 1 and not self._resolve_sharded(
+                None, self._make_mesh()
+            ):
+                hosts = jax.process_count()
+        self._ingest_hosts = hosts
+        return hosts
+
+    def _host_contigs(self, contigs) -> List:
+        """This process's contig partition under host-sharded ingest; the
+        full list otherwise. The ONE seam every ingest path (wire, packed,
+        device-generation) partitions through, so they cannot disagree on
+        the split."""
+        contigs = list(contigs)
+        hosts = self._plan_host_sharded_ingest()
+        if hosts <= 1:
+            return contigs
+        import jax
+
+        from spark_examples_tpu.sharding.contig import host_partition
+
+        local = host_partition(
+            contigs,
+            jax.process_index(),
+            hosts,
+            weight=self.source.declared_sites,
+        )
+        print(
+            f"Host-sharded ingest: process {jax.process_index()} of "
+            f"{hosts} reads {len(local)} of {len(contigs)} contig(s)."
+        )
+        return local
+
+    def _ingest_mesh(self):
+        """The dense accumulator's mesh: the run mesh, or — under
+        host-sharded ingest — a mesh over THIS process's local devices
+        only, so per-process ingest streams of different lengths never
+        deadlock a global collective (each process accumulates its partial
+        Gramian independently; the one cross-process collective is the
+        finalize merge)."""
+        if self._plan_host_sharded_ingest() > 1:
+            import jax
+
+            return resolve_run_mesh(
+                None,
+                self.conf.num_reduce_partitions,
+                devices=jax.local_devices(),
+            )
+        return self._make_mesh()
+
+    def _merge_host_partials(self, result):
+        """The ONE cross-process collective of host-sharded ingest: gather
+        every process's dense N×N partial Gramian and sum them exactly.
+        ``G += XᵀX`` commutes over any partition of the row set, and the
+        sum runs in an 8-byte intermediate (int64 for count partials,
+        float64 otherwise) before casting back — int partials are exact
+        outright, and float partials hold integer-valued counts inside the
+        accumulator's proven exact window (GR005), so the merged matrix is
+        byte-identical to the single-process result. No-op for
+        single-process runs."""
+        if self._plan_host_sharded_ingest() <= 1:
+            return result
+        from jax.experimental import multihost_utils
+
+        partial = np.asarray(result)
+        stacked = np.asarray(multihost_utils.process_allgather(partial))
+        wide = (
+            np.int64
+            if np.issubdtype(partial.dtype, np.integer)
+            else np.float64
+        )
+        return stacked.astype(wide).sum(axis=0).astype(partial.dtype)
+
     def _wrap_accumulator(self, acc):
         """Interpose the checkpoint feeder between the ingest stream and a
         fresh accumulator when checkpointing/resume is configured; a plain
@@ -519,8 +646,8 @@ class VariantsPcaDriver:
             )
         else:
             acc = GramianAccumulator(
-                n, mesh, block_size=self.conf.block_size, exact_int=exact,
-                registry=self.registry, spans=self.spans,
+                n, self._ingest_mesh(), block_size=self.conf.block_size,
+                exact_int=exact, registry=self.registry, spans=self.spans,
                 check_ranges=check_ranges,
             )
         # Duplicate callset indices only arise when a variant set is joined
@@ -541,7 +668,7 @@ class VariantsPcaDriver:
         # remote-attached backends (see ops/gramian.py). The sharded result
         # remains row-tile-sharded (padded) for the sharded PCA stage.
         if isinstance(acc, GramianAccumulator):
-            return acc.finalize_device()
+            return self._merge_host_partials(acc.finalize_device())
         self._sched_block = acc.schedule_block()
         return acc.finalize_sharded()
 
@@ -584,7 +711,7 @@ class VariantsPcaDriver:
         else:
             acc = GramianAccumulator(
                 n,
-                mesh,
+                self._ingest_mesh(),
                 block_size=self.conf.block_size,
                 exact_int=exact,
                 pipeline_depth=pipeline_depth,
@@ -597,7 +724,7 @@ class VariantsPcaDriver:
             feed.add_rows(block)
         self._finish_checkpointing()
         if isinstance(acc, GramianAccumulator):
-            return acc.finalize_device()
+            return self._merge_host_partials(acc.finalize_device())
         self._sched_block = acc.schedule_block()
         return acc.finalize_sharded()
 
@@ -634,17 +761,19 @@ class VariantsPcaDriver:
             else auto_blocks_per_dispatch(len(self.indexes), conf.block_size)
         )
         use_ring = self._resolve_sharded(None, mesh)
-        if use_ring and getattr(conf, "reduce_schedule", "auto") == "hier":
-            # The fused device-generation ring pins the flat schedule (the
-            # hierarchical kernel serves the host-fed accumulators today —
-            # ROADMAP item 2); an explicit hier request must not silently
-            # degrade, same policy as the accumulator's host-factor check.
-            raise ValueError(
-                "--reduce-schedule hier is not available for --ingest "
-                "device (the fused generation ring runs the flat "
-                "schedule); use --ingest packed or wire, or leave the "
-                "schedule on auto"
-            )
+        # The generation ring speaks both schedules: `hier` factors the
+        # samples axis host-major and runs the two-level tile exchange
+        # (ops/gramian.py:_hier_ring_tiles inside ops/devicegen.py:
+        # _ring_update), byte-identical to flat. An explicit hier request
+        # whose host factor does not divide the samples axis still raises
+        # inside the accumulator — same policy as the host-fed path.
+        reduce_schedule = getattr(conf, "reduce_schedule", "auto")
+        if not use_ring:
+            # Dense multi-process: host-sharded pod ingest. Each process
+            # generates/accumulates only its contig partition on its local
+            # devices; the partials merge exactly at finalize.
+            contigs = self._host_contigs(contigs)
+            mesh = self._ingest_mesh()
         if use_ring and len(conf.variant_set_id) > 1:
             # Sharded multi-set: the joint cohort's concatenated per-set
             # column blocks ride the same ring kernel (the join/merge
@@ -672,6 +801,7 @@ class VariantsPcaDriver:
                     source.populations_for(v) for v in conf.variant_set_id
                 ],
                 pack_bits=getattr(conf, "ring_pack_bits", "auto"),
+                reduce_schedule=reduce_schedule,
             )
         elif use_ring:
             # Sharded strategy, fully on device: each samples-slice
@@ -691,6 +821,7 @@ class VariantsPcaDriver:
                 exact_int=True,
                 n_pops=source.n_pops,
                 pack_bits=getattr(conf, "ring_pack_bits", "auto"),
+                reduce_schedule=reduce_schedule,
             )
         else:
             # Asymmetric joint cohorts (per-set sizes) ride the same kernel
@@ -778,7 +909,7 @@ class VariantsPcaDriver:
             self._sched_block = acc.schedule_block()
             result = acc.finalize_sharded()
         else:
-            result = acc.finalize_device()
+            result = self._merge_host_partials(acc.finalize_device())
         from spark_examples_tpu.obs.metrics import (
             DEVICEGEN_DISPATCHES,
             DEVICEGEN_SITES_CAPACITY,
@@ -1168,6 +1299,20 @@ def run_pipeline(
 
         heartbeat = Heartbeat(conf.heartbeat_seconds, driver.registry).start()
     similarity_summary: Optional[Dict] = None
+    recorder = None
+    if getattr(conf, "trace_dir", None):
+        # Crash-durable stage timeline (obs/recorder.py): one segment per
+        # process named by its multi-controller identity, so an N-process
+        # run's timelines merge into ONE Chrome trace (`trace export
+        # --run-dir <dir>`) with each host its own trace process row.
+        from spark_examples_tpu.obs.recorder import FlightRecorder
+
+        import jax
+
+        recorder = FlightRecorder(
+            conf.trace_dir, f"host{jax.process_index()}"
+        )
+        recorder.begin("run", tid="pipeline")
     import contextlib
 
     # Slice placement: without a mesh, jit'd work lands on the process
@@ -1185,12 +1330,22 @@ def run_pipeline(
             # (the stats epilogue); packed/wire paths end in a one-scalar
             # fetch so the stage wall-clock is honest on asynchronous
             # backends rather than dispatch-time only (utils/tracing.py).
+            if recorder is not None:
+                recorder.begin("ingest+similarity", tid="pipeline")
             with times.stage("ingest+similarity"):
                 similarity = _similarity_stage(
                     conf, driver, use_device, use_packed
                 )
                 if not use_device:
                     _sync_scalar(similarity)
+            if recorder is not None:
+                recorder.end("ingest+similarity", tid="pipeline")
+                if (driver._ingest_hosts or 1) > 1:
+                    recorder.record(
+                        "host_sharded_ingest",
+                        tid="pipeline",
+                        hosts=int(driver._ingest_hosts),
+                    )
             if similarity_only:
                 result = None
                 similarity_summary = _summarize_similarity(
@@ -1200,14 +1355,24 @@ def run_pipeline(
                 # compute_pca ends in the synchronous components fetch, so
                 # its stage time is honest even on asynchronous
                 # remote-attached backends.
+                if recorder is not None:
+                    recorder.begin("center+pca", tid="pipeline")
                 with times.stage("center+pca"):
                     result = driver.compute_pca(similarity)
+                if recorder is not None:
+                    recorder.end("center+pca", tid="pipeline")
     finally:
         # Emits-then-stops-cleanly contract: a mid-run exception gets its
         # last heartbeat, then silence — never a progress line racing the
         # traceback (or a leaked thread outliving the run).
         if heartbeat is not None:
             heartbeat.stop()
+        if recorder is not None:
+            # Durability before correctness of shape: whatever happened
+            # above, the events recorded so far reach the segment file
+            # (the crash-durable contract; an open "run" span exports as
+            # a truncated span, never disappears).
+            recorder.flush()
     # Warm the ledger only now, with every kernel this run dispatches
     # compiled and executed — a failure above must not leave a fingerprint
     # behind that makes a retry report "warm" for kernels never built. The
@@ -1283,6 +1448,9 @@ def run_pipeline(
             else:
                 manifest_path = conf.metrics_json
                 print(f"Run manifest written to {conf.metrics_json}.")
+    if recorder is not None:
+        recorder.end("run", tid="pipeline")
+        recorder.close()
     driver.stop()
     return PipelineResult(
         lines=lines,
@@ -1449,7 +1617,9 @@ def _similarity_stage(conf, driver, use_device: bool, use_packed: bool):
 
         source = driver.source
         synthetic = isinstance(source, SyntheticGenomicsSource)
-        contigs = conf.get_contigs(source, conf.variant_set_id)
+        contigs = driver._host_contigs(
+            conf.get_contigs(source, conf.variant_set_id)
+        )
         partitioner = VariantsPartitioner(contigs, conf.bases_per_partition)
         partitions = partitioner.get_partitions(conf.variant_set_id[0])
         from spark_examples_tpu.obs.metrics import (

@@ -21,7 +21,10 @@ alternative:
 
 Column layout is the caller's: the writer takes a header tuple once and
 pre-formatted row tuples after, so GRM/LD/assoc share one spill mechanism
-without sharing a schema.
+without sharing a schema. ``header=None`` writes no header line at all —
+the reference's ``saveAsTextFile`` part files (``analyses/reads_examples``)
+are headerless by format, and their bytes must not change when the
+in-memory result list is replaced by this streaming path.
 """
 
 from __future__ import annotations
@@ -41,7 +44,7 @@ class SiteOutputWriter:
         writer.close()   # atomic rename; the file now exists
     """
 
-    def __init__(self, path: str, header: Sequence[str]):
+    def __init__(self, path: str, header: Optional[Sequence[str]] = None):
         self.path = str(path)
         self.rows_written = 0
         self._closed = False
@@ -49,7 +52,8 @@ class SiteOutputWriter:
         os.makedirs(directory, exist_ok=True)
         self._tmp = f"{self.path}.{os.getpid()}.tmp"
         self._f = open(self._tmp, "w", encoding="utf-8")
-        self._f.write("\t".join(str(h) for h in header) + "\n")
+        if header is not None:
+            self._f.write("\t".join(str(h) for h in header) + "\n")
 
     def write_rows(self, rows: Iterable[Tuple]) -> int:
         """Append one window's rows (any iterable of field tuples); returns

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass
-from typing import Iterable, List
+from typing import Callable, Iterable, List, Optional
 
 #: Default shard width, matching genomics-utils
 #: ``Contig.DEFAULT_NUMBER_OF_BASES_PER_SHARD`` (used via
@@ -81,6 +81,97 @@ def parse_contigs(spec: str) -> List[Contig]:
     return contigs
 
 
+def partition_contigs_by_host(
+    contigs: Iterable[Contig],
+    num_hosts: int,
+    weight: Optional[Callable[[Contig], int]] = None,
+) -> List[List[Contig]]:
+    """THE host → contig-partition split of pod-scale ingest: every host
+    process of a multi-process run reads ONLY its partition, so a pod's
+    aggregate ingest bandwidth scales linearly with hosts while the merged
+    Gramian stays byte-identical (``G += XᵀX`` commutes over any partition
+    of the row set).
+
+    The split rule — deterministic, contig-ordered, balanced by declared
+    sites:
+
+    - contigs are walked IN THE GIVEN ORDER and never reordered or split:
+      partitions are contiguous runs, so each host's read pattern stays
+      sequential per contig and the concatenation of all partitions is the
+      original list (the order every accounting surface assumes);
+    - ``weight(contig)`` declares each contig's site count (default: its
+      base range — exact for the synthetic source's uniform grid up to
+      rounding, the honest prior for files). Host ``h`` closes its
+      partition once the cumulative weight reaches the ``(h+1)``-th
+      fair-share boundary ``(h+1)·total/H`` — compared in exact integer
+      arithmetic (``cum·H >= (h+1)·total``), never floats;
+    - TIE RULE: a contig landing cumulative weight EXACTLY on the boundary
+      belongs to the EARLIER host (it closes that host's partition) — the
+      maximal-prefix reading of "stay within the fair share";
+    - zero-weight contigs ride the partition open at their position; when
+      EVERY weight is zero the walk degenerates to one contig per host in
+      order (extras on the last host) — still deterministic, still
+      ordered.
+
+    Every process computes the SAME partition from the same inputs (pure
+    integer arithmetic over the shared contig list — no RNG, no
+    process-local state), which is what lets H processes agree on the
+    split without a collective. Hosts past the contig supply receive empty
+    partitions (valid: their partial Gramian is zero).
+    """
+    if num_hosts < 1:
+        raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+    ordered = list(contigs)
+    weigh = weight if weight is not None else (lambda c: max(0, c.range))
+    weights = [int(weigh(c)) for c in ordered]
+    for c, w in zip(ordered, weights):
+        if w < 0:
+            raise ValueError(
+                f"negative declared weight {w} for contig "
+                f"{c.reference_name}:{c.start}:{c.end}"
+            )
+    total = sum(weights)
+    parts: List[List[Contig]] = [[] for _ in range(num_hosts)]
+    if total == 0:
+        # Every weight zero: no fair share exists to balance, so the walk
+        # degenerates to one contig per host in order (extras ride the
+        # last host) — deterministic, ordered, and each host still reads
+        # a contiguous run.
+        for i, c in enumerate(ordered):
+            parts[min(i, num_hosts - 1)].append(c)
+        return parts
+    host = 0
+    cum = 0
+    for c, w in zip(ordered, weights):
+        parts[host].append(c)
+        cum += w
+        # Exact-integer fair-share comparison; ties close the EARLIER
+        # host. The while (not if) lets one giant contig span several
+        # fair shares — the hosts it covers simply receive empty
+        # partitions (a contig is never split).
+        while host < num_hosts - 1 and cum * num_hosts >= (host + 1) * total:
+            host += 1
+    return parts
+
+
+def host_partition(
+    contigs: Iterable[Contig],
+    process_index: int,
+    process_count: int,
+    weight: Optional[Callable[[Contig], int]] = None,
+) -> List[Contig]:
+    """This host's slice of :func:`partition_contigs_by_host` — the one
+    call sites use (``process_index``/``process_count`` spell the jax
+    multi-process identity without importing jax here)."""
+    if not 0 <= process_index < process_count:
+        raise ValueError(
+            f"process_index {process_index} outside [0, {process_count})"
+        )
+    return partition_contigs_by_host(contigs, process_count, weight)[
+        process_index
+    ]
+
+
 _SEX_CHROMOSOMES = frozenset({"X", "Y", "chrX", "chrY", "x", "y"})
 
 
@@ -100,5 +191,7 @@ __all__ = [
     "Contig",
     "SexChromosomeFilter",
     "filter_sex_chromosomes",
+    "host_partition",
     "parse_contigs",
+    "partition_contigs_by_host",
 ]

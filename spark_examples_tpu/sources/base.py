@@ -146,6 +146,15 @@ class GenomicsSource(ABC):
         """Contig bounds of a variant set
         (``Contig.getContigsInVariantSet``, used at ``GenomicsConf.scala:88``)."""
 
+    def declared_sites(self, contig: Contig) -> int:
+        """The contig's declared candidate-site weight — the balance input
+        of the host → contig-partition split
+        (``sharding/contig.py:partition_contigs_by_host``). Base sources
+        declare the base range (sites ∝ bases is the honest prior for
+        real data); the synthetic source overrides with its exact
+        site-grid span."""
+        return max(0, contig.range)
+
 
 __all__ = [
     "ShardBoundary",

@@ -388,6 +388,14 @@ class SyntheticGenomicsSource(GenomicsSource):
             total += max(1, -(-(k1 - k0) // VARIANTS_PAGE_SIZE))
         return total
 
+    def declared_sites(self, contig: Contig) -> int:
+        """Exact candidate-site weight of ``contig`` for the host →
+        contig-partition split: the site-grid span itself — the synthetic
+        grid is declared geometry, so the split balances on the TRUE site
+        counts (base sources fall back to the base-range prior)."""
+        k0, k1 = self.site_grid_range(contig)
+        return k1 - k0
+
     def site_grid_range(self, contig: Contig) -> Tuple[int, int]:
         """The contig's candidate-site grid as index range ``[k0, k1)`` with
         position ``k · variant_spacing`` — the only ingest metadata the

@@ -107,9 +107,13 @@ def _naive_depth(source, readset, sequence, start, end):
 
 def test_example3_depth_matches_naive(conf, source):
     region = (1_000, 9_000)
-    lines = reads_examples.run_example3(conf, source, region=region)
+    part_path = reads_examples.run_example3(conf, source, region=region)
+    # The result now STREAMS through the bounded per-site writer; the
+    # saved part file is the whole result surface.
+    assert part_path == f"{conf.output_path}/coverage_21/part-00000"
+    saved = open(part_path).read().splitlines()
     got = {}
-    for line in lines:
+    for line in saved:
         pos, depth = line.strip("()").split(",")
         got[int(pos)] = int(depth)
     # The partitioner's span layout may drop trailing remainder bases
@@ -118,9 +122,14 @@ def test_example3_depth_matches_naive(conf, source):
     naive = _naive_depth(source, Examples.GOOGLE_EXAMPLE_READSET, "21", 1_000, 9_000)
     naive = {p: d for p, d in naive.items() if p <= max_pos}
     assert got == naive
-    # Saved part file exists with identical content.
-    saved = open(f"{conf.output_path}/coverage_21/part-00000").read().splitlines()
-    assert saved == lines
+    # Byte-identical to the reference's saveAsTextFile shape: Scala tuple
+    # rendering, ascending positions, headerless, no streaming artifacts.
+    assert saved == [f"({p},{naive[p]})" for p in sorted(naive)]
+    assert not [
+        f
+        for f in os.listdir(f"{conf.output_path}/coverage_21")
+        if f.endswith(".tmp")
+    ]
 
 
 def test_example4_finds_somatic_differences(conf):
@@ -179,9 +188,9 @@ def test_example3_depth_long_reads(conf):
         num_samples=4, seed=3, read_length=400, read_depth=2
     )
     region = (1_000, 6_000)
-    lines = reads_examples.run_example3(conf, long_source, region=region)
+    part_path = reads_examples.run_example3(conf, long_source, region=region)
     got = {}
-    for line in lines:
+    for line in open(part_path).read().splitlines():
         pos, depth = line.strip("()").split(",")
         got[int(pos)] = int(depth)
     max_pos = max(got)

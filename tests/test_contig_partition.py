@@ -1,0 +1,191 @@
+"""The host → contig-partition split (``sharding/contig.py``): the pure
+integer math every process of a pod-scale run must independently agree on.
+
+Each test pins one clause of the documented split rule — contiguous
+ordered runs, exact-integer fair-share boundaries, the tie rule, the
+zero-weight degenerate walk — plus the driver-facing ``host_partition``
+slice and the merge identity the whole scheme rests on (``G += XᵀX``
+commutes over any partition of the row set).
+"""
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.sharding.contig import (
+    Contig,
+    host_partition,
+    partition_contigs_by_host,
+)
+
+
+def _contigs(*ranges):
+    return [Contig(str(i + 1), 0, r) for i, r in enumerate(ranges)]
+
+
+def test_concatenation_is_original_order():
+    """Partitions are contiguous runs whose concatenation is the input —
+    the order every accounting surface assumes."""
+    contigs = _contigs(10, 30, 20, 40, 5)
+    parts = partition_contigs_by_host(contigs, 3)
+    flat = [c for part in parts for c in part]
+    assert flat == contigs
+
+
+def test_equal_weights_split_evenly():
+    contigs = _contigs(100, 100, 100, 100)
+    parts = partition_contigs_by_host(contigs, 2)
+    assert parts == [contigs[:2], contigs[2:]]
+
+
+def test_tie_closes_earlier_host():
+    """A contig landing cumulative weight EXACTLY on the fair-share
+    boundary belongs to the EARLIER host."""
+    contigs = _contigs(50, 50)
+    parts = partition_contigs_by_host(contigs, 2)
+    assert parts == [[contigs[0]], [contigs[1]]]
+
+
+def test_exact_integer_boundaries_no_float_drift():
+    """Weights chosen so a float fair-share comparison would misplace the
+    boundary; the exact-integer rule (cum*H >= (h+1)*total) cannot."""
+    # total = 3, H = 3: boundaries at 1 and 2. Float total/H = 0.9999...
+    # style drift must not move contig 2.
+    contigs = _contigs(1, 1, 1)
+    parts = partition_contigs_by_host(contigs, 3)
+    assert parts == [[contigs[0]], [contigs[1]], [contigs[2]]]
+
+
+def test_more_hosts_than_contigs_leaves_empty_partitions():
+    contigs = _contigs(100, 100)
+    parts = partition_contigs_by_host(contigs, 5)
+    assert [len(p) for p in parts].count(0) == 3
+    assert [c for part in parts for c in part] == contigs
+
+
+def test_single_contig_goes_to_first_host():
+    contigs = _contigs(1000)
+    parts = partition_contigs_by_host(contigs, 4)
+    assert parts[0] == contigs
+    assert all(not p for p in parts[1:])
+
+
+def test_giant_contig_spans_several_fair_shares():
+    """One contig holding >2/3 of the weight covers hosts 0 and 1's fair
+    shares; host 1 receives an empty partition (contigs never split)."""
+    contigs = _contigs(700, 100, 100, 100)
+    parts = partition_contigs_by_host(contigs, 3)
+    assert parts[0] == [contigs[0]]
+    # 700/1000 passes both the 1/3 and 2/3 boundaries: host 1 is empty.
+    assert parts[1] == []
+    assert parts[2] == contigs[1:]
+
+
+def test_empty_contig_list():
+    parts = partition_contigs_by_host([], 3)
+    assert parts == [[], [], []]
+
+
+def test_all_zero_weights_degenerates_to_one_per_host():
+    contigs = _contigs(0, 0, 0, 0, 0)
+    parts = partition_contigs_by_host(contigs, 3)
+    assert parts == [[contigs[0]], [contigs[1]], contigs[2:]]
+
+
+def test_zero_weight_contig_rides_open_partition():
+    contigs = _contigs(100, 0, 100)
+    parts = partition_contigs_by_host(contigs, 2)
+    # The zero-weight contig lands wherever the walk stands; contig 1
+    # closes host 0 exactly on the boundary (tie rule), so it rides host 1.
+    assert parts == [[contigs[0]], contigs[1:]]
+
+
+def test_custom_weight_function():
+    contigs = _contigs(1, 1, 1, 1)
+    weights = {c.reference_name: w for c, w in zip(contigs, (90, 10, 10, 10))}
+    parts = partition_contigs_by_host(
+        contigs, 2, weight=lambda c: weights[c.reference_name]
+    )
+    # 90 of 120 > the 60 fair share: host 0 closes after the first contig.
+    assert parts == [[contigs[0]], contigs[1:]]
+
+
+def test_determinism_across_calls():
+    contigs = _contigs(17, 93, 41, 8, 260, 55)
+    for hosts in (1, 2, 3, 4, 7):
+        first = partition_contigs_by_host(contigs, hosts)
+        assert first == partition_contigs_by_host(contigs, hosts)
+        assert [c for p in first for c in p] == contigs
+
+
+def test_negative_weight_raises():
+    with pytest.raises(ValueError, match="negative declared weight"):
+        partition_contigs_by_host(
+            _contigs(10), 2, weight=lambda c: -1
+        )
+
+
+def test_invalid_num_hosts_raises():
+    with pytest.raises(ValueError, match="num_hosts"):
+        partition_contigs_by_host(_contigs(10), 0)
+
+
+def test_host_partition_slices_and_validates():
+    contigs = _contigs(100, 100, 100, 100)
+    assert host_partition(contigs, 0, 2) == contigs[:2]
+    assert host_partition(contigs, 1, 2) == contigs[2:]
+    with pytest.raises(ValueError, match="process_index"):
+        host_partition(contigs, 2, 2)
+    with pytest.raises(ValueError, match="process_index"):
+        host_partition(contigs, -1, 2)
+
+
+def test_declared_sites_weights():
+    """The two weight providers: the base-range prior and the synthetic
+    source's exact site-grid span."""
+    from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource
+
+    source = SyntheticGenomicsSource(num_samples=4, seed=7, variant_spacing=100)
+    contig = Contig("17", 41196311, 41277499)
+    k0, k1 = source.site_grid_range(contig)
+    assert source.declared_sites(contig) == k1 - k0
+    # The ABC default (bases ∝ sites prior) via a minimal concrete source.
+    from spark_examples_tpu.sources.base import GenomicsSource
+
+    class _Stub(GenomicsSource):
+        def client(self):  # pragma: no cover - unused
+            raise NotImplementedError
+
+        def search_callsets(self, ids):  # pragma: no cover - unused
+            return []
+
+        def get_contigs(self, vs, sex_filter=None):  # pragma: no cover
+            return []
+
+    assert _Stub().declared_sites(contig) == contig.range
+    assert _Stub().declared_sites(Contig("x", 10, 4)) == 0
+
+
+def test_partitioned_gramian_merge_is_exact():
+    """The merge identity host-sharded ingest rests on: per-partition
+    XᵀX partials summed in int64 equal the whole-cohort Gramian exactly,
+    for ANY host count."""
+    rng = np.random.default_rng(7)
+    contigs = _contigs(3, 5, 2, 7, 4)
+    rows_by_contig = {
+        c.reference_name: rng.integers(0, 2, size=(c.range, 6), dtype=np.int64)
+        for c in contigs
+    }
+    whole = np.zeros((6, 6), dtype=np.int64)
+    for c in contigs:
+        X = rows_by_contig[c.reference_name]
+        whole += X.T @ X
+    for hosts in (1, 2, 3, 5, 8):
+        partials = []
+        for part in partition_contigs_by_host(contigs, hosts):
+            partial = np.zeros((6, 6), dtype=np.int64)
+            for c in part:
+                X = rows_by_contig[c.reference_name]
+                partial += X.T @ X
+            partials.append(partial)
+        merged = np.stack(partials).sum(axis=0)
+        assert np.array_equal(merged, whole)

@@ -148,10 +148,13 @@ class TestSchedMatrix:
     def test_default_matrix_proves_clean(self):
         report = run_audit()
         assert report.ok, "\n".join(f.format() for f in report.findings)
-        # Every multi-host topology carries its hier-vs-flat comparison,
-        # hier strictly below on the slow link.
+        # Every multi-host topology carries its hier-vs-flat comparison
+        # for BOTH ring kernels (the host-fed Gramian ring and the fused
+        # generation ring), hier strictly below on the slow link.
         multihost = [t for t in DEFAULT_TOPOLOGIES if t[0] > 1]
-        assert len(report.comparisons) == len(multihost)
+        assert len(report.comparisons) == 2 * len(multihost)
+        kernels = {comp.get("kernel") for comp in report.comparisons}
+        assert kernels == {"gramian", "devicegen"}
         for comp in report.comparisons:
             assert comp["hier_strictly_below"], comp
             assert comp["dcn_reduction"] > 1.0
@@ -473,23 +476,42 @@ class TestHierRuntime:
         bad = dict(block, kind="ring")
         assert validate_manifest(build_manifest(schedule=bad))
 
-    def test_device_ingest_rejects_explicit_hier(self, mesh):
-        # The fused generation ring pins the flat schedule; an explicit
-        # hier request must fail loudly, not silently run flat.
+    def test_device_ingest_runs_explicit_hier(self, mesh, monkeypatch):
+        # The generation ring speaks the two-level schedule: an explicit
+        # hier request (host factor from the rehearsal override) runs the
+        # hierarchical kernel and lands byte-identical to the flat run.
         from spark_examples_tpu.config import PcaConf
+        from spark_examples_tpu.parallel.mesh import HIER_HOSTS_ENV
         from spark_examples_tpu.pipeline.pca_driver import VariantsPcaDriver
 
-        conf = PcaConf.parse(
-            ["--num-samples", "16", "--references", "1:0:50000",
-             "--mesh-shape", "1,4", "--similarity-strategy", "sharded",
-             "--ingest", "device", "--reduce-schedule", "hier"]
-        )
+        argv = ["--num-samples", "16", "--references", "1:0:50000",
+                "--mesh-shape", "1,4", "--similarity-strategy", "sharded",
+                "--ingest", "device"]
+        monkeypatch.setenv(HIER_HOSTS_ENV, "2")
+        conf = PcaConf.parse(argv + ["--reduce-schedule", "hier"])
         driver = VariantsPcaDriver(conf)
-        with pytest.raises(ValueError, match="flat schedule"):
+        hier_res = np.asarray(
             driver.get_similarity_device_gen(
                 conf.get_contigs(driver.source, conf.variant_set_id)
             )
+        )
+        block = driver._sched_block
         driver.stop()
+        assert block["kind"] == "hier"
+        assert (block["hosts"], block["devices_per_host"]) == (2, 2)
+        assert block["predicted_dcn_bytes"] > 0
+        monkeypatch.delenv(HIER_HOSTS_ENV)
+        conf2 = PcaConf.parse(argv + ["--reduce-schedule", "flat"])
+        driver2 = VariantsPcaDriver(conf2)
+        flat_res = np.asarray(
+            driver2.get_similarity_device_gen(
+                conf2.get_contigs(driver2.source, conf2.variant_set_id)
+            )
+        )
+        flat_block = driver2._sched_block
+        driver2.stop()
+        assert flat_block["kind"] == "flat"
+        assert hier_res.tobytes() == flat_res.tobytes()
 
     def test_hierarchical_mesh_factorization(self, mesh):
         m3 = hierarchical_mesh(mesh, 2)
@@ -736,13 +758,58 @@ class TestPlanTopology:
             i.code == "topology-mesh-mismatch" for i in report.issues
         )
 
-    def test_hier_on_device_ingest_rejected(self):
+    def test_hier_on_device_ingest_accepted(self):
+        # The generation ring speaks the two-level schedule now
+        # (ops/devicegen.py:_ring_update + _hier_ring_tiles): an explicit
+        # hier request on device ingest validates instead of rejecting,
+        # and the topology proof traces the DEVICEGEN kernel.
         report = self._plan(
             self.BASE + ["--ingest", "device", "--reduce-schedule", "hier"]
         )
-        assert any(
-            i.code == "reduce-schedule-device-ingest" for i in report.issues
+        assert report.ok, [i.message for i in report.issues]
+        report = self._plan(
+            self.BASE + ["--ingest", "device", "--reduce-schedule", "hier",
+                         "--topology", "2,4"]
         )
+        assert report.ok, [i.message for i in report.issues]
+        assert report.geometry["sched_schedule"] == "hier"
+        assert report.geometry["sched_kernel"] == "devicegen"
+        assert report.geometry["sched_dcn_bytes"] > 0
+
+    def test_hier_host_factor_must_divide_samples_axis(self):
+        # The factorization invariant IS the static validation that
+        # replaced the blanket device-ingest rejection: a declared
+        # topology whose host count does not divide the declared samples
+        # axis cannot build the host-major mesh.
+        report = self._plan(
+            self.BASE + ["--reduce-schedule", "hier",
+                         "--mesh-shape", "1,9", "--plan-devices", "9",
+                         "--similarity-strategy", "sharded",
+                         "--topology", "2,4"]
+        )
+        assert any(
+            i.code == "hier-hosts-samples-axis" for i in report.issues
+        )
+
+    def test_hier_env_override_validated_offline(self, monkeypatch):
+        from spark_examples_tpu.parallel.mesh import HIER_HOSTS_ENV
+
+        monkeypatch.setenv(HIER_HOSTS_ENV, "3")
+        report = self._plan(
+            self.BASE + ["--reduce-schedule", "hier",
+                         "--mesh-shape", "1,8", "--plan-devices", "8",
+                         "--similarity-strategy", "sharded"]
+        )
+        assert any(
+            i.code == "hier-hosts-samples-axis" for i in report.issues
+        )
+        monkeypatch.setenv(HIER_HOSTS_ENV, "4")
+        report = self._plan(
+            self.BASE + ["--reduce-schedule", "hier",
+                         "--mesh-shape", "1,8", "--plan-devices", "8",
+                         "--similarity-strategy", "sharded"]
+        )
+        assert report.ok, [i.message for i in report.issues]
 
     def test_plan_devices_topology_mismatch(self):
         report = self._plan(

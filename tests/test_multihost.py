@@ -17,34 +17,52 @@ from spark_examples_tpu.parallel.multihost import verify_multihost
 
 def test_two_process_distributed_run():
     """Phase 1: (a) data-parallel device ingest over the global 2×4-device
-    mesh with the cross-slice finalize reduce, and (b) ring ingest over the
-    samples-only mesh whose ppermute hops cross the process boundary —
-    Gramians == host oracle in both processes. Phase 2: the unmodified
-    variants-pca CLI across two coordinator-connected processes prints
-    byte-identical principal components."""
+    mesh with the cross-slice finalize reduce, (b) ring ingest over the
+    samples-only mesh whose ppermute hops cross the process boundary, and
+    (c) the hierarchical two-level schedule on that same ring (host factor
+    2) — all Gramians == host oracle in both processes. Phase 2: the fleet
+    rehearsal — host-sharded ingest over four contigs (each process reads
+    ~1/2 of the solo bases), PC rows byte-identical to the solo oracle,
+    per-host conformance bounds hold, and the per-process flight-recorder
+    segments merge into one valid Chrome trace."""
     report = verify_multihost(num_processes=2, local_devices=4)
     assert report["gramian_ok"], json.dumps(report, indent=2)
     assert report["ring_gramian_ok"], json.dumps(report, indent=2)
+    assert report["hier_gramian_ok"], json.dumps(report, indent=2)
     # The global results must actually span both processes — otherwise this
     # test would silently degrade into a single-controller run.
     assert report["result_spans_processes"], json.dumps(report, indent=2)
     for child in report["children"]:
         assert child["global_devices"] == 8, child
         assert child["local_devices"] == 4, child
+        assert child["hier_schedule_kind"] == "hier", child
     assert report["cli_ok"], json.dumps(report, indent=2)
     assert report["cli_outputs_identical"], json.dumps(report, indent=2)
     assert report["cli_pc_lines"] == 24, json.dumps(report, indent=2)
+    assert report["fleet_host_sharded"], json.dumps(report, indent=2)
+    assert report["fleet_io_ok"], json.dumps(report, indent=2)
+    # Two processes over four equal windows: the split is exactly half —
+    # per-process ingest strictly below the solo total.
+    bases = report["fleet_io_reference_bases"]
+    assert sum(bases["per_process"]) == bases["solo"]
+    assert all(0 < b < bases["solo"] for b in bases["per_process"])
+    assert report["fleet_conformance_ok"], json.dumps(report, indent=2)
+    assert report["fleet_trace_ok"], json.dumps(report, indent=2)
 
 
 def test_three_process_distributed_run_non_power_of_two():
     """Three coordinator-connected processes, 2 devices each — a 6-device
     global fleet. Non-power-of-two process counts exercise the shapes the
     2×4 run cannot: the data-axis round-robin hands UNEVEN dispatch counts
-    to the slices (7 grid groups over 6 slices), and the ring exchange runs
-    6 ppermute hops with 4 of every 6 crossing a process boundary."""
+    to the slices (7 grid groups over 6 slices), the ring exchange runs
+    6 ppermute hops with 4 of every 6 crossing a process boundary, and the
+    hier schedule factors the samples axis 3×2. The fleet rehearsal's
+    4-contig split over 3 hosts is uneven by construction ([2,1,1]) — the
+    1/H+overshoot bound and the exact partition-sum still hold."""
     report = verify_multihost(num_processes=3, local_devices=2)
     assert report["gramian_ok"], json.dumps(report, indent=2)
     assert report["ring_gramian_ok"], json.dumps(report, indent=2)
+    assert report["hier_gramian_ok"], json.dumps(report, indent=2)
     assert report["result_spans_processes"], json.dumps(report, indent=2)
     for child in report["children"]:
         assert child["global_devices"] == 6, child
@@ -52,6 +70,10 @@ def test_three_process_distributed_run_non_power_of_two():
     assert report["cli_ok"], json.dumps(report, indent=2)
     assert report["cli_outputs_identical"], json.dumps(report, indent=2)
     assert report["cli_pc_lines"] == 24, json.dumps(report, indent=2)
+    assert report["fleet_host_sharded"], json.dumps(report, indent=2)
+    assert report["fleet_io_ok"], json.dumps(report, indent=2)
+    assert report["fleet_conformance_ok"], json.dumps(report, indent=2)
+    assert report["fleet_trace_ok"], json.dumps(report, indent=2)
 
 
 def test_child_cli_exits_nonzero_on_bad_coordinator():

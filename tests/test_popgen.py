@@ -169,6 +169,15 @@ class TestSiteOutputWriter:
         with pytest.raises(ValueError, match="closed"):
             writer.write_rows([(1,)])
 
+    def test_headerless_part_file_bytes(self, tmp_path):
+        """``header=None`` writes no header line at all — the reference's
+        saveAsTextFile part files (reads examples) are headerless and must
+        stay byte-identical when routed through the streaming writer."""
+        path = str(tmp_path / "part-00000")
+        with SiteOutputWriter(path) as writer:
+            writer.write_rows([("(1000,3)",), ("(1001,2)",)])
+        assert open(path).read() == "(1000,3)\n(1001,2)\n"
+
 
 # ------------------------------------------------------- shared admission
 
