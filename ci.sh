@@ -36,12 +36,16 @@
 #      to solo, per-host conformance bounds ok in every process manifest,
 #      and the per-process flight-recorder segments merged into one
 #      validate_chrome_trace-clean Chrome trace.
-#   2d. hostmem — graftcheck hostmem (AST host-memory audit: the tree must
-#      be clean, every O(file) site a justified hostmem(unbounded)
-#      declaration) + the --host-mem-budget smoke on the 4-virtual-device
-#      synthetic config: a generous budget must plan OK, a 1 MiB budget
-#      must exit 2 — the static bound (parallel/mesh.py:host_peak_bytes)
-#      is enforced, not just printed.
+#   2d. hostmem — graftcheck hostmem (AST host-memory audit: ZERO
+#      findings and an EMPTY declared_unbounded inventory — the
+#      escape-hatch era is over, GH006 flags the syntax itself) + the
+#      --host-mem-budget smoke on the 4-virtual-device synthetic config
+#      (a generous budget must plan OK, a 1 MiB budget must exit 2 — the
+#      static bound, parallel/mesh.py:host_peak_bytes, is enforced, not
+#      just printed) + the wire-ingest budget smoke: generated JSONL and
+#      SAM inputs plan OK under an 8 GiB budget (the retired
+#      "unprovable" class) and the JSONL run's measured peak RSS must
+#      sit under its manifest's static bound.
 #   3. obs smoke — a tiny synthetic PCA run with --metrics-json and a
 #      1 s heartbeat; the produced run manifest must validate against the
 #      schema (obs/manifest.py:validate_manifest), carry I/O stats, and
@@ -261,6 +265,19 @@ rm -rf "$MH_TMP"
 echo "== hostmem stage (graftcheck hostmem + host-memory budget) =="
 hm_rc=0
 env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck hostmem || hm_rc=$?
+# TOTAL: the declared-unbounded inventory must be EMPTY — a hatch is a
+# GH006 finding now, and this assert catches any report-plumbing drift.
+env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck hostmem --json \
+  | python -c '
+import json, sys
+doc = json.load(sys.stdin)
+if doc["declared_unbounded"] != []:
+    print("hostmem inventory NOT empty:", doc["declared_unbounded"])
+    sys.exit(1)
+if doc["finding_count"] != 0:
+    print("hostmem findings present:", doc["findings"]); sys.exit(1)
+print("hostmem totality OK (0 findings, declared_unbounded == [])")
+' || hm_rc=$?
 hm_flags="--num-samples 64 --references 1:0:400000 --mesh-shape 1,4 \
   --similarity-strategy sharded --block-size 64 --plan-devices 4"
 env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck plan $hm_flags \
@@ -273,6 +290,68 @@ if [ "$?" -ne 2 ]; then
 else
   echo "hostmem budget smoke OK (in-budget plan OK, over-budget exit 2)"
 fi
+
+# Wire-ingest budget smoke: JSONL and SAM inputs under --host-mem-budget
+# were the exit-2 "unprovable" class; with the total resolver a real file
+# proves a tight bound from its bytes on disk and the plan exits 0. The
+# JSONL conf then RUNS, and its manifest's measured peak RSS must sit
+# under the same static bound the plan proved (the e2e conformance leg).
+WIRE_TMP=$(mktemp -d)
+python - "$WIRE_TMP" <<'PYEOF'
+import json, sys
+root = sys.argv[1]
+with open(f"{root}/cohort.jsonl", "w") as f:
+    for i in range(64):
+        f.write(json.dumps({
+            "referenceName": "17", "start": 100 + 10 * i, "end": 101 + 10 * i,
+            "referenceBases": "A", "alternateBases": ["G"],
+            "info": {"AF": ["0.5"]},
+            "calls": [
+                {"callSetId": f"j-{s}", "callSetName": f"S{s}",
+                 "genotype": [1, 0] if (i + s) % 2 else [0, 0]}
+                for s in range(4)
+            ],
+        }) + "\n")
+with open(f"{root}/reads.sam", "w") as f:
+    f.write("@HD\tVN:1.6\n@SQ\tSN:21\tLN:48129895\n")
+    for i in range(20):
+        f.write(f"r{i:03d}\t0\t21\t{1000 + 5 * i}\t60\t40M\t*\t0\t0\t"
+                f"{'ACGT' * 10}\t{'F' * 40}\n")
+PYEOF
+for wire_input in "$WIRE_TMP/cohort.jsonl" "$WIRE_TMP/reads.sam"; do
+  env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck plan \
+    --source file --input-files "$wire_input" --ingest wire \
+    --num-samples 4 --references 17:0:1000 \
+    --host-mem-budget 8589934592 > /dev/null || {
+      echo "wire budget smoke: $(basename "$wire_input") plan not provable"
+      hm_rc=1; }
+done
+wire_rc=0
+env JAX_PLATFORMS=cpu SPARK_EXAMPLES_TPU_NO_CACHE=1 \
+  python -m spark_examples_tpu variants-pca \
+    --source file --input-files "$WIRE_TMP/cohort.jsonl" --ingest wire \
+    --references 17:0:1000 --metrics-json "$WIRE_TMP/manifest.json" \
+    > /dev/null 2> "$WIRE_TMP/wire.err" || wire_rc=$?
+if [ "$wire_rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python - "$WIRE_TMP/manifest.json" <<'PYEOF' || hm_rc=$?
+import sys
+from spark_examples_tpu.obs.manifest import read_manifest
+hm = read_manifest(sys.argv[1])["host_memory"]
+if not hm["peak_rss_bytes"] or not hm["static_bound_bytes"]:
+    print(f"wire manifest host_memory incomplete: {hm}"); sys.exit(1)
+if hm["peak_rss_bytes"] > hm["static_bound_bytes"]:
+    print("wire run measured peak RSS EXCEEDS the static bound: "
+          f"{hm['peak_rss_bytes']} > {hm['static_bound_bytes']}")
+    sys.exit(1)
+print(f"wire budget smoke OK (JSONL+SAM provable; measured "
+      f"{hm['peak_rss_bytes'] >> 20} MiB <= bound "
+      f"{hm['static_bound_bytes'] >> 20} MiB)")
+PYEOF
+else
+  echo "wire budget smoke run failed (rc=$wire_rc):"
+  tail -10 "$WIRE_TMP/wire.err"; hm_rc=1
+fi
+rm -rf "$WIRE_TMP"
 
 echo "== observability smoke (run manifest schema) =="
 obs_rc=0

@@ -15,27 +15,35 @@ ring-traffic formula against the traced kernels:
   inside the read loop, ``list()`` materialization of a block stream,
   one-shot ``*.decompress``, and whole-buffer numpy staging.
 
-Paths that are *honestly* O(file) today (the packed whole-file VCF parse,
-checkpoint resume) are **declared**, not silently passed::
+The audit is a **totality proof** (DESIGN.md §8.6): since every source
+moved onto the windowed stream abstraction (``sources/stream.py``), the
+``hostmem(unbounded)`` escape hatch that used to *declare* an O(file)
+site::
 
     raw = f.read()  # graftcheck: hostmem(unbounded) -- packed whole-file parse needs the contiguous buffer
 
-A declared site passes the audit but lands in the report's
-``declared_unbounded`` inventory — the machine-readable worklist of the
-streaming-everywhere refactor (DESIGN.md §8.6). A hatch without a
-justification does not count.
+is itself a finding (GH006, *declared-unbounded-forbidden*) — the tree
+must prove boundedness, not declare its absence. A justified hatch still
+routes its underlying GH00x finding into the report's
+``declared_unbounded`` inventory (so the report says what the hatch
+hides), but the hatch line fails the audit regardless; the shipped tree
+carries none.
 
 The formula half lives in ``parallel/mesh.py:host_peak_bytes`` (the
 sibling of ``ring_traffic_bytes``); :func:`conf_host_peak_bytes` resolves
-one parsed configuration into that closed form — shared by ``graftcheck
-plan --host-mem-budget`` and the driver's ``host_static_bound_bytes``
-gauge, so the budget the validator enforces and the bound the manifest
-records can never drift. The loop closes at runtime: the manifest's
-``host_memory`` block carries measured peak RSS next to this bound, and
-CI asserts measured <= static on every build.
+one parsed configuration into that closed form — TOTAL over the conf
+surface: a finite bound for every (source kind x ingest mode x analysis
+x serve job kind), never ``None`` — shared by ``graftcheck plan
+--host-mem-budget`` and the driver's ``host_static_bound_bytes`` gauge,
+so the budget the validator enforces and the bound the manifest records
+can never drift. The loop closes at runtime: the budgeted accumulators
+(``sources/stream.py``) enforce the same row bounds the formula charges
+(``StreamBudgetError`` past capacity), the manifest's ``host_memory``
+block carries measured peak RSS next to this bound, and CI asserts
+measured <= static on every build.
 
-Exit contract (``check/cli.py``): 0 = clean (declared sites allowed),
-1 = undeclared O(file) findings.
+Exit contract (``check/cli.py``): 0 = clean, 1 = findings (an escape
+hatch now counts as one).
 """
 
 from __future__ import annotations
@@ -92,6 +100,15 @@ _STREAM_PRODUCERS = frozenset(
         "genotype_blocks",
         "iter_shards",
         "iter_part",
+        # sources/stream.py — the one windowed abstraction (its consumers
+        # are everywhere; accumulating its items is exactly the O(file)
+        # regression this audit exists to catch).
+        "iter_byte_windows",
+        "iter_text_lines",
+        "windowed",
+        "iter_records",
+        "merge_join",
+        "_iter_jsonl_lines",
     }
 )
 
@@ -119,6 +136,18 @@ _HATCH_RE = re.compile(
 )
 
 
+def iter_hatch_comments(source: str) -> List[Tuple[int, int]]:
+    """``(line, col)`` of every ``hostmem(unbounded)`` hatch comment,
+    justified or not — GH006's subjects: the hatch SYNTAX is forbidden
+    now that the declared inventory hit zero."""
+    out: List[Tuple[int, int]] = []
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = _HATCH_RE.search(line)
+        if m is not None:
+            out.append((lineno, m.start() + 1))
+    return out
+
+
 def parse_hostmem_hatches(source: str) -> Dict[int, str]:
     """``{line: justification}`` for every JUSTIFIED hostmem(unbounded)
     hatch; a hatch with no ``-- why`` text is ignored (declaring a site
@@ -126,7 +155,12 @@ def parse_hostmem_hatches(source: str) -> Dict[int, str]:
 
     A trailing hatch declares its own line; a comment-ONLY hatch line
     declares the next line (justifications routinely outgrow the code
-    line — the same layout the ``# lock order:`` idiom uses)."""
+    line — the same layout the ``# lock order:`` idiom uses).
+
+    Note the hatch no longer PASSES anything: it routes the underlying
+    GH00x finding into the report's ``declared_unbounded`` inventory for
+    context, while GH006 flags the hatch line itself (see
+    :func:`audit_source`)."""
     hatches: Dict[int, str] = {}
     for lineno, line in enumerate(source.splitlines(), start=1):
         m = _HATCH_RE.search(line)
@@ -548,9 +582,14 @@ class _HostmemVisitor(ast.NodeVisitor):
 def audit_source(
     source: str, relpath: str
 ) -> Tuple[List[Finding], List[DeclaredSite]]:
-    """Audit one file's text. Returns ``(undeclared findings, declared
-    sites)``; a finding on a line carrying a justified
-    ``hostmem(unbounded)`` hatch moves to the declared inventory."""
+    """Audit one file's text. Returns ``(findings, declared sites)``.
+
+    A finding on a line carrying a justified ``hostmem(unbounded)`` hatch
+    moves to the declared inventory — the report still says WHAT a hatch
+    hides — but the hatch itself fires GH006 (*declared-unbounded-
+    forbidden*): with the inventory at zero and every source on the
+    windowed stream abstraction, the hatch syntax is a finding, not a
+    declaration, so the audit can never pass with one present."""
     tree = ast.parse(source, filename=relpath)
     alias = _collect_aliases(tree)
     visitor = _HostmemVisitor(relpath, alias)
@@ -566,6 +605,22 @@ def audit_source(
             )
         else:
             findings.append(f)
+    gh006 = HOSTMEM_RULES["GH006"]
+    if gh006.applies_to(relpath):
+        for lineno, col in iter_hatch_comments(source):
+            findings.append(
+                Finding(
+                    "GH006",
+                    relpath,
+                    lineno,
+                    col,
+                    "hostmem(unbounded) escape hatch: the declared-"
+                    "inventory era is over — refactor the site through "
+                    "the windowed stream abstraction (sources/stream.py) "
+                    "instead of declaring it O(file)",
+                )
+            )
+        findings.sort(key=lambda f: (f.line, f.rule_id, f.col))
     return findings, declared
 
 
@@ -652,12 +707,12 @@ def conf_mesh_axes(conf: Any, device_count: Optional[int]) -> Tuple[int, int]:
 
 
 def _streamable_vcf_input(conf: Any) -> bool:
-    """Whether the configured file ingest is the ONE shape that actually
-    streams (``FileGenomicsSource.wants_streaming``'s static mirror): a
-    single variant set whose selected input is a ``.vcf[.gz]`` file.
-    JSONL/SAM inputs and checkpoint directories never stream — their
-    whole-file tables are declared ``hostmem(unbounded)`` sites — and
-    multi-set configs take the wire join."""
+    """Whether the configured file ingest is the packed-streaming shape
+    (``FileGenomicsSource.wants_streaming``'s static mirror): a single
+    variant set whose selected input is a ``.vcf[.gz]`` file. Everything
+    else (JSONL/SAM, checkpoint directories, multi-set configs) takes the
+    wire-table path, which is bounded by its own closed-form term now —
+    this predicate picks the FORMULA, it no longer gates provability."""
     input_files = list(getattr(conf, "input_files", None) or [])
     set_ids = list(getattr(conf, "variant_set_id", None) or [])
     if not input_files or len(set_ids) != 1:
@@ -672,14 +727,84 @@ def _streamable_vcf_input(conf: Any) -> bool:
     return lowered.endswith(".vcf")
 
 
+def _selected_paths(conf: Any) -> List[str]:
+    """The input paths a file-source run of ``conf`` would actually read:
+    ``--input-files`` filtered to the selected ``--variant-set-id``s (the
+    same id derivation ``sources/files.py:file_set_ids`` applies), all of
+    them when no set filter is configured or an id fails to resolve."""
+    input_files = [str(p) for p in (getattr(conf, "input_files", None) or [])]
+    set_ids = list(getattr(conf, "variant_set_id", None) or [])
+    if not input_files:
+        return []
+    if not set_ids:
+        return input_files
+    from spark_examples_tpu.sources.files import file_set_ids
+
+    by_id = dict(zip(file_set_ids(input_files), input_files))
+    selected = [by_id[s] for s in set_ids if s in by_id]
+    return selected if selected else input_files
+
+
+def _wire_record_bytes(num_samples: int) -> int:
+    """Conservative host bytes of ONE wire/JSONL/SAM record object: a
+    fixed per-record envelope (dict + key strings + position/id scalars)
+    plus the per-sample call payload (one small int/str cell per sample
+    after decode). 128 bytes/sample dominates any decoded call cell
+    CPython allocates; 256 dominates the envelope."""
+    return 256 + 128 * int(num_samples)
+
+
+def _rows_bound_or_contract(path: str) -> int:
+    """Total candidate rows one input path can yield, from the bytes on
+    disk (``stream.wire_rows_bound``: min-line-width over the decompressed
+    size bound), falling back to the DECLARED production geometry ceiling
+    (``ops/contracts.py:DECLARED_MAX_SITES``) for paths that cannot be
+    statted (plan-time validation of a path that does not exist yet) or
+    directories with nothing listable. Always finite, never raises."""
+    from spark_examples_tpu.ops.contracts import DECLARED_MAX_SITES
+    from spark_examples_tpu.sources.stream import wire_rows_bound
+
+    try:
+        if os.path.isdir(path):
+            rows = 0
+            for name in sorted(os.listdir(path)):
+                full = os.path.join(path, name)
+                if os.path.isfile(full):
+                    rows += wire_rows_bound(full)
+            return rows if rows > 0 else DECLARED_MAX_SITES
+        if os.path.isfile(path):
+            rows = wire_rows_bound(path)
+            return rows if rows > 0 else DECLARED_MAX_SITES
+    except OSError:
+        pass
+    return DECLARED_MAX_SITES
+
+
+def _wire_table_term(rows: int, num_samples: int) -> int:
+    """Host-resident bytes of one wire-ingest table of ``rows`` records:
+    the spool index (``SPOOL_INDEX_BYTES_PER_ROW`` per row) plus — taken
+    conservatively as fully co-resident — every decoded record, plus four
+    stream windows (reader carry + decode + spool write-behind)."""
+    from spark_examples_tpu.sources.stream import (
+        DEFAULT_WINDOW_BYTES,
+        SPOOL_INDEX_BYTES_PER_ROW,
+    )
+
+    per_row = SPOOL_INDEX_BYTES_PER_ROW + _wire_record_bytes(num_samples)
+    return int(rows) * per_row + 4 * DEFAULT_WINDOW_BYTES
+
+
 def conf_host_peak_bytes(
     conf: Any,
     device_count: Optional[int] = None,
     num_samples: Optional[int] = None,
     num_hosts: int = 1,
-) -> Optional[int]:
-    """``host_peak_bytes`` for one parsed configuration, or ``None`` when
-    the configured ingest path is O(file) — no static bound exists for it.
+) -> int:
+    """``host_peak_bytes`` for one parsed configuration. TOTAL: every
+    (source kind x ingest mode x analysis x serve job kind) resolves to a
+    finite closed-form bound — there is no ``None`` arm left, because
+    every ingest path now runs through the windowed stream abstraction
+    (``sources/stream.py``) whose residency is a formula, not the file.
 
     ``num_samples`` overrides the flag value with the DISCOVERED cohort
     width (file sources carry their cohort in the data; the driver passes
@@ -688,42 +813,77 @@ def conf_host_peak_bytes(
     PER-HOST bound (the driver passes ``jax.process_count()``; offline
     validation stays at 1).
 
-    Bounded paths (the formula's domain):
+    Per-path terms, all monotone in the cohort width:
 
-    - synthetic source, every ingest mode (the data plane is generated per
-      window; nothing whole-file ever stages on host);
-    - a SINGLE ``.vcf[.gz]`` file set on the packed/auto ingest with
-      EXPLICIT streaming (``--stream-chunk-bytes N > 0``): one pass,
-      O(workers x chunk) parse staging. Only VCFs stream
-      (``FileGenomicsSource.wants_streaming``); JSONL/SAM/checkpoint
-      inputs always stage whole-file tables, and multi-set file configs
-      take the wire join — claiming a bound there would be a false proof.
-
-    Everything else is data-dependent host memory today — auto streaming
-    (the decision needs the file size), the in-memory packed parse, wire
-    file/REST ingest, and checkpoint resume (``--input-path``) — and
-    returns ``None``: the declared ``hostmem(unbounded)`` inventory, not
-    the formula, owns those paths until the streaming refactor lands.
+    - synthetic: the device-generation path stages nothing whole-file;
+      only the runtime baseline and analysis terms apply.
+    - file, single ``.vcf[.gz]`` set, packed/auto ingest: one streamed
+      pass (O(workers x chunk) parse staging at the explicit
+      ``--stream-chunk-bytes`` or the ``sources/files.py`` default) plus
+      the packed columns' build/hand-off co-residency,
+      ``2 x rows x (N + 48)`` (int8 genotype row + per-site metadata,
+      builder AND final array alive across the final copy).
+    - file wire / JSONL / SAM / multi-set: the wire-table term per
+      selected input (spool index + conservatively co-resident decoded
+      records + stream windows), plus a merge-join term
+      ``n_sets x 64 x record_bytes`` when joining (64 = the per-stream
+      tracked-group ceiling ``stream.merge_join`` accounts against).
+    - REST: one wire table at the declared geometry ceiling
+      (``DECLARED_MAX_SITES`` rows — the pagination protocol carries no
+      size upfront, so the production contract is the bound).
+    - checkpoint resume (``--input-path``): the wire-table term over the
+      journal directory's parts (sizes from disk when statable, the
+      geometry ceiling otherwise).
     """
     from spark_examples_tpu.parallel.mesh import host_peak_bytes
     from spark_examples_tpu.sources.files import _resolve_ingest_workers
 
-    if getattr(conf, "input_path", None):
-        return None
+    if num_samples is None:
+        num_samples = int(conf.num_samples)
+    n = int(num_samples)
     source = getattr(conf, "source", "synthetic")
     stream_chunk = getattr(conf, "stream_chunk_bytes", None)
     ingest = getattr(conf, "ingest", "auto")
     chunk_bytes = 0
-    if source == "file":
-        if ingest == "wire":
-            return None
-        if not stream_chunk or stream_chunk <= 0:
-            return None
-        if not _streamable_vcf_input(conf):
-            return None
-        chunk_bytes = int(stream_chunk)
-    elif source != "synthetic":
-        return None  # REST wire ingest materializes per-shard record pages
+    wire_table_bytes = 0
+    merge_join_bytes = 0
+    input_path = getattr(conf, "input_path", None)
+    if input_path:
+        # Checkpoint resume replays journal parts through the windowed
+        # JSONL reader into one wire table; charge it like any wire input.
+        wire_table_bytes = _wire_table_term(
+            _rows_bound_or_contract(str(input_path)), n
+        )
+    elif source == "file":
+        if ingest != "wire" and _streamable_vcf_input(conf):
+            from spark_examples_tpu.sources.files import STREAM_CHUNK_BYTES
+
+            chunk_bytes = (
+                int(stream_chunk)
+                if stream_chunk and stream_chunk > 0
+                else STREAM_CHUNK_BYTES
+            )
+            rows = _rows_bound_or_contract(_selected_paths(conf)[0])
+            wire_table_bytes = 2 * rows * (n + 48)
+        else:
+            paths = _selected_paths(conf)
+            wire_table_bytes = sum(
+                _wire_table_term(_rows_bound_or_contract(p), n)
+                for p in paths
+            )
+            if len(paths) > 1:
+                merge_join_bytes = (
+                    len(paths) * 64 * _wire_record_bytes(n)
+                )
+    elif source == "rest":
+        from spark_examples_tpu.ops.contracts import DECLARED_MAX_SITES
+
+        set_ids = list(getattr(conf, "variant_set_id", None) or [None])
+        wire_table_bytes = len(set_ids) * _wire_table_term(
+            DECLARED_MAX_SITES, n
+        )
+        if len(set_ids) > 1:
+            merge_join_bytes = len(set_ids) * 64 * _wire_record_bytes(n)
     workers = _resolve_ingest_workers(getattr(conf, "ingest_workers", None))
     data, _samples = conf_mesh_axes(conf, device_count)
     # Mirrors pipeline/pca_driver._similarity_stage: a depth-2
@@ -734,12 +894,10 @@ def conf_host_peak_bytes(
     prefetch_depth = 2 if workers > 0 else 0
     pipeline_depth = 2 if workers > 0 else 0
     host_backend = getattr(conf, "pca_backend", "tpu") == "host"
-    if num_samples is None:
-        num_samples = int(conf.num_samples)
     from spark_examples_tpu.config import AssocConf, GrmConf, LdConf
 
     return host_peak_bytes(
-        num_samples=int(num_samples),
+        num_samples=n,
         block_size=int(conf.block_size),
         data_axis=data,
         ingest_workers=workers,
@@ -766,6 +924,8 @@ def conf_host_peak_bytes(
             else 0
         ),
         num_hosts=int(num_hosts),
+        wire_table_bytes=wire_table_bytes,
+        merge_join_bytes=merge_join_bytes,
     )
 
 
@@ -777,5 +937,6 @@ __all__ = [
     "conf_host_peak_bytes",
     "conf_mesh_axes",
     "default_hostmem_paths",
+    "iter_hatch_comments",
     "parse_hostmem_hatches",
 ]

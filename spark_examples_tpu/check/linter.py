@@ -77,6 +77,17 @@ _RANGE_COMMENT_WINDOW = 6
 #: spelling besides the .astype method).
 _CONVERT_FNS = ("jax.lax.convert_element_type", "lax.convert_element_type")
 
+#: GC012: callables whose result is a file handle. A READ-mode handle in
+#: ``sources/``/``pipeline/`` may only live inside the one windowed stream
+#: abstraction (``sources/stream.py``) — anywhere else, iterating it or
+#: calling ``.read*()`` on it is the raw-ingest shape the hostmem totality
+#: proof exists to keep out of the tree.
+_FILE_OPEN_FNS = ("open", "io.open", "gzip.open", "bz2.open", "lzma.open")
+
+#: The one module allowed to touch raw read handles (it IS the stream
+#: abstraction), exempt from GC012 by construction.
+_STREAM_MODULE = "sources/stream.py"
+
 #: numpy calls that are trace-time constants, not host compute: dtype
 #: constructors used as astype/array arguments. These run on Python
 #: scalars/metadata, never on traced values, and are pervasive legitimate
@@ -227,6 +238,9 @@ class _LintVisitor(ast.NodeVisitor):
         self._shard_map_depth = 0
         #: Per-function-scope set of names assigned from jnp expressions.
         self._jnp_names: List[Set[str]] = []
+        #: Per-scope read-mode file-handle names (GC012); index 0 is the
+        #: module scope.
+        self._read_handles: List[Set[str]] = [set()]
 
     # ------------------------------------------------------------- plumbing
 
@@ -256,6 +270,47 @@ class _LintVisitor(ast.NodeVisitor):
             "range:" in line or "ops/contracts" in line for line in window
         )
 
+    # ------------------------------------------------------ GC012 (raw file)
+
+    def _read_mode_open(self, node: ast.expr) -> bool:
+        """Whether a call opens a file for READING (default mode counts;
+        an unresolvable dynamic mode is conservatively read — the stream
+        abstraction is where dynamic file plumbing belongs anyway)."""
+        if not isinstance(node, ast.Call):
+            return False
+        if _dotted(node.func, self.alias) not in _FILE_OPEN_FNS:
+            return False
+        mode = None
+        if len(node.args) >= 2:
+            mode = node.args[1]
+        else:
+            for kw in node.keywords:
+                if kw.arg == "mode":
+                    mode = kw.value
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+            return not any(c in mode.value for c in "wax")
+        return True
+
+    def _bind_read_handles(self, value: ast.expr, target: ast.expr) -> None:
+        if (
+            self.relpath != _STREAM_MODULE
+            and self._read_mode_open(value)
+            and isinstance(target, ast.Name)
+        ):
+            self._read_handles[-1].add(target.id)
+
+    def _is_raw_handle_iter(self, node: ast.expr) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self._read_handles[-1]
+        if isinstance(node, ast.Call) and _dotted(node.func, self.alias) in (
+            "enumerate",
+            "zip",
+            "iter",
+            "reversed",
+        ):
+            return any(self._is_raw_handle_iter(arg) for arg in node.args)
+        return False
+
     # ------------------------------------------------------------ functions
 
     def _visit_function(self, node) -> None:
@@ -281,10 +336,12 @@ class _LintVisitor(ast.NodeVisitor):
             self._check_donation(node, jit_kwargs)
         self._func_depth += 1
         self._jnp_names.append(set())
+        self._read_handles.append(set())
         # Loops outside don't lexically contain this body's dispatches.
         outer_loop_depth, self._loop_depth = self._loop_depth, 0
         self.generic_visit(node)
         self._loop_depth = outer_loop_depth
+        self._read_handles.pop()
         self._jnp_names.pop()
         self._func_depth -= 1
         if ctx is not None:
@@ -299,8 +356,20 @@ class _LintVisitor(ast.NodeVisitor):
         # A lambda body runs at CALL time: module-level `f = lambda x:
         # jnp.sum(x)` must not trip the import-time rule (GC004).
         self._func_depth += 1
+        self._read_handles.append(set())
         self.generic_visit(node)
+        self._read_handles.pop()
         self._func_depth -= 1
+
+    def visit_With(self, node: ast.With) -> None:
+        for item in node.items:
+            if isinstance(item.optional_vars, ast.Name):
+                self._bind_read_handles(
+                    item.context_expr, item.optional_vars
+                )
+        self.generic_visit(node)
+
+    visit_AsyncWith = visit_With  # type: ignore[assignment]
 
     def _check_donation(self, node, jit_kwargs: Dict[str, ast.expr]) -> None:
         """GC005: jitted accumulator-shaped updates must donate (or carry a
@@ -328,6 +397,17 @@ class _LintVisitor(ast.NodeVisitor):
     # ---------------------------------------------------------------- loops
 
     def _visit_loop(self, node) -> None:
+        if isinstance(
+            node, (ast.For, ast.AsyncFor)
+        ) and self._is_raw_handle_iter(node.iter):
+            self.emit(
+                "GC012",
+                node,
+                "iterating a raw read-mode file handle outside the stream "
+                "abstraction; route the read through sources/stream.py "
+                "(iter_text_lines/iter_byte_windows) so the hostmem "
+                "totality proof covers it",
+            )
         self._loop_depth += 1
         self.generic_visit(node)
         self._loop_depth -= 1
@@ -404,6 +484,8 @@ class _LintVisitor(ast.NodeVisitor):
             for target in node.targets:
                 if isinstance(target, ast.Name):
                     self._jnp_names[-1].add(target.id)
+        for target in node.targets:
+            self._bind_read_handles(node.value, target)
         self.generic_visit(node)
 
     # ------------------------------------------------- GC009 (stats bypass)
@@ -519,6 +601,23 @@ class _LintVisitor(ast.NodeVisitor):
                 "on the HOST at trace time: it crashes on tracers or "
                 "silently bakes a trace-time constant into the compiled "
                 "program; use the jnp equivalent",
+            )
+
+        # GC012: .read*() on a raw read-mode handle outside stream.py.
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("read", "read1", "readline", "readlines")
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in self._read_handles[-1]
+        ):
+            self.emit(
+                "GC012",
+                node,
+                f"`{node.func.value.id}.{node.func.attr}()` on a raw "
+                "read-mode file handle outside the stream abstraction; "
+                "route the read through sources/stream.py "
+                "(open_binary/iter_byte_windows) so the hostmem totality "
+                "proof covers it",
             )
 
         # GC011: narrowing cast without a range justification.

@@ -1029,43 +1029,24 @@ def _check_host_memory(
     """Host-memory facts + budget enforcement: the static bound from the
     ONE formula (``parallel/mesh.py:host_peak_bytes``, resolved through
     ``check/hostmem.py:conf_host_peak_bytes`` — the same resolver the
-    driver's ``host_static_bound_bytes`` gauge uses). Bounded ingest paths
-    get the bound as a geometry fact and, under ``--host-mem-budget``, an
-    over-budget error; an O(file) path under a budget is rejected too —
-    the flag asks for a proof the configuration cannot give."""
+    driver's ``host_static_bound_bytes`` gauge uses). The resolver is
+    TOTAL: every configuration — wire ingest, JSONL/SAM, REST, multi-set
+    joins, checkpoint resume — gets a finite bound as a geometry fact,
+    so ``--host-mem-budget`` is enforceable against ANY workload; the
+    only failure mode left is a bound genuinely over budget."""
     from spark_examples_tpu.check.hostmem import conf_host_peak_bytes
 
     bound = conf_host_peak_bytes(conf, device_count=plan_devices)
-    if bound is not None:
-        report.geometry["host_peak_bytes"] = bound
-        if host_mem_budget is not None and bound > host_mem_budget:
-            report.error(
-                "host-mem-over-budget",
-                f"static host-memory bound ~{bound / (1 << 30):.2f} GiB "
-                f"(parallel/mesh.py:host_peak_bytes) exceeds "
-                f"--host-mem-budget {host_mem_budget} "
-                f"({host_mem_budget / (1 << 30):.2f} GiB); shrink the "
-                "ingest window (--stream-chunk-bytes, --ingest-workers, "
-                "--block-size) or raise the budget",
-            )
-        return
-    report.geometry["host_peak_bytes"] = None
-    if host_mem_budget is not None:
+    report.geometry["host_peak_bytes"] = bound
+    if host_mem_budget is not None and bound > host_mem_budget:
         report.error(
-            "host-mem-unprovable",
-            "this configuration's ingest path is O(file) in host RAM "
-            "(in-memory/auto file parse, wire ingest, or checkpoint "
-            "resume), so no static bound exists to enforce "
-            "--host-mem-budget against; use explicit streaming "
-            "(--stream-chunk-bytes N) or a bounded source",
-        )
-    elif getattr(conf, "source", "synthetic") == "file":
-        report.warn(
-            "host-mem-unbounded-path",
-            "peak host memory is O(file) for this ingest path (no "
-            "explicit --stream-chunk-bytes); the declared "
-            "hostmem(unbounded) inventory (graftcheck hostmem) owns it "
-            "until the streaming refactor lands",
+            "host-mem-over-budget",
+            f"static host-memory bound ~{bound / (1 << 30):.2f} GiB "
+            f"(parallel/mesh.py:host_peak_bytes) exceeds "
+            f"--host-mem-budget {host_mem_budget} "
+            f"({host_mem_budget / (1 << 30):.2f} GiB); shrink the "
+            "ingest window (--stream-chunk-bytes, --ingest-workers, "
+            "--block-size) or raise the budget",
         )
 
 

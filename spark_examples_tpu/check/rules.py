@@ -168,6 +168,18 @@ RULES: Dict[str, Rule] = {
             scope=("ops/*",),
         ),
         Rule(
+            "GC012",
+            "raw-file-iteration-outside-stream",
+            "A read-mode file handle (open/gzip.open/bz2.open/lzma.open) "
+            "is iterated or .read*()-consumed directly in ingest/pipeline "
+            "code instead of through the one windowed stream abstraction "
+            "(sources/stream.py: iter_byte_windows/iter_text_lines/"
+            "open_binary) — a raw handle is exactly where O(file) staging "
+            "regrows; route the read through sources/stream.py so the "
+            "hostmem totality proof keeps covering it.",
+            scope=("sources/*", "pipeline/*"),
+        ),
+        Rule(
             "GC010",
             "host-numpy-under-jit",
             "A host `np.*` call inside a jit/shard_map-decorated kernel "
@@ -326,14 +338,17 @@ HOSTMEM_GLOBS = ("sources/*", "pipeline/*", "ops/*", "serve/*", "analyses/*")
 
 #: ``graftcheck hostmem`` rule catalogue (``check/hostmem.py``): an AST
 #: dataflow audit classifying every host ingest/consume path as
-#: bounded-window or O(file). Unlike the ``disable=`` hatch, the hostmem
-#: escape hatch DECLARES a site rather than silencing it::
+#: bounded-window or O(file). The audit is a TOTALITY proof: the
+#: ``hostmem(unbounded)`` escape hatch that used to DECLARE a site::
 #:
 #:     raw = f.read()  # graftcheck: hostmem(unbounded) -- why this path is honestly O(file)
 #:
-#: Declared sites pass the audit but are inventoried in the report (and in
-#: DESIGN.md §8.6) so the streaming refactor has a machine-readable
-#: worklist; a hatch with no justification does not count.
+#: is itself a finding now (GH006) — the declared inventory hit zero when
+#: every source moved onto the windowed stream abstraction
+#: (``sources/stream.py``), and the tree must PROVE boundedness, not
+#: declare its absence. A hatch still routes its underlying GH00x finding
+#: into the report's ``declared_unbounded`` inventory (so the report says
+#: WHAT the hatch hides), but the hatch line fails the audit regardless.
 HOSTMEM_RULES: Dict[str, Rule] = {
     rule.id: rule
     for rule in [
@@ -380,6 +395,19 @@ HOSTMEM_RULES: Dict[str, Rule] = {
             "whole-file buffer (or a stream-accumulated list) stages an "
             "O(file) array on host; stage per chunk/block, or declare the "
             "site hostmem(unbounded).",
+            scope=HOSTMEM_GLOBS,
+        ),
+        Rule(
+            "GH006",
+            "declared-unbounded-forbidden",
+            "A `# graftcheck: hostmem(unbounded)` escape hatch — the "
+            "declared-inventory era ended when the last O(file) site "
+            "moved onto the windowed stream abstraction "
+            "(sources/stream.py); the tree proves boundedness now, and a "
+            "hatch (justified or not) is a finding, not a declaration. "
+            "Refactor the site through "
+            "iter_byte_windows/iter_text_lines/SpooledRecordTable/"
+            "ChunkedArrayBuilder instead.",
             scope=HOSTMEM_GLOBS,
         ),
     ]

@@ -41,14 +41,17 @@ TARGETS = (
 
 #: The ``--strict`` tier: the checker itself (it gates everyone else's
 #: code, so it holds itself to the highest standard — ``check/hostmem.py``
-#: rides in with the directory) and the telemetry subsystem (its
-#: registry/manifest types ARE its wire contract). ``parallel/mesh.py``
-#: joins the permissive tier below for its two audited formulas
-#: (``ring_traffic_bytes``, ``host_peak_bytes``) whose argument types are
-#: plan-validator contract.
+#: rides in with the directory), the telemetry subsystem (its
+#: registry/manifest types ARE its wire contract), and the ONE windowed
+#: stream abstraction (``sources/stream.py`` — every ingest path's
+#: residency proof rests on it, so its types are load-bearing).
+#: ``parallel/mesh.py`` joins the permissive tier below for its two
+#: audited formulas (``ring_traffic_bytes``, ``host_peak_bytes``) whose
+#: argument types are plan-validator contract.
 STRICT_TARGETS = (
     _CHECK_DIR,
     os.path.join(_PACKAGE_DIR, "obs"),
+    os.path.join(_PACKAGE_DIR, "sources", "stream.py"),
 )
 
 _MYPY_FLAGS = (

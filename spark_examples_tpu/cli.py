@@ -42,8 +42,10 @@ flat-ring vs hierarchical two-level ring traffic, overlap, liveness,
 critical-path budgets, for a pod that need not exist),
 ``graftcheck lockgraph`` (static lock-acquisition-order graph of the
 threaded ingest layer, DOT artifact), ``graftcheck hostmem`` (host-memory
-bound audit of the staging layers: O(file) paths must carry justified
-``hostmem(unbounded)`` declarations), ``graftcheck plan`` (device-free
+bound audit of the staging layers: a closed totality proof — every byte
+streams through ``sources/stream.py`` and the retired
+``hostmem(unbounded)`` hatch syntax is itself a finding), ``graftcheck
+plan`` (device-free
 flag/geometry/kernel-shape validation; ``--host-mem-budget`` enforces the
 static host-RAM bound, exactness-window facts/rejections come from the
 ranges prover, and ``--topology``/``--sched-budget-seconds`` add the

@@ -332,15 +332,23 @@ class Heartbeat:
 
         # Host-memory cross-validation pair: each tick SAMPLES the
         # function-backed peak-RSS gauge (graftcheck hostmem's runtime
-        # half), shown against the static bound when the driver proved one
-        # — an operator watches the headroom shrink long before an OOM.
+        # half), shown against the static bound — ALWAYS a real number
+        # now (``conf_host_peak_bytes`` is total; a process that never
+        # registered the gauge gets the runtime-baseline bound), so an
+        # operator watches the headroom shrink long before an OOM.
         peak_rss = self.registry.value(HOST_PEAK_RSS_BYTES)
         if peak_rss is not None and peak_rss == peak_rss and peak_rss > 0:
-            segment = f"host rss peak {_bytes_text(peak_rss)}"
             bound = self.registry.value(HOST_STATIC_BOUND_BYTES)
-            if bound:
-                segment += f"/{_bytes_text(bound)} bound"
-            parts.append(segment)
+            if bound is None or bound != bound or bound <= 0:
+                from spark_examples_tpu.parallel.mesh import (
+                    HOST_RUNTIME_BASELINE_BYTES,
+                )
+
+                bound = HOST_RUNTIME_BASELINE_BYTES
+            parts.append(
+                f"host rss peak {_bytes_text(peak_rss)}"
+                f"/{_bytes_text(bound)} bound"
+            )
 
         memory = _device_memory_line()
         if memory is not None:

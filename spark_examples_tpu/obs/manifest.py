@@ -136,15 +136,18 @@ def _compile_cache_block() -> Optional[Dict]:
 def _host_memory_block(registry=None) -> Dict:
     """The v2 ``host_memory`` block: measured peak RSS (read directly from
     the OS so every manifest carries it, registry or not) next to the
-    static bound the driver's gauge holds when the configured ingest path
-    is bounded (``check/hostmem.py:conf_host_peak_bytes``; null when no
-    static bound exists — the declared-unbounded paths)."""
+    static bound. The bound is ALWAYS a real positive number now —
+    ``check/hostmem.py:conf_host_peak_bytes`` is total, the driver's
+    gauge always carries it, and a manifest written outside a driver run
+    (no registry, or the gauge missing) falls back to the runtime
+    baseline bound, which is what such a process is actually bounded by."""
     from spark_examples_tpu.obs.metrics import (
         HOST_STATIC_BOUND_BYTES,
         read_host_peak_rss_bytes,
     )
+    from spark_examples_tpu.parallel.mesh import HOST_RUNTIME_BASELINE_BYTES
 
-    bound = None
+    bound = HOST_RUNTIME_BASELINE_BYTES
     if registry is not None:
         value = registry.value(HOST_STATIC_BOUND_BYTES)
         if value is not None and value == value and value > 0:
@@ -521,17 +524,29 @@ def validate_manifest(doc) -> List[str]:
     if not isinstance(host_memory, Mapping):
         errors.append("missing 'host_memory' object (schema v2)")
     else:
-        for field in ("peak_rss_bytes", "static_bound_bytes"):
-            value = host_memory.get(field, "absent")
-            if value == "absent":
-                errors.append(f"host_memory.{field} missing")
-            elif value is not None and (
-                not isinstance(value, int) or isinstance(value, bool) or value < 0
-            ):
-                errors.append(
-                    f"host_memory.{field} is neither null nor a "
-                    f"non-negative int: {value!r}"
-                )
+        value = host_memory.get("peak_rss_bytes", "absent")
+        if value == "absent":
+            errors.append("host_memory.peak_rss_bytes missing")
+        elif value is not None and (
+            not isinstance(value, int) or isinstance(value, bool) or value < 0
+        ):
+            errors.append(
+                f"host_memory.peak_rss_bytes is neither null nor a "
+                f"non-negative int: {value!r}"
+            )
+        # static_bound_bytes is NOT nullable: the bound resolver is
+        # total, so a manifest claiming "no bound" is a schema error.
+        bound = host_memory.get("static_bound_bytes", "absent")
+        if (
+            bound == "absent"
+            or not isinstance(bound, int)
+            or isinstance(bound, bool)
+            or bound <= 0
+        ):
+            errors.append(
+                f"host_memory.static_bound_bytes missing or not a "
+                f"positive int: {bound!r}"
+            )
     return errors
 
 

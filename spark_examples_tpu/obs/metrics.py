@@ -338,8 +338,9 @@ def record_prover_conformance(
     """Register one prover's measured/proven pair as the labeled
     conformance gauges (idempotent; re-recording overwrites — the pair is
     a run-level snapshot, not an accumulator). ``proven=None`` records the
-    measured side only: an unprovable configuration (a declared-unbounded
-    ingest path) reports honestly instead of inventing a bound."""
+    measured side only — kept for provers whose bound is conditional
+    (hostmem's never is: ``conf_host_peak_bytes`` is total, so its
+    callers always pass a real bound)."""
     if prover not in CONFORMANCE_PROVERS:
         raise MetricError(
             f"unknown conformance prover {prover!r} "

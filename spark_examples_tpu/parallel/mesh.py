@@ -337,6 +337,8 @@ def host_peak_bytes(
     grm_finalize: bool = False,
     ld_window_sites: int = 0,
     num_hosts: int = 1,
+    wire_table_bytes: int = 0,
+    merge_join_bytes: int = 0,
     baseline_bytes: int = HOST_RUNTIME_BASELINE_BYTES,
 ) -> int:
     """Closed-form peak host-memory bound of one bounded-ingest run — the
@@ -387,6 +389,18 @@ def host_peak_bytes(
       process pays it locally, so the pod-wide peak is ``num_hosts``
       times this formula while each host stays within it. Zero for
       single-process runs.
+    - **wire table** — ``wire_table_bytes``: the resolved residency of
+      wire-mode ingest tables (spool index + decoded records + stream
+      windows) or the packed columns' build/hand-off co-residency; the
+      caller (``check/hostmem.py:conf_host_peak_bytes``) derives it from
+      the bytes on disk via ``sources/stream.py:wire_rows_bound`` so the
+      formula stays TOTAL across JSONL/SAM/REST/checkpoint-resume inputs.
+    - **merge join** — ``merge_join_bytes``: the k-way streaming join's
+      tracked-group working set, ``n_sets x 64 x record_bytes``
+      (``sources/stream.py:merge_join`` holds at most the records of the
+      current group key per stream; 64 is the accounted per-stream group
+      ceiling its ``MergeJoinStats.peak_tracked`` gauge is asserted
+      against). Zero for single-set runs.
     - **baseline** — :data:`HOST_RUNTIME_BASELINE_BYTES`.
     """
     n = int(num_samples)
@@ -411,6 +425,8 @@ def host_peak_bytes(
         + grm_term
         + ld_term
         + merge_term
+        + int(wire_table_bytes)
+        + int(merge_join_bytes)
     )
 
 

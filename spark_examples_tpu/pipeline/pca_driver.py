@@ -49,6 +49,7 @@ from spark_examples_tpu.sharding.partitioners import VariantsPartitioner
 from spark_examples_tpu.sources import partition_page_requests
 from spark_examples_tpu.sources.base import GenomicsSource
 from spark_examples_tpu.sources.files import FileGenomicsSource, af_float
+from spark_examples_tpu.sources.stream import MergeJoinStats, merge_join
 from spark_examples_tpu.sources.synthetic import SyntheticGenomicsSource
 from spark_examples_tpu.utils import faults
 
@@ -222,12 +223,15 @@ class VariantsPcaDriver:
         """The host-memory cross-validation pair (``graftcheck hostmem``'s
         runtime half): a function-backed peak-RSS gauge — every read
         (heartbeat tick, manifest snapshot) samples the OS high-water mark
-        — and, when the configured ingest path is bounded, the static
-        bound from the ONE formula ``parallel/mesh.py:host_peak_bytes``
-        (resolved by ``check/hostmem.py:conf_host_peak_bytes``, the same
+        — and the static bound from the ONE formula
+        ``parallel/mesh.py:host_peak_bytes`` (resolved by
+        ``check/hostmem.py:conf_host_peak_bytes``, which is TOTAL — every
+        configured ingest path gets a finite bound — and the same
         resolver ``graftcheck plan --host-mem-budget`` enforces, so the
         bound the manifest records and the budget the validator proves
-        cannot drift). Best-effort: telemetry must never take down a run."""
+        cannot drift). Best-effort: telemetry must never take down a run;
+        if the resolver itself raises, the runtime-baseline bound is
+        registered so the gauge is never absent."""
         from spark_examples_tpu.obs.metrics import (
             HOST_PEAK_RSS_BYTES,
             HOST_STATIC_BOUND_BYTES,
@@ -271,11 +275,14 @@ class VariantsPcaDriver:
                 num_hosts=num_hosts,
             )
         except Exception:
-            bound = None
-        if bound is not None:
-            well_known_gauge(self.registry, HOST_STATIC_BOUND_BYTES).set(
-                float(bound)
+            from spark_examples_tpu.parallel.mesh import (
+                HOST_RUNTIME_BASELINE_BYTES,
             )
+
+            bound = HOST_RUNTIME_BASELINE_BYTES
+        well_known_gauge(self.registry, HOST_STATIC_BOUND_BYTES).set(
+            float(bound)
+        )
 
     # ------------------------------------------------------------------ data
 
@@ -363,41 +370,52 @@ class VariantsPcaDriver:
         # ``pipeline/datasets.py:_parallel_shards``): windows N+1..N+k build
         # all their datasets' records while window N's join is consumed,
         # keeping --num-workers saturated instead of computing every
-        # dataset's window serially per index.
+        # dataset's window serially per index. The join itself is the
+        # streaming k-way ``sources/stream.py:merge_join`` over per-set
+        # key-sorted streams: only the records of the CURRENT group key are
+        # resident per set, which is exactly the merge-join term the
+        # host-memory bound charges (``parallel/mesh.py:host_peak_bytes``).
         partitions = datasets[0].partitions()
         # One partition list per dataset, built once — not per window per
         # worker (a whole-genome join has thousands of windows).
         partition_lists = [dataset.partitions() for dataset in datasets]
         debug = self.conf.debug_datasets
 
-        def window_records(index: int) -> List[Dict[str, List[List[CallData]]]]:
-            per_set: List[Dict[str, List[List[CallData]]]] = []
+        def window_records(index: int) -> List[List[Tuple[str, List[CallData]]]]:
+            per_set: List[List[Tuple[str, List[CallData]]]] = []
             for dataset, parts in zip(datasets, partition_lists):
                 part = parts[index]
-                keyed: Dict[str, List[List[CallData]]] = {}
+                keyed: List[Tuple[str, List[CallData]]] = []
                 for variant in (v for _, v in dataset.compute(part)):
                     if not self.filter_variant(variant):
                         continue
-                    key = variant.variant_key(debug)
-                    keyed.setdefault(key, []).append(
-                        extract_call_info(variant, self.indexes)
+                    keyed.append(
+                        (
+                            variant.variant_key(debug),
+                            extract_call_info(variant, self.indexes),
+                        )
                     )
+                # Within one window the records are per-set ordered but not
+                # necessarily key-sorted; sort here (window-sized, bounded)
+                # so merge_join's sortedness contract holds per stream.
+                keyed.sort(key=lambda kr: kr[0])
                 per_set.append(keyed)
             return per_set
 
         num_workers = getattr(self.conf, "num_workers", 8)
+        stats = MergeJoinStats()
         for _, per_set in _parallel_shards(
             list(range(len(partitions))), window_records, num_workers
         ):
-            if n_sets == 2:
-                # joinDatasets (``VariantsPca.scala:155-168``): inner join,
-                # concatenate both call lists.
-                a, b = per_set
-                for key, calls_a in a.items():
-                    if key not in b:
-                        continue
+            for _key, groups in merge_join(
+                [iter(keyed) for keyed in per_set], stats=stats
+            ):
+                if n_sets == 2:
+                    # joinDatasets (``VariantsPca.scala:155-168``): inner
+                    # join, concatenate both call lists.
+                    calls_a, calls_b = groups
                     for ca in calls_a:
-                        for cb in b[key]:
+                        for cb in calls_b:
                             row = [
                                 c.callset_id
                                 for c in ca + cb
@@ -405,20 +423,16 @@ class VariantsPcaDriver:
                             ]
                             if row:
                                 yield row
-            else:
-                # mergeDatasets (``VariantsPca.scala:176-188``): keep keys
-                # whose total record count equals the dataset count, flatten.
-                counts: Dict[str, int] = {}
-                for keyed in per_set:
-                    for key, records in keyed.items():
-                        counts[key] = counts.get(key, 0) + len(records)
-                for key, count in counts.items():
-                    if count != n_sets:
+                else:
+                    # mergeDatasets (``VariantsPca.scala:176-188``): keep
+                    # keys whose total record count equals the dataset
+                    # count, flatten.
+                    if sum(len(g) for g in groups) != n_sets:
                         continue
                     merged: List[CallData] = []
-                    for keyed in per_set:
-                        for records in keyed.get(key, []):
-                            merged.extend(records)
+                    for records in groups:
+                        for calls in records:
+                            merged.extend(calls)
                     row = [c.callset_id for c in merged if c.has_variation]
                     if row:
                         yield row

@@ -116,8 +116,10 @@ JOURNAL_COMPACT_BYTES = 4 << 20
 #: "shrink the request or find a bigger service".
 MEM_LIMIT_CODES = frozenset(
     {
+        # "host-mem-unprovable" is retired: conf_host_peak_bytes is
+        # TOTAL now, so every job kind proves a finite bound and the
+        # only host-memory rejection left is a bound over budget.
         "host-mem-over-budget",
-        "host-mem-unprovable",
         "dense-exceeds-hbm",
         "sharded-exceeds-hbm",
     }

@@ -284,10 +284,10 @@ def serve_main(argv: Optional[Sequence[str]] = None) -> int:
         default=None,
         metavar="BYTES",
         help=(
-            "Admission host-RAM budget: jobs whose static bound "
-            "(parallel/mesh.py:host_peak_bytes) exceeds it — or whose "
-            "ingest path is O(file) and therefore unprovable — are "
-            "rejected 413 at admission."
+            "Admission host-RAM budget: every job kind (wire/JSONL/SAM "
+            "included) resolves a finite static bound "
+            "(parallel/mesh.py:host_peak_bytes); jobs whose bound "
+            "exceeds the budget are rejected 413 at admission."
         ),
     )
     parser.add_argument(

@@ -15,10 +15,17 @@ backend behind the same :class:`GenomicsSource` seam, so every pipeline
   (``pipeline/checkpoint.py``) is read via its part files.
 - ``*.sam`` — SAM text alignments for the reads analyses.
 
-Files parse once into per-contig start-sorted tables; shard queries
-(``search_variants`` with STRICT/OVERLAPS boundaries) bisect into them, so
-the partitioner/window machinery drives this source exactly as it drives the
-REST and synthetic backends.
+Files parse once — through the shared windowed stream abstraction
+(``sources/stream.py``: bounded windows, partial-record carry, budgeted
+accumulators) — into per-contig start-sorted SPOOLED tables: the record
+index is resident, the records live in a disk spool and decode lazily per
+query. Shard queries (``search_variants`` with STRICT/OVERLAPS boundaries)
+bisect into them, so the partitioner/window machinery drives this source
+exactly as it drives the REST and synthetic backends, with peak host
+memory O(index + window) — never O(file) — on every path (proven:
+``graftcheck hostmem`` audits this module with zero findings and zero
+declared-unbounded sites; ``check/hostmem.py:conf_host_peak_bytes``
+charges the index, window, and packed-column terms in closed form).
 
 Each file is one variant set (or read group set) whose id is the file's
 sanitized stem — e.g. ``/data/chr17.vcf.gz`` → ``chr17`` — with callset ids
@@ -29,13 +36,11 @@ sanitized stem — e.g. ``/data/chr17.vcf.gz`` → ``chr17`` — with callset id
 from __future__ import annotations
 
 import concurrent.futures
-import gzip
 import json
 import os
 import re
 import threading
 import warnings
-from bisect import bisect_left, bisect_right
 from collections import deque
 
 import numpy as np
@@ -51,7 +56,15 @@ from spark_examples_tpu.sources.base import (
     GenomicsSource,
     ShardBoundary,
 )
-from spark_examples_tpu.utils import faults
+from spark_examples_tpu.sources.stream import (
+    ChunkedArrayBuilder,
+    SortednessProbe,
+    SpooledRecordTable,
+    UnsortedStreamError,
+    iter_byte_windows,
+    iter_text_lines,
+    wire_rows_bound,
+)
 
 #: letter → wire operation (inverse of ``ReadBuilder.CIGAR_MATCH``,
 #: ``models/read.py``; SAM column 6).
@@ -123,10 +136,6 @@ def af_float(value: Optional[str]) -> float:
         return float(value)
     except ValueError:
         return float("nan")
-
-
-def _open_text(path: str):
-    return gzip.open(path, "rt") if path.endswith(".gz") else open(path, "rt")
 
 
 def default_ingest_workers() -> int:
@@ -281,128 +290,110 @@ def _vcf_line_record(
     return chrom, start, record
 
 
-def _parse_vcf(path: str, set_id: str):
-    """→ (callsets, {contig: (starts, records)}) with records start-sorted."""
+def _parse_vcf(path: str, set_id: str, sink: SpooledRecordTable) -> List[Dict]:
+    """Stream one VCF's data lines into ``sink`` (windowed read, one line
+    resident at a time); → the callset list from the ``#CHROM`` header."""
     samples: List[str] = []
-    by_contig: Dict[str, List[Tuple[int, Dict]]] = {}
-    with _open_text(path) as f:
-        for line in f:
-            line = line.rstrip("\n")
-            if not line:
-                continue
-            if line.startswith("#"):
-                # '##' meta lines, the '#CHROM' column row, and any other
-                # '#'-prefixed comment line are all header noise, never
-                # data — matching the native parser (vcfparse.cpp skips
-                # every '#' line), so the wire oracle and the packed paths
-                # agree on comment-bearing files.
-                if line.startswith("#CHROM"):
-                    columns = line.split("\t")
-                    samples = columns[9:] if len(columns) > 9 else []
-                continue
-            chrom, start, record = _vcf_line_record(line, path, set_id, samples)
-            # graftcheck: hostmem(unbounded) -- the wire-oracle tables are whole-file by contract (random-access bisect queries); the packed/streamed paths serve large inputs
-            by_contig.setdefault(chrom, []).append((start, record))
-    callsets = [
+    for line in iter_text_lines(path):
+        if not line:
+            continue
+        if line.startswith("#"):
+            # '##' meta lines, the '#CHROM' column row, and any other
+            # '#'-prefixed comment line are all header noise, never
+            # data — matching the native parser (vcfparse.cpp skips
+            # every '#' line), so the wire oracle and the packed paths
+            # agree on comment-bearing files.
+            if line.startswith("#CHROM"):
+                columns = line.split("\t")
+                samples = columns[9:] if len(columns) > 9 else []
+            continue
+        chrom, start, record = _vcf_line_record(line, path, set_id, samples)
+        sink.add(chrom, start, record)
+    return [
         {"id": f"{set_id}-{i}", "name": name} for i, name in enumerate(samples)
     ]
-    return callsets, _finish_tables(by_contig)
 
 
-def _parse_jsonl(path: str, set_id: str):
-    """Wire-format JSON lines (bare variant dicts, or checkpoint entries
-    ``{"key": ..., "variant": ...}``). The cohort is taken from the first
-    record carrying calls (1000G-style uniform cohorts)."""
-    by_contig: Dict[str, List[Tuple[int, Dict]]] = {}
+def _parse_jsonl(
+    path: str, set_id: str, sink: SpooledRecordTable
+) -> List[Dict]:
+    """Stream wire-format JSON lines (bare variant dicts, or checkpoint
+    entries ``{"key": ..., "variant": ...}``) into ``sink``. The cohort is
+    taken from the first record carrying calls (1000G-style uniform
+    cohorts)."""
     callsets: List[Dict] = []
-    with _open_text(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            entry = json.loads(line)
-            record = entry["variant"] if "variant" in entry else entry
-            record = dict(record)
-            record.setdefault("variantSetId", set_id)
-            if not callsets and record.get("calls"):
-                callsets = [
-                    {
-                        "id": c.get("callSetId"),
-                        "name": c.get("callSetName") or c.get("callSetId"),
-                    }
-                    for c in record["calls"]
-                ]
-            # graftcheck: hostmem(unbounded) -- wire-format JSONL (REST item shape / checkpoint entries) has no streamed consumer; whole-file tables are the resume surface (ROADMAP item 1 names the refactor)
-            by_contig.setdefault(record["referenceName"], []).append(
-                (int(record["start"]), record)
-            )
-    return callsets, _finish_tables(by_contig)
-
-
-def _parse_sam(path: str, set_id: str):
-    """SAM text → per-contig start-sorted read wire dicts (the SearchReads
-    item shape ``ReadBuilder.build`` consumes, ``models/read.py``)."""
-    by_contig: Dict[str, List[Tuple[int, Dict]]] = {}
-    with _open_text(path) as f:
-        for line_no, line in enumerate(f):
-            line = line.rstrip("\n")
-            if not line or line.startswith("@"):
-                continue
-            fields = line.split("\t")
-            if len(fields) < 11:
-                raise ValueError(
-                    f"{path}: malformed SAM data line (<11 fields): {line[:80]!r}"
-                )
-            qname, _flag, rname, pos, mapq, cigar, rnext, pnext, tlen, seq, qual = (
-                fields[:11]
-            )
-            if rname == "*":
-                continue  # unmapped: no position to shard on
-            start = int(pos) - 1
-            record: Dict = {
-                "id": f"{set_id}:{line_no}",
-                "fragmentName": qname,
-                "readGroupSetId": set_id,
-                "alignedSequence": "" if seq == "*" else seq,
-                "fragmentLength": int(tlen),
-                "alignment": {
-                    "position": {"referenceName": rname, "position": start},
-                    "mappingQuality": int(mapq),
-                    "cigar": [
-                        {
-                            "operationLength": int(length),
-                            "operation": _CIGAR_OPS[op],
-                        }
-                        for length, op in _CIGAR_RE.findall(cigar)
-                    ],
-                },
-            }
-            if qual != "*":
-                record["alignedQuality"] = [ord(c) - 33 for c in qual]
-            if rnext != "*":
-                record["nextMatePosition"] = {
-                    "referenceName": rname if rnext == "=" else rnext,
-                    "position": int(pnext) - 1,
+    for line in iter_text_lines(path):
+        line = line.strip()
+        if not line:
+            continue
+        entry = json.loads(line)
+        record = entry["variant"] if "variant" in entry else entry
+        record = dict(record)
+        record.setdefault("variantSetId", set_id)
+        if not callsets and record.get("calls"):
+            callsets = [
+                {
+                    "id": c.get("callSetId"),
+                    "name": c.get("callSetName") or c.get("callSetId"),
                 }
-            # graftcheck: hostmem(unbounded) -- SAM ingest is whole-file tables today (reads analyses bisect them); SAM/reads streaming is named in ROADMAP item 1
-            by_contig.setdefault(rname, []).append((start, record))
-    return [], _finish_tables(by_contig)
+                for c in record["calls"]
+            ]
+        sink.add(record["referenceName"], int(record["start"]), record)
+    return callsets
 
 
-def _finish_tables(
-    by_contig: Dict[str, List[Tuple[int, Dict]]],
-) -> Dict[str, Tuple[List[int], List[Dict]]]:
-    tables = {}
-    for contig, items in by_contig.items():
-        items.sort(key=lambda pair: pair[0])
-        tables[contig] = (
-            [start for start, _ in items],
-            [record for _, record in items],
+def _parse_sam(path: str, set_id: str, sink: SpooledRecordTable) -> List[Dict]:
+    """Stream SAM text into ``sink`` as read wire dicts (the SearchReads
+    item shape ``ReadBuilder.build`` consumes, ``models/read.py``)."""
+    for line_no, line in enumerate(iter_text_lines(path)):
+        if not line or line.startswith("@"):
+            continue
+        fields = line.split("\t")
+        if len(fields) < 11:
+            raise ValueError(
+                f"{path}: malformed SAM data line (<11 fields): {line[:80]!r}"
+            )
+        qname, _flag, rname, pos, mapq, cigar, rnext, pnext, tlen, seq, qual = (
+            fields[:11]
         )
-    return tables
+        if rname == "*":
+            continue  # unmapped: no position to shard on
+        start = int(pos) - 1
+        record: Dict = {
+            "id": f"{set_id}:{line_no}",
+            "fragmentName": qname,
+            "readGroupSetId": set_id,
+            "alignedSequence": "" if seq == "*" else seq,
+            "fragmentLength": int(tlen),
+            "alignment": {
+                "position": {"referenceName": rname, "position": start},
+                "mappingQuality": int(mapq),
+                "cigar": [
+                    {
+                        "operationLength": int(length),
+                        "operation": _CIGAR_OPS[op],
+                    }
+                    for length, op in _CIGAR_RE.findall(cigar)
+                ],
+            },
+        }
+        if qual != "*":
+            record["alignedQuality"] = [ord(c) - 33 for c in qual]
+        if rnext != "*":
+            record["nextMatePosition"] = {
+                "referenceName": rname if rnext == "=" else rnext,
+                "position": int(pnext) - 1,
+            }
+        sink.add(rname, start, record)
+    return []
 
 
-def _load(path: str, set_id: str):
+def _load(path: str, set_id: str) -> Tuple[List[Dict], SpooledRecordTable, str]:
+    """Parse one input into a finished spooled table. The table's row
+    capacity is the closed-form wire bound (``stream.wire_rows_bound`` —
+    the same number ``conf_host_peak_bytes`` charges), so the static proof
+    is enforced live: an input violating it raises ``StreamBudgetError``
+    instead of growing past the bound."""
     if os.path.isdir(path):
         # A checkpoint directory (``pipeline/checkpoint.py``): concatenation
         # of its part files. A directory with no part files is a wrong path
@@ -414,24 +405,21 @@ def _load(path: str, set_id: str):
                 "checkpoint directory written by save_variants "
                 "(pipeline/checkpoint.py)"
             )
+        cap = sum(wire_rows_bound(os.path.join(path, n)) for n in parts)
+        sink = SpooledRecordTable(path, capacity_rows=cap)
         callsets: List[Dict] = []
-        merged: Dict[str, List[Tuple[int, Dict]]] = {}
         for name in parts:
-            part_callsets, tables = _parse_jsonl(os.path.join(path, name), set_id)
+            part_callsets = _parse_jsonl(os.path.join(path, name), set_id, sink)
             callsets = callsets or part_callsets
-            for contig, (starts, records) in tables.items():
-                merged.setdefault(contig, []).extend(zip(starts, records))
-        return callsets, _finish_tables(merged), "variants"
+        return callsets, sink.finish(), "variants"
+    sink = SpooledRecordTable(path, capacity_rows=wire_rows_bound(path))
     lowered = path[:-3] if path.endswith(".gz") else path
     if lowered.endswith(".vcf"):
-        callsets, tables = _parse_vcf(path, set_id)
-        return callsets, tables, "variants"
+        return _parse_vcf(path, set_id, sink), sink.finish(), "variants"
     if lowered.endswith(".jsonl"):
-        callsets, tables = _parse_jsonl(path, set_id)
-        return callsets, tables, "variants"
+        return _parse_jsonl(path, set_id, sink), sink.finish(), "variants"
     if lowered.endswith(".sam"):
-        callsets, tables = _parse_sam(path, set_id)
-        return callsets, tables, "reads"
+        return _parse_sam(path, set_id, sink), sink.finish(), "reads"
     raise ValueError(
         f"unsupported input file {path!r}: expected .vcf[.gz], .jsonl[.gz], "
         ".sam, or a checkpoint directory"
@@ -439,35 +427,40 @@ def _load(path: str, set_id: str):
 
 
 class _FileTable:
-    """One parsed file: per-contig start-sorted records + bisect queries."""
+    """One parsed file: per-contig start-sorted spooled records + bisect
+    queries. Resident memory is the integer index; records decode lazily
+    from the spool per query (``stream.SpooledRecordTable``)."""
 
     def __init__(self, path: str, set_id: str):
         self.path = path
         self.set_id = set_id
-        self.callsets, self.tables, self.kind = _load(path, set_id)
+        self.callsets, self.table, self.kind = _load(path, set_id)
 
     def query(
         self, contig: str, start: int, end: int, boundary: ShardBoundary
     ) -> Iterator[Dict]:
-        starts, records = self.tables.get(contig, ([], []))
+        starts = self.table.starts(contig)
         if boundary is ShardBoundary.STRICT:
             # Exactly the records whose start lies in [start, end).
-            lo = bisect_left(starts, start)
-            hi = bisect_right(starts, end - 1, lo=lo)
-            yield from records[lo:hi]
+            lo = int(np.searchsorted(starts, start, side="left"))
+            hi = int(np.searchsorted(starts, end - 1, side="right"))
+            yield from self.table.iter_records(contig, lo, hi)
             return
         # OVERLAPS: any record intersecting [start, end). Starts are sorted
         # but ends are not, so scan the prefix with start < end and filter.
-        hi = bisect_right(starts, end - 1)
-        for record in records[:hi]:
+        hi = int(np.searchsorted(starts, end - 1, side="right"))
+        for record in self.table.iter_records(contig, 0, hi):
             if _record_end(record) > start:
                 yield record
 
     def contigs(self) -> List[Contig]:
-        return [
-            Contig(name, 0, (starts[-1] if starts else 0) + _max_span(records))
-            for name, (starts, records) in sorted(self.tables.items())
-        ]
+        out: List[Contig] = []
+        for name in sorted(self.table.contig_names()):
+            starts = self.table.starts(name)
+            last = int(starts[-1]) if len(starts) else 0
+            span = _max_span(self.table.tail_records(name, 64))
+            out.append(Contig(name, 0, last + span))
+        return out
 
 
 def _record_end(record: Dict) -> int:
@@ -545,29 +538,42 @@ def _records_to_arrays(items, n_samples: int):
 
 def _python_vcf_arrays(path: str, set_id: str):
     """Pure-Python fallback producing the same arrays as the native parser
-    (``utils/native.py:parse_vcf_arrays``), derived from the wire records.
-    Like the native parser, rows with fewer sample columns than the header
-    zero-fill the missing samples (the header is the cohort authority)."""
-    callsets, tables = _parse_vcf(path, set_id)
+    (``utils/native.py:parse_vcf_arrays``), derived from the wire records —
+    staged through a spooled table so even the fallback oracle never holds
+    the record set in memory. Like the native parser, rows with fewer
+    sample columns than the header zero-fill the missing samples (the
+    header is the cohort authority)."""
+    sink = SpooledRecordTable(path, capacity_rows=wire_rows_bound(path))
+    callsets = _parse_vcf(path, set_id, sink)
+    table = sink.finish()
     return _records_to_arrays(
         (
-            (contig, start, record)
-            for contig, (starts, records) in sorted(tables.items())
-            for start, record in zip(starts, records)
+            (contig, int(start), record)
+            for contig in sorted(table.contig_names())
+            for start, record in zip(
+                table.starts(contig).tolist(), table.iter_records(contig)
+            )
         ),
         len(callsets),
     )
 
 
 def _native_parallel_vcf_arrays(text: bytes, workers: int):
-    """Chunk-parallel native parse of one decompressed VCF buffer: split into
+    """Span-parallel native parse of one in-memory VCF buffer: split into
     line-aligned spans, parse spans concurrently through the GIL-releasing
     C-ABI parser (``utils/native.py:parse_vcf_span``), and reassemble the
     per-span arrays in file order. Byte-identical to the serial
     ``parse_vcf_arrays`` by construction: the cohort comes from the same
     whole-buffer ``vcf_scan``, every span runs the same per-line core, and
     concatenation in span order IS file order. ``None`` when the native
-    library is unavailable."""
+    library is unavailable.
+
+    Since the packed path moved to windowed staging
+    (``_chunked_vcf_arrays``), no production path holds a whole-file
+    buffer to hand here — this is the span-level parity oracle the fuzz
+    corpus drives (parallel == serial on every document, including the
+    malformed-ordinal contract), kept as the reference implementation for
+    any buffer-holding caller."""
     from spark_examples_tpu.utils.native import (
         parse_vcf_span,
         scan_vcf_counts,
@@ -608,40 +614,62 @@ def _native_parallel_vcf_arrays(text: bytes, workers: int):
     )
 
 
-def _read_whole_vcf_bytes(path: str) -> bytes:
-    """Decompressed text of one VCF for the packed WHOLE-FILE parse — the
-    one honestly-O(file) read of the packed path, declared as such
-    (``graftcheck hostmem`` inventories these sites; the streaming path
-    never calls this).
+def _chunked_vcf_arrays(
+    path: str, set_id: str, ingest_workers: Optional[int]
+):
+    """Windowed staging for the packed view: the streaming chunk engine
+    (``_StreamedVcf.iter_chunk_arrays`` — bounded windows, partial-line
+    carry, chunk-parallel native decode) feeds budgeted column builders
+    (``stream.ChunkedArrayBuilder``, capacity = the closed-form wire row
+    bound), replacing the retired whole-file buffer read. Peak staging is
+    O(workers × chunk) for the parse plus the growing packed columns —
+    both charged by ``conf_host_peak_bytes``'s packed term — and for
+    ``.gz`` inputs the compressed stream decodes window by window, never
+    resident beside more than one decompressed window.
 
-    The ``.gz`` branch reads through gzip's file interface in bounded
-    windows instead of the old ``f.read()`` + ``gzip.decompress(raw)``
-    one-shot, so the peak is the decompressed text plus ONE window —
-    never the compressed file alongside the full decompressed copy
-    (~10-30% of the text again for real GT matrices).
-    """
-    if not path.endswith(".gz"):
-        with open(path, "rb") as f:
-            # graftcheck: hostmem(unbounded) -- packed whole-file parse: the native chunk-parallel parser spans one contiguous buffer; files past STREAM_THRESHOLD_BYTES take the streaming path instead
-            data = f.read()
-        return faults.io_point("files.whole-read", data)
-    pieces: List[bytes] = []
-    with gzip.open(path, "rb") as f:
-        while True:
-            piece = faults.io_point("files.whole-read", f.read(STREAM_CHUNK_BYTES))
-            if not piece:
-                break
-            # graftcheck: hostmem(unbounded) -- decompressed whole-file staging for the packed parse (windowed reads; the compressed copy is never co-resident). Streaming-scale inputs never reach here
-            pieces.append(piece)
-    return b"".join(pieces)
+    → ``((contigs, positions, ends, af, hv), native)``; byte-identical to
+    the retired whole-buffer parse (concatenating line-aligned windows in
+    file order IS file order — the parity the streaming tests pin)."""
+    from spark_examples_tpu.utils.native import MalformedVcfLine
+
+    view = _StreamedVcf(
+        path,
+        set_id,
+        chunk_bytes=STREAM_CHUNK_BYTES,
+        ingest_workers=ingest_workers,
+    )
+    cap = wire_rows_bound(path)
+    n_samples = view.num_samples
+    builders = (
+        ChunkedArrayBuilder(object, capacity_rows=cap, label=path),
+        ChunkedArrayBuilder(np.int64, capacity_rows=cap, label=path),
+        ChunkedArrayBuilder(np.int64, capacity_rows=cap, label=path),
+        ChunkedArrayBuilder(np.float64, capacity_rows=cap, label=path),
+        ChunkedArrayBuilder(
+            np.int8, row_shape=(n_samples,), capacity_rows=cap, label=path
+        ),
+    )
+    rows_staged = 0
+    try:
+        for parts in view.iter_chunk_arrays():
+            for builder, part in zip(builders, parts):
+                builder.add(part)
+            rows_staged += len(parts[1])
+    except MalformedVcfLine as e:
+        # Chunks merge in file order, so every chunk BEFORE the failing
+        # one has been staged — the chunk-relative ordinal translates to
+        # the file-level data-line number the serial parse reports.
+        raise MalformedVcfLine(rows_staged + e.ordinal) from None
+    return tuple(b.finish() for b in builders), view.native_decode
 
 
 class _PackedVcf:
     """Column-oriented view of one VCF: per-contig start-sorted arrays
     (positions, AF, has-variation rows) feeding the packed ingest path —
-    parsed by the native C++ parser when available (``native/vcfparse.cpp``,
-    chunk-parallel across ``ingest_workers`` threads), by Python otherwise,
-    with identical output (tested)."""
+    staged through the windowed chunk engine (native C++ decode when
+    available, ``native/vcfparse.cpp``, chunk-parallel across
+    ``ingest_workers`` threads; the shared-semantics Python fallback
+    otherwise) with identical output (tested)."""
 
     def __init__(
         self,
@@ -649,32 +677,24 @@ class _PackedVcf:
         set_id: str,
         ingest_workers: Optional[int] = None,
     ):
-        from spark_examples_tpu.utils.native import (
-            parse_vcf_arrays,
-            vcf_library,
-        )
+        from spark_examples_tpu.utils.native import vcf_library
 
         self.path = path
         self.native = False
-        workers = _resolve_ingest_workers(ingest_workers)
+        _resolve_ingest_workers(ingest_workers)
         lowered = path[:-3] if path.endswith(".gz") else path
         if not lowered.endswith(".vcf"):
             raise ValueError(
                 f"packed ingest needs a .vcf[.gz] input; got {path!r}"
             )
         # Probe library availability BEFORE reading: without a compiler the
-        # fallback parser reads the file itself — no point paying a full
-        # read of a multi-GB VCF just to get None back.
+        # chunk engine would pay the windowed read only to fall back per
+        # chunk — the spooled Python oracle is the honest path there.
         if vcf_library() is not None:
-            raw = _read_whole_vcf_bytes(path)
-            if workers >= 2:
-                arrays = _native_parallel_vcf_arrays(raw, workers)
-            else:
-                arrays = parse_vcf_arrays(raw)
-            self.native = arrays is not None
+            arrays, self.native = _chunked_vcf_arrays(
+                path, set_id, ingest_workers
+            )
         else:
-            arrays = None
-        if arrays is None:
             arrays = _python_vcf_arrays(path, set_id)
         contigs, positions, ends, af, hv = arrays
         self.num_samples = hv.shape[1]
@@ -730,54 +750,31 @@ def _read_vcf_header_samples(path: str) -> List[str]:
     VCF (a data line before any ``#CHROM`` row) yields the empty cohort,
     exactly like the whole-file wire parser (``_parse_vcf``) — header-only
     discovery must not reject files the data parse would accept."""
-    with _open_text(path) as f:
-        for line in f:
-            line = line.rstrip("\r\n")
-            if not line:
-                continue
-            if line.startswith("#CHROM"):
-                columns = line.split("\t")
-                return columns[9:] if len(columns) > 9 else []
-            if line.startswith("#"):
-                # Any other '#'-prefixed line ('##' meta or a bare comment)
-                # is header noise, not data: keep scanning for #CHROM. A
-                # single-'#' comment before #CHROM previously ended the
-                # scan here and silently yielded a 0-sample cohort.
-                continue
-            break  # a data line before #CHROM: headerless, no cohort
+    # A small window: the scan usually ends within the first KBs, and the
+    # streamed-ingest memory tests pin the whole pass to O(chunk).
+    for line in iter_text_lines(path, window_bytes=64 << 10):
+        if not line:
+            continue
+        if line.startswith("#CHROM"):
+            columns = line.split("\t")
+            return columns[9:] if len(columns) > 9 else []
+        if line.startswith("#"):
+            # Any other '#'-prefixed line ('##' meta or a bare comment)
+            # is header noise, not data: keep scanning for #CHROM. A
+            # single-'#' comment before #CHROM previously ended the
+            # scan here and silently yielded a 0-sample cohort.
+            continue
+        break  # a data line before #CHROM: headerless, no cohort
     return []
 
 
 def _iter_vcf_chunks(path: str, chunk_bytes: int) -> Iterator[bytes]:
     """Stream a (possibly gzipped) text file in ~``chunk_bytes`` pieces that
     end at line boundaries (the partial last line carries into the next
-    chunk), holding one chunk in memory at a time."""
-    # Floor guards 0/negative; tiny explicit values are honored (tests fuzz
-    # chunk boundaries with chunks smaller than one line — the carry handles
-    # lines longer than the chunk).
-    chunk_bytes = max(64, int(chunk_bytes))
-    opener = (
-        gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
-    )
-    carry = b""
-    with opener as f:
-        while True:
-            # Registered IO fault boundary (utils/faults.py): a plan entry
-            # can fail, truncate, or delay exactly one windowed read here —
-            # the reproducible stand-in for a failing disk / truncated file.
-            data = faults.io_point("files.read", f.read(chunk_bytes))
-            if not data:
-                break
-            if carry:
-                data = carry + data
-            cut = data.rfind(b"\n")
-            if cut < 0:
-                carry = data
-                continue
-            carry = data[cut + 1 :]
-            yield data[: cut + 1]
-    if carry:
-        yield carry
+    chunk), holding one chunk in memory at a time — the shared windowed
+    reader (``sources/stream.py:iter_byte_windows``; the ``files.read``
+    fault boundary and the 64-byte window floor live there)."""
+    return iter_byte_windows(path, chunk_bytes, fault_label="files.read")
 
 
 def _python_chunk_arrays(chunk: bytes, path: str, set_id: str, samples):
@@ -806,7 +803,7 @@ def _contig_runs(contigs: np.ndarray) -> Iterator[Tuple[str, slice]]:
         yield str(contigs[lo]), slice(lo, hi)
 
 
-class UnsortedVcfError(ValueError):
+class UnsortedVcfError(UnsortedStreamError):
     """A streaming pass met records out of coordinate order. Explicitly
     requested streaming (``--stream-chunk-bytes N``) surfaces this as the
     hard error it is; AUTO-selected streaming catches it and falls back to
@@ -815,42 +812,21 @@ class UnsortedVcfError(ValueError):
     threshold existed into a hard failure."""
 
 
-class _RunOrderCheck:
-    """Coordinate-sortedness guard for one streaming pass: each contig's
-    records must be contiguous and non-decreasing in position (the standard
-    sorted-VCF layout; the guard turns a silently-wrong single pass into a
-    loud error naming the fix)."""
+class _RunOrderCheck(SortednessProbe):
+    """Coordinate-sortedness guard for one streaming pass — the VCF face
+    of the shared ``stream.SortednessProbe`` contract (contig-contiguous,
+    non-decreasing positions), raising :class:`UnsortedVcfError` with the
+    VCF-specific remedy."""
 
     def __init__(self, path: str):
-        self.path = path
-        self.current: Optional[str] = None
-        self.last_pos = -1
-        self.finished: set = set()
-
-    def check(self, name: str, positions: np.ndarray) -> None:
-        if name != self.current:
-            if self.current is not None:
-                self.finished.add(self.current)
-            if name in self.finished:
-                raise UnsortedVcfError(
-                    f"{self.path}: records for contig {name!r} are not "
-                    "contiguous — streaming ingest needs a coordinate-sorted "
-                    "VCF; sort the input or disable streaming "
-                    "(--stream-chunk-bytes 0)"
-                )
-            self.current = name
-            self.last_pos = -1
-        if len(positions) == 0:
-            return
-        if int(positions[0]) < self.last_pos or (
-            len(positions) > 1 and np.any(np.diff(positions) < 0)
-        ):
-            raise UnsortedVcfError(
-                f"{self.path}: contig {name!r} positions are not sorted — "
+        super().__init__(
+            path,
+            error_cls=UnsortedVcfError,
+            hint=(
                 "streaming ingest needs a coordinate-sorted VCF; sort the "
                 "input or disable streaming (--stream-chunk-bytes 0)"
-            )
-        self.last_pos = int(positions[-1])
+            ),
+        )
 
 
 class StreamCounters:
@@ -961,6 +937,9 @@ class _StreamedVcf:
             for i, name in enumerate(self.samples)
         ]
         self._bounds: Optional[Dict[str, int]] = None
+        #: Whether the LAST ``iter_chunk_arrays`` pass decoded natively
+        #: end to end (the packed view's ``native`` flag derives from it).
+        self.native_decode = False
 
     def iter_chunk_arrays(self):
         """→ ``(contigs, positions, ends, af, hv)`` per chunk, file order.
@@ -979,9 +958,12 @@ class _StreamedVcf:
             vcf_library,
         )
 
+        self.native_decode = vcf_library() is not None
+
         def decode(chunk: bytes):
             arrays = parse_vcf_chunk(chunk, self.num_samples)
             if arrays is None:
+                self.native_decode = False  # library vanished mid-flight
                 arrays = _python_chunk_arrays(
                     chunk, self.path, self.set_id, self.samples
                 )

@@ -130,6 +130,18 @@ class RestClient(GenomicsClient):
     def _paginate(
         self, path: str, request: Mapping, items_field: str, page_size: int
     ) -> Iterator[Dict]:
+        """One page resident at a time — the REST arm of the windowed
+        stream discipline (``sources/stream.py``): each decoded page is
+        re-yielded through :func:`windowed` (window = the requested page
+        size), and a server page more than 4x the requested size raises
+        :class:`StreamBudgetError` — a misbehaving server must fail
+        loudly, not silently inflate host residency past the bound the
+        prover charged for this source."""
+        from spark_examples_tpu.sources.stream import (
+            StreamBudgetError,
+            windowed,
+        )
+
         payload = dict(request)
         payload["pageSize"] = page_size
         token: Optional[str] = None
@@ -137,8 +149,16 @@ class RestClient(GenomicsClient):
             if token is not None:
                 payload["pageToken"] = token
             response = self._post(path, payload)
-            for item in response.get(items_field, []):
-                yield item
+            items = response.get(items_field, [])
+            if len(items) > 4 * page_size:
+                raise StreamBudgetError(
+                    f"{path}: server returned {len(items)} items against "
+                    f"pageSize {page_size} (>4x) — refusing to stage an "
+                    "unbounded page on host"
+                )
+            for window in windowed(items, page_size):
+                for item in window:
+                    yield item
             token = response.get("nextPageToken")
             if not token:
                 return

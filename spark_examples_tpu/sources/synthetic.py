@@ -618,7 +618,13 @@ class SyntheticGenomicsSource(GenomicsSource):
 class SyntheticClient(GenomicsClient):
     """A per-partition session over the synthetic source, with the page
     accounting of the reference's ``Paginator`` (one initialized request per
-    page, ``rdd/VariantsRDD.scala:212-224``)."""
+    page, ``rdd/VariantsRDD.scala:212-224``).
+
+    Stream contract (``sources/stream.py``): records are GENERATED one at
+    a time from the site grid — no file handle, no decoded payload larger
+    than one record ever stages on host — so the synthetic arm of the
+    hostmem totality proof carries no wire-table term at all; its page
+    windows exist only for request accounting parity with the REST arm."""
 
     def __init__(self, source: SyntheticGenomicsSource):
         super().__init__()

@@ -101,12 +101,9 @@ KILL_POINTS: Dict[str, str] = {
 #: Registered IO-boundary fault sites.
 IO_POINTS: Dict[str, str] = {
     "files.read": (
-        "sources/files.py:_iter_vcf_chunks — one streamed read window "
-        "(truncate simulates a truncated file; ioerror a failing disk)"
-    ),
-    "files.whole-read": (
-        "sources/files.py:_read_whole_vcf_bytes — the packed in-memory "
-        "path's windowed whole-file read loop"
+        "sources/stream.py:iter_byte_windows — one streamed read window, "
+        "EVERY file ingest path (wire tables, packed staging, streaming; "
+        "truncate simulates a truncated file; ioerror a failing disk)"
     ),
     "rest.post": (
         "sources/rest.py:RestClient._post — one transport attempt "
@@ -117,7 +114,7 @@ IO_POINTS: Dict[str, str] = {
 #: IO points whose hook carries a byte payload ``truncate`` can shorten.
 #: ``rest.post`` passes no data — a truncate there would be a silent no-op
 #: that still counts as fired, so the grammar rejects it.
-TRUNCATE_IO_POINTS = ("files.read", "files.whole-read")
+TRUNCATE_IO_POINTS = ("files.read",)
 
 _ACTIONS = ("kill", "raise", "crash", "ioerror", "truncate", "slow")
 _KILL_ACTIONS = ("kill", "raise", "crash")

@@ -295,6 +295,7 @@ if HAVE_HYPOTHESIS:
 
         from spark_examples_tpu.models.read import ReadBuilder
         from spark_examples_tpu.sources.files import _parse_sam
+        from spark_examples_tpu.sources.stream import SpooledRecordTable
 
         text = "@HD\tVN:1.6\n" + "".join(
             "\t".join(
@@ -311,12 +312,18 @@ if HAVE_HYPOTHESIS:
         try:
             with os.fdopen(fd, "w") as f:
                 f.write(text)
-            _, tables = _parse_sam(path, "fuzz")
+            sink = SpooledRecordTable(path)
+            _parse_sam(path, "fuzz", sink)
+            table = sink.finish()
+            tables = {
+                contig: list(table.iter_records(contig))
+                for contig in table.contig_names()
+            }
         finally:
             os.unlink(path)
 
         parsed = {}
-        for contig, (starts, recs) in tables.items():
+        for contig, recs in tables.items():
             for wire in recs:
                 key, read = ReadBuilder.build(wire)
                 parsed[wire["id"]] = (key, read)

@@ -261,7 +261,9 @@ def test_admission_memory_rejections_are_413(tmp_path):
         codes = [i["code"] for i in body["plan"]["issues"]]
         assert "host-mem-over-budget" in codes
         assert set(codes) & MEM_LIMIT_CODES
-        # An O(file)-ingest config cannot be proven under a budget at all.
+        # File-ingest configs used to be "unprovable"; the total resolver
+        # now gives them a real (huge, ceiling-rows) bound, so under a
+        # 1 MiB budget they reject as plainly over-budget — still 413.
         status, body = service.submit(
             request_doc(
                 ["--source", "file", "--input-files", "cohort.vcf"]
@@ -269,9 +271,9 @@ def test_admission_memory_rejections_are_413(tmp_path):
             )
         )
         assert status == 413
-        assert "host-mem-unprovable" in [
-            i["code"] for i in body["plan"]["issues"]
-        ]
+        codes = [i["code"] for i in body["plan"]["issues"]]
+        assert "host-mem-over-budget" in codes
+        assert "host-mem-unprovable" not in codes
     finally:
         gate.release.set()
         service.stop(timeout=30)
