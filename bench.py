@@ -190,6 +190,61 @@ def _percentile(values, fraction: float) -> float:
     return ordered[index]
 
 
+def _small_wall_snapshot(service) -> dict:
+    """One merged ``serve_job_wall_seconds`` snapshot for the small
+    admission class (all kinds and compile dispositions) — the same
+    histograms ``/v1/fleet/stats`` and the calibration ledger ride on,
+    so the bench reports the numbers operators will actually see."""
+    from spark_examples_tpu.obs.metrics import SERVE_JOB_WALL_SECONDS
+
+    merged = {"buckets": {}, "sum": 0.0, "count": 0}
+    family = service.registry.get(SERVE_JOB_WALL_SECONDS)
+    if family is None:
+        return merged
+    for child in family.children():
+        if child.labels_dict.get("job_class") != "small":
+            continue
+        snap = child.snapshot()
+        for bound, cumulative in snap["buckets"].items():
+            merged["buckets"][bound] = merged["buckets"].get(bound, 0) + int(
+                cumulative
+            )
+        merged["sum"] += float(snap["sum"])
+        merged["count"] += int(snap["count"])
+    return merged
+
+
+def _snapshot_delta(after: dict, before: dict) -> dict:
+    """The histogram increments one bench phase contributed: cumulative
+    bucket counts subtract bound-by-bound (children of one family share
+    bounds, and counts only grow)."""
+    bounds = set(after["buckets"]) | set(before["buckets"])
+    return {
+        "buckets": {
+            bound: after["buckets"].get(bound, 0)
+            - before["buckets"].get(bound, 0)
+            for bound in bounds
+        },
+        "sum": after["sum"] - before["sum"],
+        "count": after["count"] - before["count"],
+    }
+
+
+def _phase_quantiles(delta: dict, phase: str) -> dict:
+    from spark_examples_tpu.obs.metrics import histogram_quantile
+
+    if delta["count"] <= 0:
+        raise RuntimeError(
+            f"serve-load {phase} phase recorded no small-job wall samples"
+        )
+    return {
+        "count": delta["count"],
+        "mean": round(delta["sum"] / delta["count"], 4),
+        "p50": round(histogram_quantile(delta, 0.50), 4),
+        "p99": round(histogram_quantile(delta, 0.99), 4),
+    }
+
+
 def _serve_load_phase(client, jobs: int) -> list:
     """Submit ``jobs`` small jobs one after another (a poller's view:
     submit -> terminal), returning per-job wall seconds."""
@@ -229,7 +284,9 @@ def _run_serve_load_config(device) -> dict:
         warm = client.submit(SERVE_LOAD_SMALL_FLAGS)
         client.wait(warm["job"]["id"], timeout=300, poll_cap_seconds=0.1)
 
+        baseline_snap = _small_wall_snapshot(service)
         unloaded = _serve_load_phase(client, SERVE_LOAD_SMALL_JOBS)
+        unloaded_snap = _small_wall_snapshot(service)
 
         large_doc = client.submit(SERVE_LOAD_LARGE_FLAGS)
         large_id = large_doc["job"]["id"]
@@ -239,18 +296,33 @@ def _run_serve_load_config(device) -> dict:
             )
         t_large = time.perf_counter()
         loaded = _serve_load_phase(client, SERVE_LOAD_SMALL_JOBS)
+        loaded_snap = _small_wall_snapshot(service)
         large = client.wait(large_id, timeout=600, poll_cap_seconds=0.2)
         large_seconds = time.perf_counter() - t_large
         if large["job"]["status"] != "done":
             raise RuntimeError(f"serve-load large job failed: {large}")
         health = client.healthz()
+        # The observability surface under test: the HTTP fleet-stats
+        # document must exist and carry the same class quantiles.
+        import urllib.request
+
+        with urllib.request.urlopen(
+            server.url + "/v1/fleet/stats", timeout=30
+        ) as resp:
+            fleet = json.loads(resp.read().decode("utf-8"))
     finally:
         server.shutdown()
         service.stop(timeout=60)
         shutil.rmtree(run_dir, ignore_errors=True)
 
-    unloaded_p99 = _percentile(unloaded, 0.99)
-    loaded_p99 = _percentile(loaded, 0.99)
+    unloaded_stats = _phase_quantiles(
+        _snapshot_delta(unloaded_snap, baseline_snap), "unloaded"
+    )
+    loaded_stats = _phase_quantiles(
+        _snapshot_delta(loaded_snap, unloaded_snap), "loaded"
+    )
+    unloaded_p99 = unloaded_stats["p99"]
+    loaded_p99 = loaded_stats["p99"]
     ratio = loaded_p99 / unloaded_p99 if unloaded_p99 > 0 else None
     return {
         "metric": (
@@ -268,13 +340,22 @@ def _run_serve_load_config(device) -> dict:
             ],
             "sliced": sliced,
             "small_jobs_per_phase": SERVE_LOAD_SMALL_JOBS,
-            "small_unloaded_seconds": {
-                "p50": round(_percentile(unloaded, 0.5), 4),
-                "p99": round(unloaded_p99, 4),
+            # Server-side wall quantiles from `serve_job_wall_seconds`
+            # snapshot deltas — the metric `/v1/fleet/stats` serves.
+            "small_unloaded_seconds": unloaded_stats,
+            "small_loaded_seconds": loaded_stats,
+            # Client-observed submit->terminal latency, for comparison
+            # with the server-side histograms (includes HTTP + polling).
+            "client_observed_seconds": {
+                "unloaded_p50": round(_percentile(unloaded, 0.5), 4),
+                "unloaded_p99": round(_percentile(unloaded, 0.99), 4),
+                "loaded_p50": round(_percentile(loaded, 0.5), 4),
+                "loaded_p99": round(_percentile(loaded, 0.99), 4),
             },
-            "small_loaded_seconds": {
-                "p50": round(_percentile(loaded, 0.5), 4),
-                "p99": round(loaded_p99, 4),
+            "fleet_stats": {
+                "classes": fleet.get("classes"),
+                "calibration": fleet.get("calibration"),
+                "counters": fleet.get("counters"),
             },
             "large_job_seconds": round(
                 large["job"]["seconds"] or large_seconds, 3

@@ -762,7 +762,32 @@ hits = [l for l in client.metrics().splitlines()
 if not hits or float(hits[0].split()[1]) < 1:
     print(f"/metrics shows no warm-geometry hit: {hits}"); sys.exit(1)
 
-# 4. SIGTERM drain: a fresh-geometry job holds the worker (cold compile),
+# 4. deadline below the calibrated estimate -> structured 413 carrying
+#    both numbers; a feasible resubmit completes and its per-job manifest
+#    lands the predicted-vs-measured cost block.
+try:
+    client.submit(flags, deadline_seconds=0.001)
+    print("infeasible-deadline submit was ACCEPTED"); sys.exit(1)
+except ServeError as e:
+    if e.status != 413 or e.code != "deadline-infeasible":
+        print(f"infeasible deadline not a structured 413: "
+              f"{e.status} {e.code}"); sys.exit(1)
+    cost = e.body.get("cost") or {}
+    predicted = cost.get("predicted_seconds")
+    message = (e.body.get("error") or {}).get("message") or ""
+    if not predicted or cost.get("requested_deadline_seconds") != 0.001 \
+            or "0.001" not in message or f"{predicted:.4g}" not in message:
+        print(f"413 body does not name predicted vs requested: {e.body}")
+        sys.exit(1)
+job3 = client.wait(client.submit(flags)["job"]["id"], timeout=300)["job"]
+cost_doc = read_manifest(job3["manifest_path"]).get("cost")
+if not cost_doc or cost_doc.get("compile") not in ("warm", "cold") \
+        or not isinstance(cost_doc.get("measured_seconds"), (int, float)) \
+        or not isinstance(cost_doc.get("predicted_seconds"), (int, float)) \
+        or not isinstance(cost_doc.get("queue_wait_seconds"), (int, float)):
+    print(f"done job's manifest has no cost block: {cost_doc}"); sys.exit(1)
+
+# 5. SIGTERM drain: a fresh-geometry job holds the worker (cold compile),
 #    new submissions get 503, the in-flight job still finishes.
 inflight = client.submit(["--num-samples", "12",
                           "--references", "1:0:50000"])["job"]
@@ -909,10 +934,21 @@ repeat = client.wait(client.submit(SMALL)["job"]["id"], timeout=300)["job"]
 if repeat["compile_cache"] != "warm":
     print(f"repeat-geometry job not warm after restart: {repeat}")
     sys.exit(1)
+# The calibration ledger is append-only and fsync'd: the kill -9 above
+# must not have cost the pre-kill measured samples. The restarted daemon
+# alone completed only 2 jobs (large3 + repeat; large2 failed, failures
+# are never recorded) — more than 2 folded samples proves the pre-kill
+# rows survived the crash.
+from spark_examples_tpu.obs.calibration import calibration_path, fold_calibration
+fold = fold_calibration(calibration_path(tmp + "/run"))
+if fold.overall.n <= 2:
+    print(f"calibration ledger lost pre-kill samples: n={fold.overall.n}")
+    sys.exit(1)
 print(f"serve concurrency phase 2 OK: {health['warm_state']['journal_replayed']} "
       f"jobs replayed, queued job finished ({replayed['seconds']:.2f}s), "
       "mid-device job failed structurally, repeat geometry warm from the "
-      "persistent run-dir state")
+      f"persistent run-dir state, calibration ledger kept {fold.overall.n} "
+      "samples across kill -9")
 PYEOF
       kill -TERM "$SC_PID" 2>/dev/null
       if ! wait "$SC_PID"; then
@@ -949,9 +985,20 @@ if loaded > max(2.0 * unloaded, unloaded + 2.0):
 if loaded >= large:
     print(f"small-job P99 {loaded:.3f}s >= large job {large:.3f}s: "
           "head-of-line blocking"); sys.exit(1)
+# The /v1/fleet/stats document the bench fetched over HTTP must be
+# valid and carry nonzero small-class quantiles + a calibration fold.
+fs = d["fleet_stats"]
+wall = ((fs.get("classes") or {}).get("small") or {}).get("wall_seconds") or {}
+if not wall.get("count") or not wall.get("p99") or wall["p99"] <= 0:
+    print(f"/v1/fleet/stats has no nonzero small wall quantiles: {fs}")
+    sys.exit(1)
+if not (fs.get("calibration") or {}).get("samples"):
+    print(f"/v1/fleet/stats calibration fold empty: {fs}"); sys.exit(1)
 print(f"serve-load OK: small P99 {unloaded:.3f}s unloaded -> "
       f"{loaded:.3f}s beside a {large:.2f}s large job "
-      f"({doc['value']}x, bound 2x)")
+      f"({doc['value']}x, bound 2x); fleet stats: small wall p99 "
+      f"{wall['p99']:.3f}s over {wall['count']} jobs, calibration "
+      f"n={fs['calibration']['samples']}")
 PYEOF
   else
     echo "serve-load bench failed:"; tail -10 "$SC_TMP/load.err"
@@ -1113,6 +1160,45 @@ print(f"trace export OK: {doc['otherData']['recorder_events']} events, "
       f"{job_id} complete across {len(pids)} processes (steal arrow + "
       f"epoch {facts['lease_epoch']} + fenced terminal "
       f"'{facts['status']}'), zero orphan spans")
+PYEOF
+  fi
+fi
+if [ "$rep_rc" -eq 0 ]; then
+  # Post-mortem cost observatory: with the whole fleet dead, `obs
+  # report` must reconstruct the stolen job's prediction, wall, and
+  # queue-wait under its one trace id — purely from the run-dir
+  # artifacts (journal + calibration ledger + recorder segments).
+  env JAX_PLATFORMS=cpu python -m spark_examples_tpu obs report \
+    --run-dir "$REP_TMP/rd" --json > "$REP_TMP/fleet.report.json" \
+    || rep_rc=$?
+  if [ "$rep_rc" -eq 0 ]; then
+    env JAX_PLATFORMS=cpu python - "$REP_TMP/fleet.report.json" <<'PYEOF' || rep_rc=$?
+import json, sys
+doc = json.load(open(sys.argv[1]))
+stolen = {j: f for j, f in doc["jobs"].items() if f.get("stolen")}
+if not stolen:
+    print(f"fleet report records no stolen job: {list(doc['jobs'])}")
+    sys.exit(1)
+job_id, facts = sorted(stolen.items())[0]
+missing = [k for k in
+           ("trace", "predicted_seconds", "measured_seconds",
+            "queue_wait_seconds")
+           if facts.get(k) is None]
+if missing:
+    print(f"fleet report's stolen job {job_id} lacks {missing}: {facts}")
+    sys.exit(1)
+if facts["status"] != "failed":
+    print(f"stolen job's fenced status wrong in the report: {facts}")
+    sys.exit(1)
+if not doc["totals"]["ledger_samples"] or not doc["recorder"]:
+    print(f"report missing ledger or recorder facts: {doc['totals']}")
+    sys.exit(1)
+print(f"obs report OK (fleet dead): stolen job {job_id} trace="
+      f"{facts['trace'][:8]}... predicted {facts['predicted_seconds']:.2f}s,"
+      f" wall {facts['measured_seconds']:.2f}s, queue wait "
+      f"{facts['queue_wait_seconds']:.2f}s; "
+      f"{doc['totals']['ledger_samples']} ledger samples, "
+      f"{doc['recorder']['events']} recorder events")
 PYEOF
   fi
 fi

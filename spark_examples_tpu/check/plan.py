@@ -1420,10 +1420,91 @@ def validate_plan(
     return report
 
 
+def predict_job_cost(
+    conf: PcaConf,
+    topology=None,
+    *,
+    kind: str = "pca",
+    plan_devices: Optional[int] = None,
+    geometry: Optional[Dict] = None,
+):
+    """One job's admission-time :class:`~spark_examples_tpu.obs.costmodel.
+    CostPrediction`, assembled from the SAME geometry facts the plan
+    validator proves — plan, serve admission, and bench share this ONE
+    estimator, so a prediction printed by ``graftcheck plan`` and one
+    stamped on a served job can never disagree.
+
+    ``geometry`` short-circuits re-validation: serve admission already
+    ran :func:`validate_plan` and passes ``report.geometry`` straight in
+    (one validation per job, not two). Without it, this validates the
+    plan itself (``topology`` adds the schedule simulator's critical-path
+    term). The prediction is always produced, even for a plan with
+    findings — a cost estimate is telemetry, not a gate; admission
+    rejects on the findings separately."""
+    from spark_examples_tpu.obs.costmodel import (
+        COMPILE_COLD,
+        COMPILE_WARM,
+        CostPrediction,
+        estimate_seconds,
+    )
+    from spark_examples_tpu.utils.cache import (
+        compile_fingerprint,
+        geometry_seen,
+    )
+
+    if geometry is None:
+        analysis = kind if kind in ANALYSIS_SURFACES else "pca"
+        report = validate_plan(
+            conf,
+            plan_devices=plan_devices,
+            analysis=analysis,
+            topology=topology,
+        )
+        geometry = report.geometry
+
+    fingerprint = compile_fingerprint(conf, kind=kind)
+    warm = geometry_seen(fingerprint)
+    sites = _static_site_rows(conf)
+    host_peak = geometry.get("host_peak_bytes")
+    if host_peak is None:
+        from spark_examples_tpu.check.hostmem import conf_host_peak_bytes
+
+        try:
+            host_peak = conf_host_peak_bytes(conf, device_count=plan_devices)
+        except Exception:
+            host_peak = None
+    sched_seconds = geometry.get("sched_critical_path_seconds")
+    ring_bytes = geometry.get("ring_bytes_per_flush")
+    model = estimate_seconds(
+        sites=sites,
+        host_peak_bytes=None if host_peak is None else int(host_peak),
+        sched_seconds=(
+            None if sched_seconds is None else float(sched_seconds)
+        ),
+        cold=not warm,
+    )
+    return CostPrediction(
+        predicted_seconds=model["predicted_seconds"],
+        kind=str(kind),
+        fingerprint=fingerprint,
+        compile=COMPILE_WARM if warm else COMPILE_COLD,
+        compute_seconds=model["compute_seconds"],
+        sched_seconds=(
+            None if sched_seconds is None else float(sched_seconds)
+        ),
+        sites=sites,
+        host_peak_bytes=None if host_peak is None else int(host_peak),
+        ring_bytes_per_flush=(
+            None if ring_bytes is None else int(ring_bytes)
+        ),
+    )
+
+
 __all__ = [
     "ANALYSIS_SURFACES",
     "PlanIssue",
     "PlanReport",
     "parse_plan_args",
+    "predict_job_cost",
     "validate_plan",
 ]

@@ -91,6 +91,16 @@ arrows — load it in chrome://tracing or https://ui.perfetto.dev):
 
     python -m spark_examples_tpu trace export --run-dir /tmp/serve \\
         --out fleet.trace.json
+
+Cost observatory (``obs/report.py``; README "Fleet stats & cost
+calibration"): every admitted job carries a predicted cost, every
+finished job appends a measured one to the crash-durable calibration
+ledger, and ``obs report`` folds journal + ledger + recorder segments
+into a post-mortem fleet report (per-job predicted vs measured under
+one trace id, per-class latency quantiles, calibration ratios) —
+purely from run-dir artifacts, so it works on a dead fleet:
+
+    python -m spark_examples_tpu obs report --run-dir /tmp/serve --json
 """
 
 from __future__ import annotations
@@ -193,6 +203,15 @@ def _trace(argv):
     return export_main(argv)
 
 
+def _obs(argv):
+    # Post-mortem cost observatory (obs/report.py): folds a fleet's
+    # journal + calibration ledger + recorder segments into one report.
+    # Pure file I/O — dispatched before the platform/cache setup.
+    from spark_examples_tpu.obs.report import report_main
+
+    return report_main(argv)
+
+
 COMMANDS = {
     "variants-pca": lambda argv: pca_driver.run(argv),
     "grm": _grm,
@@ -202,6 +221,7 @@ COMMANDS = {
     "serve": _serve,
     "submit": _submit,
     "trace": _trace,
+    "obs": _obs,
     "search-variants-klotho": _variants_cmd(variants_examples.run_klotho),
     "search-variants-brca1": _variants_cmd(variants_examples.run_brca1),
     "search-reads-example-1": _reads_cmd(reads_examples.run_example1, ["readset"]),
@@ -225,12 +245,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if command not in COMMANDS:
         print(f"unknown command: {command}", file=sys.stderr)
         return 2
-    if command in ("graftcheck", "submit", "trace"):
+    if command in ("graftcheck", "submit", "trace", "obs"):
         # Analysis-only / client-only: no platform override, no compile
         # cache — graftcheck must run identically on devices-free CI
         # boxes, `submit` talks to a (possibly remote) daemon without
-        # initializing a local backend, and `trace export` is pure file
-        # I/O over a run dir. Exit codes propagate.
+        # initializing a local backend, and `trace export` / `obs
+        # report` are pure file I/O over a run dir. Exit codes
+        # propagate.
         return int(COMMANDS[command](rest))
     # After the help/unknown early-outs: only real commands pay (and benefit
     # from) the process-global platform/cache configuration.

@@ -1,0 +1,213 @@
+"""Per-job cost predictions: the admission-time estimate the fleet audits.
+
+The serve fleet already *computes* device-free cost facts per job — the
+collective-schedule simulator's critical-path seconds (``graftcheck
+sched`` GS005), the TOTAL host-memory bound (``graftcheck hostmem``),
+ring bytes per flush — but until this module none of them were ever
+recorded ON the job. :class:`CostPrediction` is that record: a small,
+JSON-round-trippable envelope stamped at admission into the job doc, the
+journal's ``accepted`` record (so it survives compaction, restart, and
+replica steal exactly like the trace id), and the per-job manifest.
+
+The prediction combines two sources:
+
+- **link transfer** — the sched simulator's critical-path seconds, when
+  the configuration proves a ring schedule on a declared topology. This
+  term is exact for what it models, but it models ONLY ppermute traffic:
+  a single-device job has no collectives and would predict ~0.
+- **compute throughput** — a deliberately coarse sites-per-second model
+  (:data:`SITES_PER_SECOND`) plus fixed dispatch overhead and a cold-
+  compile penalty. Coarse is fine: the calibration ledger
+  (``obs/calibration.py``) learns the per-geometry measured/predicted
+  ratio, so the model only has to be *monotone and positive* — the
+  learned ratio absorbs the constant.
+
+The floor (:data:`MIN_PREDICTED_SECONDS`) keeps every prediction
+strictly positive, which makes deadline-feasibility deterministic: a
+submitted ``deadline_seconds`` below the floor is infeasible for ANY
+job, so the 413 path needs no special empty-model case.
+
+No imports from ``check/`` or ``serve/`` here — this module sits below
+both (plan builds predictions, serve stamps and measures them), and a
+cycle would force lazy imports everywhere above.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Mapping, Optional
+
+#: Coarse device throughput for the compute term (candidate sites per
+#: second). Intentionally conservative next to the measured ~13.5 M
+#: sites/s/chip whole-genome number (DESIGN.md §7): the calibration
+#: ledger's per-geometry ratio corrects the constant, and a conservative
+#: base errs toward over-prediction — the safe direction for deadline
+#: feasibility (reject-early beats accept-then-expire).
+SITES_PER_SECOND: float = 2_000_000.0
+
+#: Bytes/second proxy used when the site count has no static bound
+#: (file/REST cohorts): the host-memory bound is TOTAL for every source,
+#: so ``host_peak_bytes`` over a nominal ingest bandwidth gives a finite,
+#: monotone stand-in for the compute term.
+HOST_BYTES_PER_SECOND: float = 200e6
+
+#: Fixed per-job dispatch/finalize overhead (queue handoff, manifest
+#: write, result marshalling) — the latency floor even a trivial warm
+#: job pays.
+DISPATCH_OVERHEAD_SECONDS: float = 0.05
+
+#: One-time penalty when the geometry ledger says this compile
+#: fingerprint has never been built in this process fleet.
+COLD_COMPILE_SECONDS: float = 1.5
+
+#: Hard positive floor on every prediction (see module docstring).
+MIN_PREDICTED_SECONDS: float = 0.05
+
+#: The two compile expectations a prediction can carry.
+COMPILE_WARM = "warm"
+COMPILE_COLD = "cold"
+
+
+@dataclass
+class CostPrediction:
+    """One job's admission-time cost estimate, JSON-round-trippable.
+
+    ``predicted_seconds`` is the headline number (floored, penalty
+    included); the remaining fields are its provenance, kept so the
+    post-mortem report and the calibration fold can attribute error to
+    the right term instead of a single opaque scalar.
+    """
+
+    predicted_seconds: float
+    kind: str = "pca"
+    fingerprint: Optional[str] = None
+    compile: str = COMPILE_COLD
+    compute_seconds: float = 0.0
+    sched_seconds: Optional[float] = None
+    sites: Optional[int] = None
+    host_peak_bytes: Optional[int] = None
+    ring_bytes_per_flush: Optional[int] = None
+    calibrated_seconds: Optional[float] = None
+    calibration_ratio: Optional[float] = None
+    calibration_samples: int = 0
+
+    def to_dict(self) -> Dict[str, object]:
+        """The additive envelope block (job doc / journal / manifest)."""
+        out: Dict[str, object] = {
+            "predicted_seconds": float(self.predicted_seconds),
+            "kind": self.kind,
+            "compile": self.compile,
+            "compute_seconds": float(self.compute_seconds),
+        }
+        if self.fingerprint is not None:
+            out["fingerprint"] = self.fingerprint
+        if self.sched_seconds is not None:
+            out["sched_seconds"] = float(self.sched_seconds)
+        if self.sites is not None:
+            out["sites"] = int(self.sites)
+        if self.host_peak_bytes is not None:
+            out["host_peak_bytes"] = int(self.host_peak_bytes)
+        if self.ring_bytes_per_flush is not None:
+            out["ring_bytes_per_flush"] = int(self.ring_bytes_per_flush)
+        if self.calibrated_seconds is not None:
+            out["calibrated_seconds"] = float(self.calibrated_seconds)
+        if self.calibration_ratio is not None:
+            out["calibration_ratio"] = float(self.calibration_ratio)
+        if self.calibration_samples:
+            out["calibration_samples"] = int(self.calibration_samples)
+        return out
+
+    @classmethod
+    def from_dict(cls, doc: Mapping) -> Optional["CostPrediction"]:
+        """Parse a stamped prediction back; ``None`` on junk — a torn or
+        foreign ``cost`` block must never kill a journal replay."""
+        try:
+            predicted = float(doc["predicted_seconds"])
+        except (KeyError, TypeError, ValueError):
+            return None
+        if not (predicted == predicted and predicted >= 0):
+            return None
+
+        def _opt_float(key):
+            value = doc.get(key)
+            return None if value is None else float(value)
+
+        def _opt_int(key):
+            value = doc.get(key)
+            return None if value is None else int(value)
+
+        try:
+            return cls(
+                predicted_seconds=predicted,
+                kind=str(doc.get("kind") or "pca"),
+                fingerprint=(
+                    str(doc["fingerprint"])
+                    if doc.get("fingerprint") is not None
+                    else None
+                ),
+                compile=(
+                    COMPILE_WARM
+                    if doc.get("compile") == COMPILE_WARM
+                    else COMPILE_COLD
+                ),
+                compute_seconds=float(doc.get("compute_seconds") or 0.0),
+                sched_seconds=_opt_float("sched_seconds"),
+                sites=_opt_int("sites"),
+                host_peak_bytes=_opt_int("host_peak_bytes"),
+                ring_bytes_per_flush=_opt_int("ring_bytes_per_flush"),
+                calibrated_seconds=_opt_float("calibrated_seconds"),
+                calibration_ratio=_opt_float("calibration_ratio"),
+                calibration_samples=int(doc.get("calibration_samples") or 0),
+            )
+        except (TypeError, ValueError):
+            return None
+
+    @property
+    def best_estimate_seconds(self) -> float:
+        """The number deadline feasibility compares against: the
+        calibrated estimate when the ledger has seen this geometry, the
+        raw model otherwise."""
+        if self.calibrated_seconds is not None:
+            return self.calibrated_seconds
+        return self.predicted_seconds
+
+
+def estimate_seconds(
+    *,
+    sites: Optional[int],
+    host_peak_bytes: Optional[int],
+    sched_seconds: Optional[float],
+    cold: bool,
+) -> Dict[str, float]:
+    """The model itself, pure arithmetic over geometry facts: compute
+    term from the static site count (bytes-proxy fallback), max'd with
+    the schedule simulator's link term (compute and transfer overlap —
+    the double-buffered feed), plus overhead and the cold penalty.
+    Returns ``{"compute_seconds", "predicted_seconds"}``."""
+    if sites is not None and sites > 0:
+        compute = float(sites) / SITES_PER_SECOND
+    elif host_peak_bytes is not None and host_peak_bytes > 0:
+        compute = float(host_peak_bytes) / HOST_BYTES_PER_SECOND
+    else:
+        compute = 0.0
+    body = max(compute, float(sched_seconds or 0.0))
+    predicted = DISPATCH_OVERHEAD_SECONDS + body
+    if cold:
+        predicted += COLD_COMPILE_SECONDS
+    return {
+        "compute_seconds": compute,
+        "predicted_seconds": max(predicted, MIN_PREDICTED_SECONDS),
+    }
+
+
+__all__ = [
+    "COLD_COMPILE_SECONDS",
+    "COMPILE_COLD",
+    "COMPILE_WARM",
+    "CostPrediction",
+    "DISPATCH_OVERHEAD_SECONDS",
+    "HOST_BYTES_PER_SECOND",
+    "MIN_PREDICTED_SECONDS",
+    "SITES_PER_SECOND",
+    "estimate_seconds",
+]

@@ -46,6 +46,11 @@ Well-known metric names sampled (producers register them; see DESIGN.md §9):
   ``serve_lease_renewals_total`` (counters) — the multi-replica lease
   substrate's liveness, so a replica daemon's heartbeat shows the pool
   thinning (and its own steals) the moment a peer stops renewing
+- ``cost_predicted_mean_seconds`` / ``cost_measured_mean_seconds`` /
+  ``cost_calibration_samples`` (function-backed gauges over the folded
+  calibration ledger, ``obs/calibration.py``) — the cost observatory's
+  predicted-vs-measured segment, e.g. ``cost pred 3.2s / meas 2.9s
+  (ratio 0.91, n=17)``
 - ``compile_cache_geometry_hits`` / ``..._misses`` (function-backed
   gauges) — the warm-geometry ledger (``utils/cache.py``), the resident
   service's compile-once promise per tick
@@ -69,6 +74,9 @@ from spark_examples_tpu.obs.metrics import (
     ANALYSIS_SITES_TESTED,
     COMPILE_CACHE_GEOMETRY_HITS,
     COMPILE_CACHE_GEOMETRY_MISSES,
+    COST_CALIBRATION_SAMPLES,
+    COST_MEASURED_MEAN_SECONDS,
+    COST_PREDICTED_MEAN_SECONDS,
     GRAMIAN_INFLIGHT_DISPATCHES,
     GRAMIAN_RING_BYTES,
     HOST_PEAK_RSS_BYTES,
@@ -315,6 +323,33 @@ class Heartbeat:
             if batch_jobs:
                 segment += f" ({int(batch_jobs)} jobs)"
             parts.append(segment)
+
+        # Cost-calibration segment (obs/calibration.py fold, sampled via
+        # the function-backed COST_* gauges the serve daemon registers):
+        # mean predicted vs mean measured wall seconds with the learned
+        # ratio and the sample count behind it — silent until the first
+        # completed job lands in the ledger (the gauges read NaN).
+        cost_n = self.registry.value(COST_CALIBRATION_SAMPLES)
+        if cost_n is not None and cost_n == cost_n and cost_n > 0:
+            predicted = self.registry.value(COST_PREDICTED_MEAN_SECONDS)
+            measured = self.registry.value(COST_MEASURED_MEAN_SECONDS)
+            if (
+                predicted is not None
+                and predicted == predicted
+                and measured is not None
+                and measured == measured
+            ):
+                segment = (
+                    f"cost pred {predicted:.1f}s / meas {measured:.1f}s"
+                )
+                if predicted > 0:
+                    segment += (
+                        f" (ratio {measured / predicted:.2f}, "
+                        f"n={int(cost_n)})"
+                    )
+                else:
+                    segment += f" (n={int(cost_n)})"
+                parts.append(segment)
 
         # Warm-geometry compile-cache pair (utils/cache.py ledger): the
         # compile-once promise of a resident process, visible per tick.

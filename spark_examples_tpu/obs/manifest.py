@@ -56,7 +56,15 @@ GR005-proven projection) — the prover-conformance telemetry the driver's
 epilogue registers (``obs/metrics.py:record_prover_conformance``); ``ok``
 is the measured<=proven verdict (null when no bound was provable). Null
 on runs without conformance telemetry, so existing consumers are
-untouched.
+untouched. Still v2 (additive): the optional ``cost`` block —
+``{predicted_seconds, measured_seconds, queue_wait_seconds, compile}``
+— stamped by the serve daemon onto a completed job's manifest
+(``serve/daemon.py:_stamp_manifest_cost``): the admission-time cost
+prediction (``obs/costmodel.py``) next to the measured wall clock and
+queue wait, with ``compile`` naming the observed ``warm``/``cold``
+disposition; extra prediction detail (``fingerprint``,
+``calibrated_seconds``, ...) may ride along. Null on batch runs, so
+existing consumers are untouched.
 
 Multi-host: under ``jax.distributed`` each process carries per-process
 I/O counters. :func:`build_run_manifest` aggregates them across processes
@@ -205,6 +213,7 @@ def build_manifest(
     analysis: Optional[Dict] = None,
     schedule: Optional[Dict] = None,
     conformance: Optional[Dict] = None,
+    cost: Optional[Dict] = None,
 ) -> Dict:
     """Assemble a manifest from already-snapshotted parts (the low-level
     form; :func:`build_run_manifest` snapshots a live driver). The
@@ -231,6 +240,7 @@ def build_manifest(
         "analysis": analysis,
         "schedule": schedule,
         "conformance": conformance,
+        "cost": cost,
         "compile_cache": _compile_cache_block(),
         "process": _process_block(),
         "multihost": multihost,
@@ -489,6 +499,35 @@ def validate_manifest(doc) -> List[str]:
                         f"conformance.{prover}.ok is neither null nor a "
                         f"bool: {ok!r}"
                     )
+
+    cost = doc.get("cost")
+    if cost is not None:
+        if not isinstance(cost, Mapping):
+            errors.append("'cost' is neither null nor an object")
+        else:
+            for field in (
+                "predicted_seconds",
+                "measured_seconds",
+                "queue_wait_seconds",
+            ):
+                value = cost.get(field, "absent")
+                if (
+                    value == "absent"
+                    or isinstance(value, bool)
+                    or not isinstance(value, (int, float))
+                    or value != value
+                    or value < 0
+                ):
+                    errors.append(
+                        f"cost.{field} missing or not a non-negative "
+                        f"number: {value!r}"
+                    )
+            compile_disposition = cost.get("compile")
+            if compile_disposition not in ("warm", "cold"):
+                errors.append(
+                    f"cost.compile is neither 'warm' nor 'cold': "
+                    f"{compile_disposition!r}"
+                )
 
     schedule = doc.get("schedule")
     if schedule is not None:

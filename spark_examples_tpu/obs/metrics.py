@@ -36,6 +36,17 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0,
 )
 
+#: Wall-clock buckets for whole-job latencies (seconds; +Inf implied).
+#: DEFAULT_BUCKETS tops out at 60 s — fine for flush/RPC timings, but a
+#: whole-genome large-class serve job can run minutes, and every quantile
+#: above the top bound collapses into +Inf (``histogram_quantile`` can
+#: only answer "more than 60"). These extend to an hour so fleet P99s
+#: stay interpolable across the full measured job-latency range.
+WIDE_SECONDS_BUCKETS: Tuple[float, ...] = (
+    0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    60.0, 120.0, 300.0, 900.0, 3600.0,
+)
+
 
 class MetricError(ValueError):
     """Invalid metric registration or use (name/type/label mismatch)."""
@@ -134,6 +145,23 @@ SERVE_JOURNAL_REPLAYED = "serve_journal_replayed_total"
 SERVE_LEASE_RENEWALS = "serve_lease_renewals_total"
 SERVE_JOBS_STOLEN = "serve_jobs_stolen_total"
 SERVE_REPLICAS_ALIVE = "serve_replicas_alive"
+
+#: Fleet cost observatory (``obs/costmodel.py`` + ``obs/calibration.py``):
+#: queue-wait and whole-job wall-clock histograms (wall labeled
+#: ``kind``/``job_class``/``compile`` so warm and cold populations never
+#: blur into one distribution), and the measured/predicted ratio of the
+#: most recent completed job per kind — the live needle of the
+#: calibration ledger's per-geometry fold.
+SERVE_QUEUE_WAIT_SECONDS = "serve_queue_wait_seconds"
+SERVE_JOB_WALL_SECONDS = "serve_job_wall_seconds"
+COST_PREDICTION_RATIO = "cost_prediction_ratio"
+
+#: Calibration-ledger fold summary gauges the heartbeat samples
+#: (``obs/heartbeat.py`` cost segment): mean predicted and measured wall
+#: seconds over the folded ledger and the sample count behind them.
+COST_PREDICTED_MEAN_SECONDS = "cost_predicted_mean_seconds"
+COST_MEASURED_MEAN_SECONDS = "cost_measured_mean_seconds"
+COST_CALIBRATION_SAMPLES = "cost_calibration_samples"
 
 #: Host-memory cross-validation pair (``graftcheck hostmem``'s runtime
 #: half): the measured peak process RSS (function-backed — every read
@@ -257,6 +285,18 @@ _WELL_KNOWN_GAUGE_HELP = {
     SERVE_REPLICAS_ALIVE: (
         "Replica daemons currently heartbeating against this shared run "
         "dir, self included (serve/journal.py lease substrate)."
+    ),
+    COST_PREDICTED_MEAN_SECONDS: (
+        "Mean predicted wall seconds over the folded calibration ledger "
+        "(obs/calibration.py; the heartbeat's cost segment numerator)."
+    ),
+    COST_MEASURED_MEAN_SECONDS: (
+        "Mean measured wall seconds over the folded calibration ledger "
+        "(obs/calibration.py; pairs with cost_predicted_mean_seconds)."
+    ),
+    COST_CALIBRATION_SAMPLES: (
+        "Completed (predicted, measured) job pairs folded into the "
+        "calibration ledger so far — the n behind the learned ratios."
     ),
 }
 
@@ -575,6 +615,59 @@ def _format_bound(bound: float) -> str:
     return text[:-2] if text.endswith(".0") else text
 
 
+def _parse_bound(text: str) -> float:
+    return float("inf") if text == "+Inf" else float(text)
+
+
+def histogram_quantile(snapshot: Mapping, q: float) -> Optional[float]:
+    """Estimate the q-quantile of a :meth:`Histogram.snapshot` (or any
+    dict shaped like one: cumulative ``buckets`` keyed by upper-bound
+    string, plus ``count``) by linear interpolation inside the target
+    bucket — the Prometheus ``histogram_quantile`` estimator, applied to
+    one snapshot instead of a rate.
+
+    Contract (the edges tests pin):
+
+    - empty histogram (``count == 0``) → ``None`` — "no data" must be
+      distinguishable from "0 seconds";
+    - ``q <= 0`` → the lower edge of the first populated bucket (0.0
+      when that is the first bucket — observations have no recorded
+      lower bound below their bucket floor);
+    - ``q >= 1`` → the upper bound of the highest populated bucket;
+    - mass landing in ``+Inf`` reports the highest FINITE bound — the
+      estimator cannot see above the top bucket, and returning a finite
+      floor ("at least this") beats returning infinity. Callers sizing
+      buckets for real latencies want :data:`WIDE_SECONDS_BUCKETS`.
+    """
+    buckets = snapshot.get("buckets") or {}
+    count = int(snapshot.get("count") or 0)
+    if count <= 0 or not buckets:
+        return None
+    pairs = sorted(
+        ((_parse_bound(k), int(v)) for k, v in buckets.items()),
+        key=lambda kv: kv[0],
+    )
+    top_finite = max(
+        (b for b, _ in pairs if not math.isinf(b)), default=0.0
+    )
+    rank = min(max(float(q), 0.0), 1.0) * count
+    prev_bound = 0.0
+    prev_cumulative = 0
+    for bound, cumulative in pairs:
+        if cumulative > prev_cumulative and rank <= cumulative:
+            if rank <= prev_cumulative:
+                return prev_bound
+            if math.isinf(bound):
+                return top_finite
+            fraction = (rank - prev_cumulative) / (
+                cumulative - prev_cumulative
+            )
+            return prev_bound + (bound - prev_bound) * fraction
+        prev_cumulative = cumulative
+        prev_bound = top_finite if math.isinf(bound) else bound
+    return top_finite
+
+
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
 
 
@@ -833,6 +926,14 @@ __all__ = [
     "MetricError",
     "MetricsRegistry",
     "DEFAULT_BUCKETS",
+    "WIDE_SECONDS_BUCKETS",
+    "histogram_quantile",
+    "SERVE_QUEUE_WAIT_SECONDS",
+    "SERVE_JOB_WALL_SECONDS",
+    "COST_PREDICTION_RATIO",
+    "COST_PREDICTED_MEAN_SECONDS",
+    "COST_MEASURED_MEAN_SECONDS",
+    "COST_CALIBRATION_SAMPLES",
     "INGEST_SITES_SCANNED",
     "INGEST_PARTITIONS_PLANNED",
     "INGEST_PARTITIONS_DONE",

@@ -217,6 +217,7 @@ class PcaService:
         lease_grace_seconds: Optional[float] = None,
         steal_interval_seconds: Optional[float] = None,
         guard_run_dir: bool = False,
+        deadline_feasibility: bool = True,
     ):
         if terminal_retention < 1:
             raise ValueError(
@@ -322,6 +323,21 @@ class PcaService:
         self._primed_geometries = 0
         self.device_count: Optional[int] = None
         self.platform: Optional[str] = None
+        #: Reject jobs whose deadline is below the calibrated cost
+        #: estimate at admission (413 ``deadline-infeasible``) instead of
+        #: queueing work that is guaranteed to expire. ``False`` restores
+        #: the optimistic pre-cost-observatory admission.
+        self.deadline_feasibility = bool(deadline_feasibility)
+        #: Fleet-shared predicted-vs-measured ledger (obs/calibration.py):
+        #: every replica appends to the one file under the run dir, so
+        #: the fold — and calibrated admission — sees the whole fleet.
+        from spark_examples_tpu.obs.calibration import CalibrationLedger
+
+        self._calibration = CalibrationLedger(self.run_dir)
+        # Expired queued jobs are swept at admission time (capacity must
+        # not be held by jobs that can never run); the sink routes them
+        # to the same terminal path a dequeued-too-late job takes.
+        self._queue.set_expired_sink(self._expire_queued_job)
 
         from spark_examples_tpu.obs import MetricsRegistry, SpanRecorder
 
@@ -401,6 +417,61 @@ class PcaService:
             "serve_job_seconds",
             "Wall-clock of completed jobs, by admission class.",
             labelnames=("job_class",),
+        )
+        from spark_examples_tpu.obs.metrics import (
+            COST_CALIBRATION_SAMPLES,
+            COST_MEASURED_MEAN_SECONDS,
+            COST_PREDICTED_MEAN_SECONDS,
+            COST_PREDICTION_RATIO,
+            SERVE_JOB_WALL_SECONDS,
+            SERVE_QUEUE_WAIT_SECONDS,
+            WIDE_SECONDS_BUCKETS,
+        )
+
+        self._queue_wait_seconds = self.registry.histogram(
+            SERVE_QUEUE_WAIT_SECONDS,
+            "Admission-to-dequeue wait of jobs, by admission class.",
+            labelnames=("job_class",),
+            buckets=WIDE_SECONDS_BUCKETS,
+        )
+        self._job_wall_seconds = self.registry.histogram(
+            SERVE_JOB_WALL_SECONDS,
+            "Executor wall-clock of completed jobs, by kind, admission "
+            "class, and compile cache disposition.",
+            labelnames=("kind", "job_class", "compile"),
+            buckets=WIDE_SECONDS_BUCKETS,
+        )
+        self._prediction_ratio = self.registry.gauge(
+            COST_PREDICTION_RATIO,
+            "measured/predicted wall-clock ratio of the most recently "
+            "completed job, by kind.",
+            labelnames=("kind",),
+        )
+        # Fleet calibration aggregates (this replica's fold of the shared
+        # ledger): NaN while no completed job has been recorded — the
+        # heartbeat's cost segment keys off the NaN guard.
+        well_known_gauge(
+            self.registry, COST_CALIBRATION_SAMPLES
+        ).set_function(lambda: float(self._calibration.fold.overall.n))
+        well_known_gauge(
+            self.registry, COST_PREDICTED_MEAN_SECONDS
+        ).set_function(
+            lambda: (
+                self._calibration.fold.overall.predicted_sum
+                / self._calibration.fold.overall.n
+                if self._calibration.fold.overall.n
+                else float("nan")
+            )
+        )
+        well_known_gauge(
+            self.registry, COST_MEASURED_MEAN_SECONDS
+        ).set_function(
+            lambda: (
+                self._calibration.fold.overall.measured_sum
+                / self._calibration.fold.overall.n
+                if self._calibration.fold.overall.n
+                else float("nan")
+            )
         )
         self._slice_inflight = self.registry.gauge(
             "serve_slice_inflight",
@@ -720,6 +791,11 @@ class PcaService:
             # SAME span tree its submit opened; pre-tracing journals get
             # a fresh id so every adopted job is still traceable.
             trace_id=record.trace_id or mint_trace_id(),
+            # The ORIGINAL admission prediction rides the steal/replay
+            # (like the trace id): the calibration pair must compare
+            # against what admission promised, not a re-prediction under
+            # the adopter's warm state.
+            cost_prediction=self._cost_from_record(record),
         )
         if count_replayed:
             self._journal_replayed.inc(1)
@@ -757,6 +833,7 @@ class PcaService:
                 )
             self._journal_terminal(job)
             self._completed.labels(status="failed").inc()
+            self._record_failed_cost(job)
             return False
         with self._lock:
             self._table[job.id] = job
@@ -774,6 +851,7 @@ class PcaService:
                 )
             self._journal_terminal(job)
             self._completed.labels(status="failed").inc()
+            self._record_failed_cost(job)
             return False
         return True
 
@@ -846,6 +924,7 @@ class PcaService:
             self._trace_event("drained")
             faults.remove_flush_hook(self._flush_recorder)
             self._recorder.close()
+        self._calibration.close()
         if self._run_dir_lock is not None:
             self._run_dir_lock.release()
             self._run_dir_lock = None
@@ -954,6 +1033,51 @@ class PcaService:
                 plan=plan_block,
             )
 
+        # Admission-time cost prediction: the ONE estimator (check/
+        # plan.py:predict_job_cost, shared with the plan CLI and bench)
+        # over the geometry the validator above just computed — no second
+        # validation — then calibrated against the fleet's measured
+        # history. Prediction is telemetry plus a feasibility gate; a
+        # cost-model failure must never take admission down with it.
+        prediction = None
+        try:
+            from spark_examples_tpu.check.plan import predict_job_cost
+
+            prediction = predict_job_cost(
+                conf,
+                kind=request.kind,
+                plan_devices=self.admission_devices(job_class),
+                geometry=report.geometry,
+            )
+            prediction = self._calibration.calibrated_estimate(prediction)
+        except Exception as e:  # noqa: BLE001 — telemetry, not a gate
+            print(f"serve: cost prediction failed: {e}", file=sys.stderr)
+        if (
+            self.deadline_feasibility
+            and prediction is not None
+            and request.deadline_seconds is not None
+            and request.deadline_seconds < prediction.best_estimate_seconds
+        ):
+            estimate = prediction.best_estimate_seconds
+            self._rejected.labels(code="deadline-infeasible").inc()
+            doc = error_doc(
+                "deadline-infeasible",
+                f"deadline_seconds={request.deadline_seconds:.4g} is below "
+                f"the calibrated estimate of {estimate:.4g}s for this "
+                f"geometry (model predicted "
+                f"{prediction.predicted_seconds:.4g}s, "
+                f"{prediction.compile} compile, "
+                f"{prediction.calibration_samples} calibration samples); "
+                "raise the deadline, or start the service with "
+                "--no-deadline-feasibility to queue it anyway",
+                plan=plan_block,
+            )
+            doc["cost"] = prediction.to_dict()
+            doc["cost"]["requested_deadline_seconds"] = float(
+                request.deadline_seconds
+            )
+            return 413, doc
+
         now = time.time()
         with self._lock:
             self._seq += 1
@@ -979,6 +1103,7 @@ class PcaService:
             plan_geometry=dict(report.geometry),
             batch_key=self._batch_key(conf, request.kind),
             trace_id=normalize_trace_id(trace_id) or mint_trace_id(),
+            cost_prediction=prediction,
         )
         with self._lock:
             self._table[job.id] = job
@@ -1062,7 +1187,42 @@ class PcaService:
             submitted_unix=job.submitted_unix,
             deadline_unix=job.deadline_unix,
             trace_id=job.trace_id,
+            cost=(
+                job.cost_prediction.to_dict()
+                if job.cost_prediction is not None
+                else None
+            ),
         )
+
+    def _cost_from_record(self, record):
+        """Rehydrate a journaled cost prediction (None on pre-cost
+        journals and junk blocks — replay must never die on one)."""
+        if not getattr(record, "cost", None):
+            return None
+        from spark_examples_tpu.obs.costmodel import CostPrediction
+
+        return CostPrediction.from_dict(record.cost)
+
+    def _expire_queued_job(self, job: Job) -> None:
+        """The queue's expired-sink target: a job swept out of the queue
+        because its deadline passed before any worker reached it. Called
+        OUTSIDE the queue lock (see ``BoundedJobQueue.put``); routes to
+        the same terminal path a dequeued-too-late job takes."""
+        now = time.time()
+        with self._lock:
+            if job.status != "queued":
+                return
+            job.status = "failed"
+            job.error = (
+                f"deadline-exceeded: queued {now - job.submitted_unix:.1f}s,"
+                f" deadline was "
+                f"{(job.deadline_unix or now) - job.submitted_unix:.1f}s "
+                "(swept at admission — expired before any worker freed up)"
+            )
+            job.finished_unix = now
+            self._mark_terminal_locked(job)
+        self._journal_terminal(job)
+        self._completed.labels(status="failed").inc()
 
     def _lease_epoch(self, job_id: str) -> Optional[int]:
         return (
@@ -1229,6 +1389,114 @@ class PcaService:
         existing export, unchanged."""
         return self.registry.prometheus_text()
 
+    @staticmethod
+    def _merged_quantiles(snapshots) -> Optional[Dict]:
+        """Merge same-bucket histogram snapshots (children of one family
+        share bucket bounds by construction) and report the standard
+        quantile trio — the fleet-stats shape for one latency surface."""
+        from spark_examples_tpu.obs.metrics import histogram_quantile
+
+        merged: Dict[str, int] = {}
+        total = 0.0
+        count = 0
+        for snap in snapshots:
+            for bound, cumulative in snap["buckets"].items():
+                merged[bound] = merged.get(bound, 0) + int(cumulative)
+            total += float(snap["sum"])
+            count += int(snap["count"])
+        if count == 0:
+            return None
+        snapshot = {"buckets": merged, "sum": total, "count": count}
+        return {
+            "count": count,
+            "mean": total / count,
+            "p50": histogram_quantile(snapshot, 0.50),
+            "p95": histogram_quantile(snapshot, 0.95),
+            "p99": histogram_quantile(snapshot, 0.99),
+        }
+
+    def _histogram_by_label(self, name: str, label: str) -> Dict[str, Dict]:
+        """Group one histogram family's children by a single label value
+        and merge each group's snapshots into quantiles."""
+        family = self.registry.get(name)
+        if family is None:
+            return {}
+        groups: Dict[str, List] = {}
+        for child in family.children():
+            key = child.labels_dict.get(label, "")
+            groups.setdefault(key, []).append(child.snapshot())
+        out: Dict[str, Dict] = {}
+        for key, snaps in sorted(groups.items()):
+            merged = self._merged_quantiles(snaps)
+            if merged is not None:
+                out[key] = merged
+        return out
+
+    def fleet_stats(self) -> Dict:
+        """``GET /v1/fleet/stats``: per-class latency quantiles, the
+        fleet calibration fold, and recovery counters in one JSON
+        document. Quantiles and counters are THIS replica's (each
+        replica's registry sees its own executions); the calibration
+        block is fleet-wide — every replica appends to the one shared
+        ledger, and this call re-folds it from disk so peers' completed
+        jobs are merged in."""
+        from spark_examples_tpu.obs.metrics import (
+            SERVE_JOB_WALL_SECONDS,
+            SERVE_QUEUE_WAIT_SECONDS,
+        )
+        from spark_examples_tpu.serve.protocol import protocol_block
+
+        fold = self._calibration.refresh()
+        uptime = (
+            time.time() - self._started_unix
+            if self._started_unix is not None
+            else None
+        )
+        with self._lock:
+            tracked = len(self._table)
+            inflight = self._inflight
+            terminal = self._terminal
+        classes: Dict[str, Dict] = {}
+        for job_class, wall in self._histogram_by_label(
+            SERVE_JOB_WALL_SECONDS, "job_class"
+        ).items():
+            classes.setdefault(job_class, {})["wall_seconds"] = wall
+        for job_class, wait in self._histogram_by_label(
+            SERVE_QUEUE_WAIT_SECONDS, "job_class"
+        ).items():
+            classes.setdefault(job_class, {})["queue_wait_seconds"] = wait
+        return {
+            "protocol": protocol_block(),
+            "replica": self.replica_id,
+            "uptime_seconds": uptime,
+            "jobs": {
+                "tracked": tracked,
+                "inflight": inflight,
+                "terminal": terminal,
+                "queue_depth": self._queue.total_depth(),
+            },
+            "classes": classes,
+            "kinds": self._histogram_by_label(
+                SERVE_JOB_WALL_SECONDS, "kind"
+            ),
+            "compile": self._histogram_by_label(
+                SERVE_JOB_WALL_SECONDS, "compile"
+            ),
+            "calibration": fold.summary(),
+            "counters": {
+                "jobs_stolen": int(self._jobs_stolen.value),
+                "worker_restarts": int(self._worker_restarts.value),
+                "journal_replayed": int(self._journal_replayed.value),
+                "lease_renewals": int(self._lease_renewals.value),
+                "replicas_alive": (
+                    self._lease_store.alive_count()
+                    if self._lease_store is not None
+                    else 0
+                ),
+            },
+            "run_dir": self.run_dir,
+        }
+
     def _mark_terminal_locked(self, job: Job) -> None:
         """Lifetime counter + bounded retention: the oldest terminal
         records past ``terminal_retention`` leave the table (their
@@ -1264,7 +1532,23 @@ class PcaService:
             slice_name=job.slice,
             batch_size=job.batch_size,
             trace=job.trace_id,
+            cost=self._job_cost_doc_locked(job),
         )
+
+    def _job_cost_doc_locked(self, job: Job) -> Optional[Dict]:
+        """The job envelope's ``cost`` block: the admission prediction
+        with measured fields merged in once they exist."""
+        prediction = job.cost_prediction
+        if prediction is None:
+            return None
+        doc = prediction.to_dict()
+        if job.queue_wait_seconds is not None:
+            doc["queue_wait_seconds"] = job.queue_wait_seconds
+        if job.seconds is not None:
+            doc["measured_seconds"] = job.seconds
+        if job.compile_cache:
+            doc["compile"] = job.compile_cache
+        return doc
 
     # --------------------------------------------------------------- worker
 
@@ -1303,6 +1587,13 @@ class PcaService:
 
     def _run_job(self, worker: _SliceWorker, job: Job) -> None:
         now = time.time()
+        # Queue wait is a fact the moment the worker holds the job,
+        # whatever happens next (run, expire, lease-lost abandon).
+        job.dequeued_unix = now
+        job.queue_wait_seconds = max(0.0, now - job.submitted_unix)
+        self._queue_wait_seconds.labels(job_class=job.job_class).observe(
+            job.queue_wait_seconds
+        )
         if job.deadline_unix is not None and now > job.deadline_unix:
             with self._lock:
                 job.status = "failed"
@@ -1357,6 +1648,10 @@ class PcaService:
             job_class=job.job_class,
             kind=job.request.kind,
             batch_size=job.batch_size,
+            # Durable on THIS replica's segment before any kill-point:
+            # the post-mortem report's queue-wait source for a job whose
+            # owner (and its histograms) died mid-run.
+            queue_wait=job.queue_wait_seconds,
             **(
                 {"epoch": self._lease_epoch(job.id)}
                 if self._lease_store is not None
@@ -1469,6 +1764,112 @@ class PcaService:
         self._journal_terminal(job)
         self._completed.labels(status=job.status).inc()
         self._job_seconds.labels(job_class=job.job_class).observe(seconds)
+        self._job_wall_seconds.labels(
+            kind=job.request.kind,
+            job_class=job.job_class,
+            compile=job.compile_cache
+            or (
+                job.cost_prediction.compile
+                if job.cost_prediction is not None
+                else "cold"
+            ),
+        ).observe(seconds)
+        if job.status == "done":
+            self._record_job_cost(job, seconds)
+            self._stamp_manifest_cost(job)
+        else:
+            self._record_failed_cost(job)
+
+    def _record_job_cost(self, job: Job, seconds: float) -> None:
+        """Feed one COMPLETED job's (predicted, measured) pair into the
+        fleet calibration ledger and the ratio gauge. Done-only: a failed
+        job's wall clock measures the failure path, not the geometry's
+        cost, and would poison the learned ratios. Best-effort — the
+        ledger is telemetry, never a reason to fail a finished job."""
+        prediction = job.cost_prediction
+        if prediction is None:
+            return
+        try:
+            if prediction.predicted_seconds > 0:
+                self._prediction_ratio.labels(kind=job.request.kind).set(
+                    seconds / prediction.predicted_seconds
+                )
+            self._calibration.record(
+                fingerprint=prediction.fingerprint,
+                kind=job.request.kind,
+                job_class=job.job_class,
+                predicted_seconds=prediction.predicted_seconds,
+                measured_seconds=seconds,
+                queue_wait_seconds=job.queue_wait_seconds or 0.0,
+                compile=job.compile_cache or prediction.compile,
+                job_id=job.id,
+                trace_id=job.trace_id,
+                unix=job.finished_unix,
+            )
+        except Exception as e:  # noqa: BLE001 — telemetry, not the job
+            print(
+                f"serve: calibration record failed for {job.id}: {e}",
+                file=sys.stderr,
+            )
+
+    def _record_failed_cost(self, job: Job) -> None:
+        """A failed job (crashed executor, fenced-off steal) still gets a
+        ledger row — ``status: failed``, which the ratio fold skips — so
+        the post-mortem report can put its fleet-side wall (submission
+        to fenced terminal) next to what admission predicted. The
+        queue wait is omitted when this replica never dequeued the job
+        (the owner that did may be dead; its flight-recorder segment
+        holds the wait). Best-effort, like every ledger write."""
+        prediction = job.cost_prediction
+        if prediction is None:
+            return
+        try:
+            settled = job.finished_unix or time.time()
+            self._calibration.record(
+                fingerprint=prediction.fingerprint,
+                kind=job.request.kind,
+                job_class=job.job_class,
+                predicted_seconds=prediction.predicted_seconds,
+                measured_seconds=max(0.0, settled - job.submitted_unix),
+                queue_wait_seconds=job.queue_wait_seconds,
+                compile=job.compile_cache or prediction.compile,
+                job_id=job.id,
+                trace_id=job.trace_id,
+                unix=settled,
+                status="failed",
+            )
+        except Exception as e:  # noqa: BLE001 — telemetry, not the job
+            print(
+                f"serve: calibration record failed for {job.id}: {e}",
+                file=sys.stderr,
+            )
+
+    def _stamp_manifest_cost(self, job: Job) -> None:
+        """Rewrite the finished job's manifest with its ``cost`` block
+        (predicted vs measured vs queue wait) — the per-job half of the
+        ledger, queryable post-mortem without the service. Atomic
+        (``obs/manifest.py:write_manifest``) and best-effort."""
+        prediction = job.cost_prediction
+        if prediction is None or not job.manifest_path:
+            return
+        try:
+            from spark_examples_tpu.obs.manifest import (
+                read_manifest,
+                write_manifest,
+            )
+
+            doc = read_manifest(job.manifest_path)
+            cost = prediction.to_dict()
+            cost["measured_seconds"] = job.seconds
+            cost["queue_wait_seconds"] = job.queue_wait_seconds or 0.0
+            cost["compile"] = job.compile_cache or prediction.compile
+            doc["cost"] = cost
+            write_manifest(job.manifest_path, doc)
+        except Exception as e:  # noqa: BLE001 — telemetry, not the job
+            print(
+                f"serve: manifest cost stamp failed for {job.id}: {e}",
+                file=sys.stderr,
+            )
 
     def _mirror_conformance(self, block: Dict) -> None:
         """Mirror a completed job's manifest ``conformance`` block into

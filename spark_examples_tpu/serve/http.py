@@ -11,6 +11,8 @@ JSON protocol (``serve/protocol.py``) onto :class:`PcaService`
 - ``POST /v1/jobs/<id>/cancel``— cancel a queued job (409 once running)
 - ``GET  /metrics``            — Prometheus text export of the service
   registry (``obs/metrics.py``)
+- ``GET  /v1/fleet/stats``     — per-class latency quantiles + the fleet
+  calibration fold (``serve/daemon.py:fleet_stats``)
 - ``GET  /healthz``            — mesh/queue liveness JSON
 
 ``serve_main`` is the ``python -m spark_examples_tpu serve`` entry
@@ -136,6 +138,9 @@ class ServeHandler(BaseHTTPRequestHandler):
                 service.metrics_text(),
                 "text/plain; version=0.0.4; charset=utf-8",
             )
+            return
+        if self.path == "/v1/fleet/stats":
+            self._send_json(200, service.fleet_stats())
             return
         if self.path.startswith("/v1/jobs/"):
             job_id = self.path[len("/v1/jobs/"):]
@@ -403,6 +408,15 @@ def serve_main(argv: Optional[Sequence[str]] = None) -> int:
         ),
     )
     parser.add_argument(
+        "--no-deadline-feasibility",
+        action="store_true",
+        help=(
+            "Queue jobs whose deadline_seconds is below the calibrated "
+            "cost estimate instead of rejecting them 413 "
+            "deadline-infeasible at admission."
+        ),
+    )
+    parser.add_argument(
         "--no-persistent-cache",
         action="store_true",
         help=(
@@ -491,6 +505,7 @@ def serve_main(argv: Optional[Sequence[str]] = None) -> int:
         lease_seconds=ns.lease_seconds,
         lease_grace_seconds=ns.lease_grace_seconds,
         steal_interval_seconds=ns.steal_interval_seconds,
+        deadline_feasibility=not ns.no_deadline_feasibility,
         # The CLI daemon always guards its run dir: a second daemon on
         # the same --run-dir without --replica-id exits 2 below instead
         # of silently corrupting the shared journal.

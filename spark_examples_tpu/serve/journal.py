@@ -112,6 +112,14 @@ class PendingJob:
     #: job stays one span tree across replica steals; ``None`` on
     #: journals written before tracing existed).
     trace_id: Optional[str] = None
+    #: Admission-time cost prediction
+    #: (``obs/costmodel.py:CostPrediction.to_dict``) — rides the
+    #: ``accepted`` record like the trace id, so a stolen or replayed
+    #: job keeps the prediction its original admission computed (the
+    #: calibration pair must compare against THAT estimate, not a
+    #: re-prediction under the adopter's warm state). ``None`` on
+    #: journals written before the cost observatory existed.
+    cost: Optional[Dict] = None
 
 
 class JobJournal:
@@ -184,14 +192,16 @@ class JobJournal:
         submitted_unix: float,
         deadline_unix: Optional[float],
         trace_id: Optional[str] = None,
+        cost: Optional[Dict] = None,
     ) -> None:
         # The replica stamp lets the steal scan attribute a job that was
         # accepted but never leased (its owner died in the one-record
         # window between this append and the lease claim) to a dead peer
         # via the heartbeat file instead of leaving it orphaned. The
-        # trace id rides the same record so a stolen job keeps ONE span
-        # tree across replica lives (compaction rewrites accepted records
-        # verbatim, so it survives every rewrite for free).
+        # trace id and cost prediction ride the same record so a stolen
+        # job keeps ONE span tree and ONE admission estimate across
+        # replica lives (compaction rewrites accepted records verbatim,
+        # so both survive every rewrite for free).
         record = {
             "event": "accepted",
             "id": job_id,
@@ -202,6 +212,8 @@ class JobJournal:
         }
         if trace_id is not None:
             record["trace"] = trace_id
+        if cost is not None:
+            record["cost"] = dict(cost)
         self._append(self._stamped(record, None))
 
     def began(self, job_id: str, epoch: Optional[int] = None) -> None:
@@ -328,6 +340,7 @@ def replay_journal(path: str) -> Tuple[List[PendingJob], int]:
             ):
                 continue
             trace = record.get("trace")
+            cost = record.get("cost")
             pending[job_id] = PendingJob(
                 job_id=job_id,
                 request_doc=request,
@@ -340,6 +353,7 @@ def replay_journal(path: str) -> Tuple[List[PendingJob], int]:
                 ),
                 accepted_record=record,
                 trace_id=trace if isinstance(trace, str) else None,
+                cost=cost if isinstance(cost, dict) else None,
             )
         elif event == "began":
             began.add(job_id)

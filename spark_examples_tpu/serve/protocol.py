@@ -224,14 +224,17 @@ def job_doc(
     slice_name: Optional[str] = None,
     batch_size: Optional[int] = None,
     trace: Optional[str] = None,
+    cost: Optional[Mapping] = None,
 ) -> Dict:
     """The job envelope (submit response and ``GET /v1/jobs/<id>``).
     ``slice``/``batch_size`` are execution attribution (which executor
     slice ran the job, how many jobs rode its dispatch group);
     ``trace`` echoes the job's distributed-tracing id (the client-sent
     ``X-Trace-Id`` when one rode the submit, a server-minted id
-    otherwise) — additive response fields; request-side strictness is
-    unchanged."""
+    otherwise); ``cost`` is the admission-time cost prediction
+    (``obs/costmodel.py:CostPrediction.to_dict``, with measured fields
+    merged once the job completes) — additive response fields;
+    request-side strictness is unchanged."""
     return {
         "protocol": protocol_block(),
         "job": {
@@ -254,6 +257,7 @@ def job_doc(
             ),
             "slice": slice_name,
             "batch_size": batch_size,
+            "cost": dict(cost) if cost is not None else None,
         },
     }
 

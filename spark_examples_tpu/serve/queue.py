@@ -130,6 +130,21 @@ class Job:
     #: accepted record, stamped on every flight-recorder event — one job
     #: is one span tree across restarts and replica steals.
     trace_id: Optional[str] = None
+    #: Admission-time cost prediction
+    #: (``obs/costmodel.py:CostPrediction``, opaque here — this module
+    #: must stay obs-free): stamped at submit, journaled with the
+    #: accepted record, compared against the measured wall clock at the
+    #: terminal (the calibration ledger's input pair).
+    cost_prediction: Optional[object] = None
+    #: When a worker dequeued the job (the queue-wait measurement's end;
+    #: ``submitted_unix`` is its start). Distinct from ``started_unix``
+    #: so batched jobs that ride a group but execute back-to-back keep
+    #: an honest wait-vs-run split.
+    dequeued_unix: Optional[float] = None
+    #: Measured queue wait (``dequeued_unix - submitted_unix``), stamped
+    #: by the worker so the terminal envelope and the calibration ledger
+    #: read one number instead of re-deriving it.
+    queue_wait_seconds: Optional[float] = None
 
 
 def classify_conf(conf, small_site_limit: int = SMALL_JOB_MAX_SITES) -> str:
@@ -185,6 +200,16 @@ class BoundedJobQueue:
         self._small: Deque[Job] = deque()
         self._large: Deque[Job] = deque()
         self._closed = False
+        # Expired-deadline sweep sink (set by the owning daemon): a
+        # queued job whose deadline already passed is dead weight — it
+        # will fail at dequeue without touching the devices, but until
+        # popped it OCCUPIES class capacity, so a full queue of expired
+        # jobs 429s live traffic. ``put`` sweeps them out first and
+        # hands them to this sink OUTSIDE the queue lock (the sink takes
+        # the daemon's table lock; the queue lock stays a leaf). No sink
+        # = no sweep: without an owner to settle them, removing queued
+        # jobs here would strand them in "queued" forever.
+        self._expired_sink = None
 
     # ------------------------------------------------------------ admission
 
@@ -196,23 +221,66 @@ class BoundedJobQueue:
         once — journal replay and a crashed worker's un-run dispatch-group
         tail: their 202 was acknowledged, so capacity (which bounds NEW
         admissions) must not drop them; the transient overshoot is bounded
-        by the previous incarnation's capacity + one dispatch group."""
-        with self._nonempty:
-            if self._closed:
-                raise QueueClosed("service is draining; no new jobs")
-            lane, capacity = (
-                (self._small, self.small_capacity)
-                if job.job_class == SMALL_CLASS
-                else (self._large, self.large_capacity)
-            )
-            if enforce_capacity and len(lane) >= capacity:
-                raise QueueFull(job.job_class, capacity)
-            lane.append(job)
-            # notify_all, not notify: per-slice workers wait for DIFFERENT
-            # classes on this one condition, and waking only one could
-            # wake a worker whose classes stay empty while the right one
-            # sleeps.
-            self._nonempty.notify_all()
+        by the previous incarnation's capacity + one dispatch group.
+
+        Before the capacity check, queued jobs whose deadline has already
+        expired are swept out (they would fail at dequeue anyway, but
+        until popped they occupy capacity — a full queue of expired jobs
+        must not 429 live traffic) and handed to the daemon's expired
+        sink AFTER the lock is released."""
+        swept: List[Job] = []
+        try:
+            with self._nonempty:
+                if self._closed:
+                    raise QueueClosed("service is draining; no new jobs")
+                swept = self._sweep_expired_locked(time.time())
+                lane, capacity = (
+                    (self._small, self.small_capacity)
+                    if job.job_class == SMALL_CLASS
+                    else (self._large, self.large_capacity)
+                )
+                if enforce_capacity and len(lane) >= capacity:
+                    raise QueueFull(job.job_class, capacity)
+                lane.append(job)
+                # notify_all, not notify: per-slice workers wait for
+                # DIFFERENT classes on this one condition, and waking only
+                # one could wake a worker whose classes stay empty while
+                # the right one sleeps.
+                self._nonempty.notify_all()
+        finally:
+            # Outside the queue lock (leaf-lock discipline) and on BOTH
+            # exits: a put that still 429s must not re-strand the expired
+            # jobs it already removed from the lanes.
+            sink = self._expired_sink
+            if sink is not None:
+                for expired in swept:
+                    sink(expired)
+
+    def set_expired_sink(self, sink) -> None:
+        """Install the owning daemon's expired-deadline settler (called
+        with each swept :class:`Job`, outside the queue lock)."""
+        with self._lock:
+            self._expired_sink = sink
+
+    def _sweep_expired_locked(self, now: float) -> List[Job]:
+        """Remove every queued job whose deadline already passed (both
+        lanes — capacity relief for the class being admitted, honest
+        accounting for the other). Caller holds the queue lock and owns
+        delivering the swept jobs to the sink after releasing it."""
+        if self._expired_sink is None:
+            return []
+        swept: List[Job] = []
+        for lane in (self._small, self._large):
+            expired = [
+                queued
+                for queued in lane
+                if queued.deadline_unix is not None
+                and now >= queued.deadline_unix
+            ]
+            for queued in expired:
+                lane.remove(queued)
+                swept.append(queued)
+        return swept
 
     def inject_reclaimed(self, job: Job) -> None:
         """Admit a RECLAIMED job: one replayed from the journal by a

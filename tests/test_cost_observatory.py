@@ -1,0 +1,592 @@
+"""Fleet cost observatory (obs/costmodel.py, obs/calibration.py,
+obs/report.py + the serve wiring): histogram quantile estimation edge
+cases, the queued-deadline capacity-leak regression, the cost model's
+floor/round-trip contract, calibration ledger fold/merge/crash
+semantics, the heartbeat cost segment, manifest ``cost``-block
+validation, journal round-trip of the prediction through compaction,
+the deadline-infeasibility 413 (and its opt-out), fleet stats, and the
+post-mortem ``obs report`` CLI verb."""
+
+import json
+import math
+import os
+import time
+
+import pytest
+
+from spark_examples_tpu.obs.calibration import (
+    CalibrationFold,
+    CalibrationLedger,
+    MIN_CALIBRATION_SAMPLES,
+    _Reservoir,
+    calibration_path,
+    fold_calibration,
+)
+from spark_examples_tpu.obs.costmodel import (
+    COLD_COMPILE_SECONDS,
+    DISPATCH_OVERHEAD_SECONDS,
+    MIN_PREDICTED_SECONDS,
+    CostPrediction,
+    estimate_seconds,
+)
+from spark_examples_tpu.obs.heartbeat import Heartbeat
+from spark_examples_tpu.obs.metrics import (
+    COST_CALIBRATION_SAMPLES,
+    COST_MEASURED_MEAN_SECONDS,
+    COST_PREDICTED_MEAN_SECONDS,
+    WIDE_SECONDS_BUCKETS,
+    MetricsRegistry,
+    histogram_quantile,
+)
+from spark_examples_tpu.serve.executor import ExecutionOutcome
+from spark_examples_tpu.serve.protocol import parse_request, request_doc
+from spark_examples_tpu.serve.queue import (
+    LARGE_CLASS,
+    SMALL_CLASS,
+    BoundedJobQueue,
+    Job,
+    QueueFull,
+)
+
+TINY_FLAGS = ["--num-samples", "8", "--references", "1:0:50000"]
+
+
+# ---------------------------------------------------- histogram_quantile
+
+
+def _snapshot(values, buckets=(0.1, 1.0, 10.0)):
+    """Build a cumulative-bucket snapshot the way Histogram.snapshot()
+    does, from raw observations."""
+    counts = {}
+    for bound in buckets:
+        counts[repr(float(bound))] = sum(1 for v in values if v <= bound)
+    counts["+Inf"] = len(values)
+    return {
+        "buckets": counts,
+        "sum": float(sum(values)),
+        "count": len(values),
+    }
+
+
+def test_histogram_quantile_empty_is_none():
+    assert histogram_quantile(_snapshot([]), 0.5) is None
+    assert histogram_quantile({"buckets": {}, "sum": 0.0, "count": 0}, 0.5) \
+        is None
+
+
+def test_histogram_quantile_q0_and_q1_edges():
+    snap = _snapshot([0.5, 0.7, 5.0])
+    # q=0: the lower edge of the first populated bucket (0.1, 1.0].
+    assert histogram_quantile(snap, 0.0) == pytest.approx(0.1)
+    # q=1: the upper bound of the highest populated bucket.
+    assert histogram_quantile(snap, 1.0) == pytest.approx(10.0)
+    # Out-of-range q clamps, never raises.
+    assert histogram_quantile(snap, -3.0) == pytest.approx(0.1)
+    assert histogram_quantile(snap, 7.0) == pytest.approx(10.0)
+
+
+def test_histogram_quantile_inf_mass_clamps_to_top_finite_bound():
+    # All mass past every finite bound: the estimate is the top finite
+    # bound (the honest "at least this much"), never inf/NaN.
+    snap = _snapshot([50.0, 99.0], buckets=(0.1, 1.0, 10.0))
+    for q in (0.5, 0.99, 1.0):
+        estimate = histogram_quantile(snap, q)
+        assert estimate == pytest.approx(10.0), q
+        assert math.isfinite(estimate)
+
+
+def test_histogram_quantile_interpolates_within_bucket():
+    # 4 observations all inside (1.0, 10.0]: the median interpolates
+    # linearly within that bucket, strictly between its edges.
+    snap = _snapshot([2.0, 3.0, 4.0, 5.0])
+    p50 = histogram_quantile(snap, 0.5)
+    assert 1.0 < p50 < 10.0
+    # Rank 2 of 4 in a bucket spanning [1, 10]: 1 + (2/4)*9 = 5.5.
+    assert p50 == pytest.approx(5.5)
+
+
+def test_wide_seconds_buckets_reach_hours():
+    assert WIDE_SECONDS_BUCKETS == tuple(sorted(WIDE_SECONDS_BUCKETS))
+    assert WIDE_SECONDS_BUCKETS[0] <= 0.01  # sub-dispatch-overhead floor
+    assert WIDE_SECONDS_BUCKETS[-1] >= 3600.0  # whole-genome large jobs
+
+
+# ------------------------------------------- queued-deadline capacity leak
+
+
+def _queued_job(job_id, job_class=SMALL_CLASS, deadline_unix=None):
+    return Job(
+        id=job_id,
+        request=parse_request(request_doc(TINY_FLAGS)),
+        conf=None,
+        job_class=job_class,
+        submitted_unix=time.time(),
+        deadline_unix=deadline_unix,
+    )
+
+
+def test_full_queue_of_expired_jobs_admits_new_job():
+    """The capacity-leak regression: expired queued jobs must free their
+    capacity at the next admission instead of 429ing live traffic."""
+    q = BoundedJobQueue(small_capacity=2, large_capacity=1)
+    settled = []
+    q.set_expired_sink(settled.append)
+    soon = time.time() + 0.05
+    q.put(_queued_job("S1", deadline_unix=soon))
+    q.put(_queued_job("S2", deadline_unix=soon))
+    with pytest.raises(QueueFull):
+        q.put(_queued_job("S3"))  # full, nothing expired yet
+    time.sleep(0.08)
+    q.put(_queued_job("S4"))  # sweeps S1+S2, admits without QueueFull
+    assert [j.id for j in settled] == ["S1", "S2"]
+    assert q.depth() == {SMALL_CLASS: 1, LARGE_CLASS: 0}
+    assert q.pop(timeout=1).id == "S4"
+
+
+def test_expired_sweep_delivers_even_when_put_still_raises():
+    """Cross-lane sweep: a small-lane 429 must not re-strand the expired
+    LARGE job the same put already removed."""
+    q = BoundedJobQueue(small_capacity=1, large_capacity=1)
+    settled = []
+    q.set_expired_sink(settled.append)
+    q.put(_queued_job("S-live"))
+    q.put(_queued_job("L-exp", LARGE_CLASS, deadline_unix=time.time() + 0.05))
+    time.sleep(0.08)
+    with pytest.raises(QueueFull):
+        q.put(_queued_job("S-new"))  # small lane still full of live work
+    assert [j.id for j in settled] == ["L-exp"]
+    assert q.depth() == {SMALL_CLASS: 1, LARGE_CLASS: 0}
+
+
+def test_no_sink_means_no_sweep():
+    """Without an owner to settle them, expired jobs must NOT be removed
+    (they would be stranded in 'queued' forever)."""
+    q = BoundedJobQueue(small_capacity=1, large_capacity=1)
+    q.put(_queued_job("S1", deadline_unix=time.time() - 1))
+    with pytest.raises(QueueFull):
+        q.put(_queued_job("S2"))
+    assert q.pop(timeout=1).id == "S1"
+
+
+# -------------------------------------------------------------- cost model
+
+
+def test_estimate_floor_overhead_and_cold_penalty():
+    warm = estimate_seconds(
+        sites=1_000_000, host_peak_bytes=None, sched_seconds=None, cold=False
+    )
+    cold = estimate_seconds(
+        sites=1_000_000, host_peak_bytes=None, sched_seconds=None, cold=True
+    )
+    assert warm["predicted_seconds"] == pytest.approx(
+        DISPATCH_OVERHEAD_SECONDS + warm["compute_seconds"]
+    )
+    assert cold["predicted_seconds"] - warm["predicted_seconds"] == (
+        pytest.approx(COLD_COMPILE_SECONDS)
+    )
+    # No facts at all: still strictly positive (the 413 determinism
+    # floor), never zero.
+    empty = estimate_seconds(
+        sites=None, host_peak_bytes=None, sched_seconds=None, cold=False
+    )
+    assert empty["predicted_seconds"] == pytest.approx(MIN_PREDICTED_SECONDS)
+    # The link term dominates when the schedule simulator's critical
+    # path is longer than the compute term (they overlap, not add).
+    linked = estimate_seconds(
+        sites=10, host_peak_bytes=None, sched_seconds=9.0, cold=False
+    )
+    assert linked["predicted_seconds"] == pytest.approx(
+        DISPATCH_OVERHEAD_SECONDS + 9.0
+    )
+
+
+def test_cost_prediction_round_trip_and_junk():
+    pred = CostPrediction(
+        predicted_seconds=1.5,
+        kind="pca",
+        fingerprint="abc123",
+        compile="warm",
+        compute_seconds=0.2,
+        sites=501,
+        host_peak_bytes=1 << 30,
+    )
+    back = CostPrediction.from_dict(json.loads(json.dumps(pred.to_dict())))
+    assert back == pred
+    assert CostPrediction.from_dict({}) is None
+    assert CostPrediction.from_dict({"predicted_seconds": "junk"}) is None
+    assert CostPrediction.from_dict({"predicted_seconds": float("nan")}) \
+        is None
+    assert CostPrediction.from_dict({"predicted_seconds": -1.0}) is None
+
+
+def test_best_estimate_prefers_calibrated():
+    pred = CostPrediction(predicted_seconds=2.0)
+    assert pred.best_estimate_seconds == 2.0
+    pred.calibrated_seconds = 6.0
+    assert pred.best_estimate_seconds == 6.0
+
+
+def test_predict_job_cost_from_conf():
+    """The shared estimator (check/plan.py): device-free, reuses the
+    plan validator's geometry, positive, fingerprinted."""
+    from spark_examples_tpu.check.plan import predict_job_cost
+    from spark_examples_tpu.config import PcaConf
+
+    conf = PcaConf.parse(TINY_FLAGS)
+    pred = predict_job_cost(conf)
+    assert pred.predicted_seconds >= MIN_PREDICTED_SECONDS
+    assert pred.sites and pred.sites > 0
+    assert pred.fingerprint
+    assert pred.compile in ("warm", "cold")
+    assert CostPrediction.from_dict(pred.to_dict()) == pred
+
+
+# ------------------------------------------------------ calibration ledger
+
+
+def _row(fingerprint="fp1", predicted=2.0, measured=1.0, **extra):
+    doc = {
+        "fingerprint": fingerprint,
+        "kind": "pca",
+        "job_class": "small",
+        "predicted_seconds": predicted,
+        "measured_seconds": measured,
+        "queue_wait_seconds": 0.1,
+        "compile": "warm",
+    }
+    doc.update(extra)
+    return doc
+
+
+def test_fold_learns_per_geometry_ratio_and_calibrates():
+    fold = CalibrationFold()
+    for _ in range(max(2, MIN_CALIBRATION_SAMPLES)):
+        assert fold.add(_row("fp1", predicted=2.0, measured=1.0))
+        assert fold.add(_row("fp2", predicted=1.0, measured=3.0))
+    assert fold.ratio_for("fp1") == pytest.approx(0.5)
+    assert fold.ratio_for("fp2") == pytest.approx(3.0)
+    # Unknown geometry: the overall fleet ratio, not None.
+    assert fold.ratio_for("fp-never-seen") == pytest.approx(
+        fold.overall.ratio
+    )
+    pred = CostPrediction(predicted_seconds=4.0, fingerprint="fp1")
+    fold.calibrated_estimate(pred)
+    assert pred.calibrated_seconds == pytest.approx(2.0)
+    assert pred.calibration_ratio == pytest.approx(0.5)
+    assert pred.calibration_samples >= MIN_CALIBRATION_SAMPLES
+    assert pred.best_estimate_seconds == pytest.approx(2.0)
+
+
+def test_fold_skips_junk_and_failed_rows():
+    fold = CalibrationFold()
+    assert not fold.add("not a dict")
+    assert not fold.add({"predicted_seconds": 1.0})  # no measured
+    assert not fold.add(_row(predicted=float("nan")))
+    assert not fold.add(_row(predicted=-1.0))
+    # A failed row (stolen job the survivor fenced off) exists for the
+    # post-mortem report, never for the ratio fold.
+    assert not fold.add(_row(status="failed"))
+    assert fold.overall.n == 0
+    assert fold.add(_row())
+    assert fold.overall.n == 1
+
+
+def test_ledger_crash_durability_torn_tail_and_merge(tmp_path):
+    run_dir = str(tmp_path)
+    a = CalibrationLedger(run_dir)
+    b = CalibrationLedger(run_dir)  # a peer replica, same shared file
+    a.record(
+        fingerprint="fp1", kind="pca", job_class="small",
+        predicted_seconds=2.0, measured_seconds=1.0,
+        queue_wait_seconds=0.1, compile="warm", job_id="job-a-1",
+    )
+    b.record(
+        fingerprint="fp1", kind="pca", job_class="small",
+        predicted_seconds=2.0, measured_seconds=1.0,
+        queue_wait_seconds=None, compile="cold", job_id="job-b-1",
+        status="failed",
+    )
+    # a's in-process fold has not seen b's append; refresh merges it —
+    # but the failed row stays out of the ratio fold by contract.
+    assert a.fold.overall.n == 1
+    assert a.refresh().overall.n == 1
+    # Simulate the kill -9 torn tail: a half-written trailing line.
+    with open(calibration_path(run_dir), "a", encoding="utf-8") as f:
+        f.write('{"fingerprint": "fp1", "predicted_sec')
+    fold = fold_calibration(calibration_path(run_dir))
+    assert fold.overall.n == 1
+    assert fold.overall.ratio == pytest.approx(0.5)
+    # The raw file still holds both rows (the report reads them all).
+    rows = [
+        json.loads(line)
+        for line in open(calibration_path(run_dir), encoding="utf-8")
+        if line.strip().endswith("}")
+    ]
+    assert {r["id"] for r in rows} == {"job-a-1", "job-b-1"}
+    failed = next(r for r in rows if r["id"] == "job-b-1")
+    assert failed["status"] == "failed"
+    assert "queue_wait_seconds" not in failed  # None omits the key
+    a.close()
+    b.close()
+    a.record(  # record() reopens after close — telemetry never dies
+        fingerprint="fp1", kind="pca", job_class="small",
+        predicted_seconds=2.0, measured_seconds=1.0,
+        queue_wait_seconds=0.0, compile="warm",
+    )
+    a.close()
+
+
+def test_reservoir_is_deterministic_and_bounded():
+    r1 = _Reservoir(capacity=8)
+    r2 = _Reservoir(capacity=8)
+    for i in range(1000):
+        r1.add(float(i))
+        r2.add(float(i))
+    assert r1.samples == r2.samples  # no randomness, ever
+    assert len(r1.samples) <= 8
+    assert r1.stride > 1  # it actually thinned
+    assert r1.quantile(0.0) == min(r1.samples)
+    assert r1.quantile(1.0) == max(r1.samples)
+    assert _Reservoir().quantile(0.5) is None
+
+
+# ------------------------------------------------------ heartbeat segment
+
+
+def test_heartbeat_cost_segment():
+    reg = MetricsRegistry()
+    reg.gauge(COST_PREDICTED_MEAN_SECONDS).set(3.2)
+    reg.gauge(COST_MEASURED_MEAN_SECONDS).set(2.9)
+    reg.gauge(COST_CALIBRATION_SAMPLES).set(17)
+    hb = Heartbeat(10.0, reg, emit=lambda line: None)
+    assert "cost pred 3.2s / meas 2.9s (ratio 0.91, n=17)" in hb.line()
+
+
+def test_heartbeat_cost_segment_silent_without_samples():
+    reg = MetricsRegistry()
+    reg.gauge(COST_PREDICTED_MEAN_SECONDS).set(3.2)
+    reg.gauge(COST_MEASURED_MEAN_SECONDS).set(2.9)
+    reg.gauge(COST_CALIBRATION_SAMPLES).set(0)
+    hb = Heartbeat(10.0, reg, emit=lambda line: None)
+    assert "cost pred" not in hb.line()
+    # And a registry without the gauges at all stays silent too.
+    assert "cost pred" not in Heartbeat(
+        10.0, MetricsRegistry(), emit=lambda line: None
+    ).line()
+
+
+# --------------------------------------------------- manifest cost block
+
+
+def _valid_cost_block():
+    return {
+        "predicted_seconds": 1.5,
+        "measured_seconds": 1.2,
+        "queue_wait_seconds": 0.01,
+        "compile": "warm",
+        "fingerprint": "abc",  # extras are allowed (additive envelope)
+    }
+
+
+def test_manifest_cost_block_valid_and_absent():
+    from spark_examples_tpu.obs.manifest import (
+        build_manifest,
+        validate_manifest,
+    )
+
+    assert validate_manifest(build_manifest()) == []  # absent = fine (v2)
+    doc = build_manifest(cost=_valid_cost_block())
+    assert validate_manifest(doc) == []
+    assert doc["cost"]["compile"] == "warm"
+
+
+@pytest.mark.parametrize(
+    "tamper",
+    [
+        lambda c: c.update(predicted_seconds=-1.0),
+        lambda c: c.update(measured_seconds=float("nan")),
+        lambda c: c.update(queue_wait_seconds=True),
+        lambda c: c.update(queue_wait_seconds="0.1"),
+        lambda c: c.pop("measured_seconds"),
+        lambda c: c.update(compile="lukewarm"),
+    ],
+)
+def test_manifest_cost_block_tampering_rejected(tamper):
+    from spark_examples_tpu.obs.manifest import (
+        build_manifest,
+        validate_manifest,
+    )
+
+    cost = _valid_cost_block()
+    tamper(cost)
+    errors = validate_manifest(build_manifest(cost=cost))
+    assert errors, cost
+    assert any("cost" in e for e in errors), errors
+
+
+# ----------------------------------------- journal round-trip + compaction
+
+
+def test_journal_cost_survives_replay_and_compaction(tmp_path):
+    from spark_examples_tpu.serve.journal import (
+        JobJournal,
+        compact_journal,
+        journal_path,
+        replay_journal,
+    )
+
+    path = journal_path(str(tmp_path))
+    journal = JobJournal(path)
+    cost = CostPrediction(
+        predicted_seconds=2.5, fingerprint="fp9", compile="cold"
+    ).to_dict()
+    journal.accepted(
+        "job-000001", request_doc(TINY_FLAGS), "small",
+        submitted_unix=123.0, deadline_unix=None,
+        trace_id="a" * 32, cost=cost,
+    )
+    journal.accepted(  # a pre-observatory record: no cost key at all
+        "job-000002", request_doc(TINY_FLAGS), "small",
+        submitted_unix=124.0, deadline_unix=None,
+    )
+    pending, _ = replay_journal(path)
+    assert [p.job_id for p in pending] == ["job-000001", "job-000002"]
+    assert pending[0].cost == cost
+    assert pending[1].cost is None
+    # Compaction rewrites accepted records verbatim: the prediction (and
+    # trace id) survive the rewrite, exactly like before it.
+    compact_journal(path, pending)
+    pending2, _ = replay_journal(path)
+    assert pending2[0].cost == cost
+    assert pending2[0].trace_id == "a" * 32
+    assert pending2[1].cost is None
+    assert CostPrediction.from_dict(pending2[0].cost).predicted_seconds \
+        == 2.5
+
+
+# ------------------------------------- daemon: 413, fleet stats, report
+
+
+class InstantExecutor:
+    def __call__(self, job, run_dir):
+        return ExecutionOutcome(
+            result={"ok": True}, manifest_path=None, compile_cache="cold"
+        )
+
+
+def _wait_done(service, job_id, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        status, doc = service.job_status(job_id)
+        assert status == 200, doc
+        if doc["job"]["status"] in ("done", "failed", "cancelled"):
+            return doc["job"]
+        time.sleep(0.01)
+    raise AssertionError(f"{job_id} never settled")
+
+
+def test_deadline_infeasible_413_and_opt_out(tmp_path):
+    from spark_examples_tpu.serve.daemon import PcaService
+
+    service = PcaService(
+        run_dir=str(tmp_path / "a"), executor=InstantExecutor()
+    ).start()
+    try:
+        # Below MIN_PREDICTED_SECONDS: infeasible for ANY job, so the
+        # 413 is deterministic with an empty calibration ledger.
+        status, body = service.submit(
+            request_doc(TINY_FLAGS, deadline_seconds=0.001)
+        )
+        assert status == 413
+        assert body["error"]["code"] == "deadline-infeasible"
+        assert body["cost"]["requested_deadline_seconds"] == 0.001
+        assert body["cost"]["predicted_seconds"] >= MIN_PREDICTED_SECONDS
+        message = body["error"]["message"]
+        assert "0.001" in message and "--no-deadline-feasibility" in message
+        # A feasible deadline on the same geometry is admitted.
+        status, doc = service.submit(
+            request_doc(TINY_FLAGS, deadline_seconds=3600.0)
+        )
+        assert status == 202, doc
+        assert doc["job"]["cost"]["predicted_seconds"] > 0
+    finally:
+        service.stop(timeout=30)
+    opt_out = PcaService(
+        run_dir=str(tmp_path / "b"),
+        executor=InstantExecutor(),
+        deadline_feasibility=False,
+    ).start()
+    try:
+        status, doc = opt_out.submit(
+            request_doc(TINY_FLAGS, deadline_seconds=0.001)
+        )
+        assert status == 202  # the pre-observatory accept-then-expire
+    finally:
+        opt_out.stop(timeout=30)
+
+
+def test_fleet_stats_metrics_and_postmortem_report(tmp_path, capsys):
+    from spark_examples_tpu.obs.report import report_main
+    from spark_examples_tpu.serve.daemon import PcaService
+
+    run_dir = str(tmp_path / "serve")
+    service = PcaService(run_dir=run_dir, executor=InstantExecutor()).start()
+    try:
+        status, doc = service.submit(request_doc(TINY_FLAGS))
+        assert status == 202
+        job = _wait_done(service, doc["job"]["id"])
+        assert job["status"] == "done"
+        # The terminal envelope carries the measured half of the pair.
+        assert job["cost"]["measured_seconds"] is not None
+        assert job["cost"]["queue_wait_seconds"] is not None
+        stats = service.fleet_stats()
+        wall = stats["classes"]["small"]["wall_seconds"]
+        assert wall["count"] == 1 and wall["p50"] > 0
+        assert stats["classes"]["small"]["queue_wait_seconds"]["count"] == 1
+        assert stats["calibration"]["samples"] == 1
+        assert stats["calibration"]["ratio"] > 0
+        assert set(stats["counters"]) >= {
+            "jobs_stolen", "worker_restarts", "journal_replayed",
+        }
+        text = service.metrics_text()
+        for name in (
+            "serve_queue_wait_seconds", "serve_job_wall_seconds",
+            "cost_prediction_ratio", "cost_calibration_samples",
+            "cost_predicted_mean_seconds", "cost_measured_mean_seconds",
+        ):
+            assert name in text, name
+    finally:
+        service.begin_drain()
+        service.wait_drained(timeout=30)
+        service.stop(timeout=30)
+    # The fleet is dead; the report folds what it left on disk.
+    assert report_main(["report", "--run-dir", run_dir, "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    (job_id,) = report["jobs"].keys()
+    facts = report["jobs"][job_id]
+    assert facts["status"] == "done"
+    assert facts["trace"]
+    assert facts["predicted_seconds"] > 0
+    assert facts["measured_seconds"] is not None
+    assert facts["queue_wait_seconds"] is not None
+    assert report["calibration"]["samples"] == 1
+    assert report["classes"]["small"]["wall_seconds"]["count"] == 1
+    assert report["totals"]["journaled"] == 1
+    # Text mode renders the same facts.
+    assert report_main(["report", "--run-dir", run_dir]) == 0
+    text = capsys.readouterr().out
+    assert "fleet report:" in text and job_id in text
+    assert "predicted" in text and "queue wait" in text
+
+
+def test_report_cli_exit_codes(tmp_path, capsys):
+    from spark_examples_tpu.obs.report import report_main
+
+    assert report_main([]) == 2  # usage
+    assert report_main(["export"]) == 2  # wrong verb
+    missing = str(tmp_path / "nope")
+    assert report_main(["report", "--run-dir", missing]) == 2
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    assert report_main(["report", "--run-dir", empty]) == 1  # nothing
+    capsys.readouterr()

@@ -345,6 +345,11 @@ def test_cancellation_matrix(gated_service):
 
 def test_deadline_exceeded_fails_without_running(gated_service):
     service, gate = gated_service
+    # This test targets the DEQUEUE-time expiry path; a 0.2 s deadline
+    # is below the cold-compile cost estimate, so the admission-time
+    # feasibility gate (tested in test_cost_observatory.py) must be off
+    # for the job to reach the queue at all.
+    service.deadline_feasibility = False
     _, blocker = service.submit(request_doc(TINY_FLAGS))
     assert gate.started.wait(timeout=10)
     _, doomed = service.submit(
