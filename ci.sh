@@ -994,11 +994,37 @@ if not wall.get("count") or not wall.get("p99") or wall["p99"] <= 0:
     sys.exit(1)
 if not (fs.get("calibration") or {}).get("samples"):
     print(f"/v1/fleet/stats calibration fold empty: {fs}"); sys.exit(1)
+# Fused-batch phase: the one-program group must be byte-identical to
+# the same jobs back to back and at least 2x their group throughput
+# (the acceptance bound; BENCH_r07 records ~5.8x on this host).
+fb = d["fused_batch"]
+if not fb["byte_identical"]:
+    print("fused-batch phase lost byte parity"); sys.exit(1)
+if fb["fused"]["dispatch"]["fused_groups"] < 1 \
+        or fb["serial"]["dispatch"]["fused_groups"] != 0:
+    print(f"fused-batch dispatch counters wrong: fused ran "
+          f"{fb['fused']['dispatch']}, serial ran {fb['serial']['dispatch']}")
+    sys.exit(1)
+ratio = fb["group_throughput_ratio"]
+if not ratio or ratio < 2.0:
+    print(f"fused group throughput below the 2x bound: {ratio}")
+    sys.exit(1)
+# Cost-ordered scheduling: cheap jobs queued behind an expensive one
+# must finish ahead of it (SJF within the class lane) and cut the
+# cheap-job P99 relative to FIFO on the identical load.
+co = d["cost_ordering"]
+if co["cost"]["cheap_p99_seconds"] >= co["cost"]["expensive_latency_seconds"]:
+    print(f"cost ordering left cheap jobs behind the expensive one: "
+          f"{co['cost']}"); sys.exit(1)
+if not co["fifo_over_cost_p99"] or co["fifo_over_cost_p99"] <= 1.0:
+    print(f"cost ordering did not beat FIFO: {co}"); sys.exit(1)
 print(f"serve-load OK: small P99 {unloaded:.3f}s unloaded -> "
       f"{loaded:.3f}s beside a {large:.2f}s large job "
       f"({doc['value']}x, bound 2x); fleet stats: small wall p99 "
       f"{wall['p99']:.3f}s over {wall['count']} jobs, calibration "
-      f"n={fs['calibration']['samples']}")
+      f"n={fs['calibration']['samples']}; fused group {ratio:.1f}x "
+      f"serial (byte-identical), cost ordering cut cheap P99 "
+      f"{co['fifo_over_cost_p99']:.2f}x vs FIFO")
 PYEOF
   else
     echo "serve-load bench failed:"; tail -10 "$SC_TMP/load.err"
@@ -1009,6 +1035,118 @@ if [ "$sc_rc" -ne 0 ]; then
   tail -20 "$SC_TMP/daemon.err" 2>/dev/null
 fi
 rm -rf "$SC_TMP"
+
+echo "== fused batch + cost-ordering smoke (one device program per group) =="
+fb_rc=0
+FB_TMP=$(mktemp -d)
+env JAX_PLATFORMS=cpu SPARK_EXAMPLES_TPU_NO_CACHE=1 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+  python -m spark_examples_tpu serve --port 0 \
+    --run-dir "$FB_TMP/run" --endpoint-file "$FB_TMP/endpoint" \
+    --executor-slices 0 --batch-max-jobs 3 --batch-linger-seconds 2.0 \
+    --serve-small-site-limit 500000 \
+    > "$FB_TMP/daemon.out" 2> "$FB_TMP/daemon.err" &
+FB_PID=$!
+for _ in $(seq 1 150); do [ -f "$FB_TMP/endpoint" ] && break; sleep 0.2; done
+if [ ! -f "$FB_TMP/endpoint" ]; then
+  echo "fused smoke: daemon never published its endpoint"; fb_rc=1
+  kill "$FB_PID" 2>/dev/null; wait "$FB_PID" 2>/dev/null
+else
+  env JAX_PLATFORMS=cpu python - "$(cat "$FB_TMP/endpoint")" <<'PYEOF' || fb_rc=$?
+import json, sys, urllib.request
+from spark_examples_tpu.serve.client import ServeClient, ServeError
+
+url = sys.argv[1]
+client = ServeClient(url)
+SMALL = ["--num-samples", "8", "--references", "1:0:50000"]
+
+# 1. Three identical small jobs land inside the linger window -> the
+#    daemon runs the group as ONE stacked device program and every
+#    member envelope records the group size it rode in.
+ids = [client.submit(SMALL)["job"]["id"] for _ in range(3)]
+fused = [client.wait(j, timeout=600)["job"] for j in ids]
+for job in fused:
+    if job["status"] != "done" or job["fused_size"] != 3:
+        print(f"group member not fused: {job['status']} "
+              f"fused_size={job['fused_size']} {job.get('error')}")
+        sys.exit(1)
+
+# 2. Serial resubmits of the SAME geometry (one at a time — a
+#    singleton batch never fuses) must be byte-identical to the fused
+#    group's results.
+serial = [client.wait(client.submit(SMALL)["job"]["id"], timeout=600)["job"]
+          for _ in range(2)]
+reference = serial[0]["result"]["pc_lines"]
+for job in serial[1:] + fused:
+    if job["result"]["pc_lines"] != reference:
+        print("fused group results diverged from serial resubmits")
+        sys.exit(1)
+for job in serial:
+    if job["fused_size"] != 1:
+        print(f"singleton batch fused anyway: {job['fused_size']}")
+        sys.exit(1)
+
+# 3. /v1/fleet/stats partitions every executed job fused vs serial.
+with urllib.request.urlopen(url + "/v1/fleet/stats", timeout=30) as resp:
+    dispatch = json.loads(resp.read().decode("utf-8"))["dispatch"]
+if dispatch["fused_groups"] < 1 or dispatch["fused_jobs"] < 3 \
+        or dispatch["serial_jobs"] < 2:
+    print(f"dispatch counters wrong: {dispatch}"); sys.exit(1)
+
+# 4. An over-HBM fused group is a structured 413 at admission: the
+#    plan charges K stacked accumulators against the HBM budget
+#    device-free and names the cohort's fused-group ceiling.
+try:
+    client.submit(["--num-samples", "20000", "--references", "1:0:50000",
+                   "--pca-backend", "tpu", "--fused-jobs", "12"])
+    print("over-HBM fused group was ACCEPTED"); sys.exit(1)
+except ServeError as e:
+    codes = [i["code"] for i in e.body.get("plan", {}).get("issues", [])]
+    if e.status != 413 or e.code != "plan-rejected" \
+            or "fused-group-exceeds-hbm" not in codes:
+        print(f"over-HBM group not a structured 413: "
+              f"{e.status} {e.code} {codes}")
+        sys.exit(1)
+    ceiling = e.body["plan"]["geometry"].get("max_fused_jobs")
+    if not ceiling or ceiling >= 12:
+        print(f"413 geometry does not carry a real fused ceiling: {ceiling}")
+        sys.exit(1)
+
+# 5. Cost ordering: a cheap job admitted BEHIND an expensive one
+#    completes first. The blocker's geometry differs from the
+#    expensive job's so they can never coalesce into one group.
+BLOCKER = ["--num-samples", "144", "--references", "1:0:10000000"]
+EXPENSIVE = ["--num-samples", "128", "--references", "1:0:10000000"]
+blocker = client.submit(BLOCKER)["job"]["id"]
+expensive = client.submit(EXPENSIVE)["job"]["id"]
+cheap = client.submit(SMALL)["job"]["id"]
+cheap_done = client.wait(cheap, timeout=600)["job"]
+expensive_done = client.wait(expensive, timeout=600)["job"]
+client.wait(blocker, timeout=600)
+if cheap_done["status"] != "done" or expensive_done["status"] != "done":
+    print(f"ordering smoke jobs failed: {cheap_done.get('error')} "
+          f"{expensive_done.get('error')}"); sys.exit(1)
+if cheap_done["finished_unix"] >= expensive_done["finished_unix"]:
+    print(f"cheap job did not overtake the expensive one: cheap finished "
+          f"at +{cheap_done['finished_unix'] - expensive_done['finished_unix']:.3f}s")
+    sys.exit(1)
+print(f"fused smoke OK: 3-job group one device program (byte-identical "
+      f"to serial resubmits), dispatch {dispatch['fused_groups']} fused "
+      f"group(s) / {dispatch['serial_jobs']} serial, over-HBM group 413 "
+      f"(ceiling {ceiling}), cheap job overtook the expensive one by "
+      f"{expensive_done['finished_unix'] - cheap_done['finished_unix']:.2f}s")
+PYEOF
+  kill -TERM "$FB_PID" 2>/dev/null
+  if wait "$FB_PID"; then
+    echo "fused smoke: daemon drained cleanly (exit 0)"
+  else
+    echo "fused smoke: daemon exited nonzero"; fb_rc=1
+  fi
+fi
+if [ "$fb_rc" -ne 0 ]; then
+  echo "fused batch smoke failed (rc=$fb_rc):"; tail -20 "$FB_TMP/daemon.err"
+fi
+rm -rf "$FB_TMP"
 
 echo "== multi-replica serving smoke (lease-fenced work stealing) =="
 rep_rc=0
@@ -1343,6 +1481,7 @@ if [ "$ring_rc" -ne 0 ]; then exit "$ring_rc"; fi
 if [ "$an_rc" -ne 0 ]; then exit "$an_rc"; fi
 if [ "$serve_rc" -ne 0 ]; then exit "$serve_rc"; fi
 if [ "$sc_rc" -ne 0 ]; then exit "$sc_rc"; fi
+if [ "$fb_rc" -ne 0 ]; then exit "$fb_rc"; fi
 if [ "$rep_rc" -ne 0 ]; then exit "$rep_rc"; fi
 if [ "$faults_rc" -ne 0 ]; then exit "$faults_rc"; fi
 exit "$san_rc"

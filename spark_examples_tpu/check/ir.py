@@ -764,6 +764,44 @@ def dense_kernel_spec(data: int, num_samples: int, block_size: int) -> KernelSpe
     )
 
 
+def stacked_kernel_spec(
+    jobs: int, num_samples: int, block_size: int
+) -> KernelSpec:
+    """The fused batch executor's stacked-jobs update (``ops/batched.py``):
+    the IDENTICAL ``_dense_update`` body with the jobs axis in the batch
+    slot — one program accumulating K independent Gramians. A first-class
+    audit subject: the serving daemon's fused dispatch runs exactly this
+    jaxpr, so its donation/dtype/liveness contracts must hold at group
+    geometry, not just at the serial data-axis geometry."""
+
+    def build() -> Tuple[Callable[..., Any], Tuple[Any, ...]]:
+        import jax
+        import jax.numpy as jnp
+
+        from spark_examples_tpu.ops.gramian import _dense_update
+        from spark_examples_tpu.parallel.mesh import RING_PACK_MULTIPLE
+
+        G = jax.ShapeDtypeStruct((jobs, num_samples, num_samples), jnp.float32)
+        X = jax.ShapeDtypeStruct(
+            (jobs, block_size, -(-num_samples // RING_PACK_MULTIPLE)),
+            jnp.uint8,
+        )
+        return (
+            lambda g, x: _dense_update(g, x, np.float32, num_samples),
+            (G, X),
+        )
+
+    return KernelSpec(
+        name=f"stacked[jobs={jobs},N={num_samples},B={block_size}]",
+        build=build,
+        packed=True,
+        packed_invars=(1,),
+        acc_invar=0,
+        donation=DonationSite(_gramian_file(), "_dense_update", "ops/gramian.py"),
+        liveness_scope="global",
+    )
+
+
 def counts_kernel_spec(data: int, num_samples: int, block_size: int) -> KernelSpec:
     """The count-valued (same-set-join) dense update — unpacked by
     necessity, audited for donation and dtype hygiene."""
@@ -1111,6 +1149,10 @@ def default_specs(
     for data in sorted({d for d, _ in meshes}):
         specs.append(dense_kernel_spec(data, num_samples, block_size))
         specs.append(counts_kernel_spec(data, num_samples, block_size))
+    # The fused batch groups' stacked program, at a small and a larger
+    # group size: same body as dense, jobs axis in the batch slot.
+    for jobs in (2, 4):
+        specs.append(stacked_kernel_spec(jobs, num_samples, block_size))
     for data, samples in meshes:
         if samples < 2:
             continue
@@ -1232,5 +1274,6 @@ __all__ = [
     "peak_live_bytes",
     "ring_kernel_spec",
     "run_audit",
+    "stacked_kernel_spec",
     "trace_kernel",
 ]

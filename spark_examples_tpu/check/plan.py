@@ -347,6 +347,56 @@ def _eval_dense_update(report: PlanReport, data: int, conf: PcaConf) -> None:
         )
 
 
+def _eval_stacked_update(
+    report: PlanReport, fused_jobs: int, conf: PcaConf
+) -> None:
+    """Trace the STACKED-JOBS kernel abstractly (``--fused-jobs K``): the
+    fused batch executor's one-device-program path runs the identical
+    ``_dense_update`` body with a leading jobs axis in the batch slot —
+    G (K, N, N), X (K, B, ceil(N/8)) — so the same eval_shape proof that
+    covers the serial kernel covers the stacked one, at the group's
+    geometry. Device-free, like every proof here."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_examples_tpu.ops.gramian import _dense_update
+
+    K = int(fused_jobs)
+    N = int(conf.num_samples)
+    B = int(conf.block_size)
+    operand = np.int8 if conf.exact_similarity else np.float32
+    accum = jnp.int32 if conf.exact_similarity else jnp.float32
+    G = jax.ShapeDtypeStruct((K, N, N), accum)
+    X_packed = jax.ShapeDtypeStruct((K, B, -(-N // 8)), jnp.uint8)
+    out = jax.eval_shape(
+        lambda g, x: _dense_update(g, x, operand, N), G, X_packed
+    )
+    if out.shape != G.shape or out.dtype != G.dtype:
+        report.error(
+            "stacked-update-shape",
+            f"stacked {K}-job Gramian update maps {G.shape}/{G.dtype} to "
+            f"{out.shape}/{out.dtype} — per-job accumulator lanes would "
+            "diverge",
+        )
+        return
+    # The per-job result is a host-side slice of the stacked accumulator:
+    # prove the slice geometry too (what the fused runner hands each
+    # job's epilogue).
+    lane = jax.eval_shape(lambda g: g[0], G)
+    if lane.shape != (N, N):
+        report.error(
+            "stacked-slice-shape",
+            f"per-job slice of the stacked accumulator yields "
+            f"{lane.shape}, expected {(N, N)}",
+        )
+        return
+    report.shape_checks.append(
+        f"stacked update: jobs={K}, ({K}, {B}, {N}) uint8 blocks -> "
+        f"G {out.shape} {out.dtype}; per-job slice -> {lane.shape}"
+    )
+
+
 #: Simultaneous per-device buffers of the sharded strategy at peak: the
 #: local G row-tile, its non-donated update output, and the (smaller)
 #: column-block operands rounded up to one more tile.
@@ -1321,6 +1371,14 @@ def validate_plan(
     if conf.pca_backend == "tpu" and gramian_like:
         if report.ok:
             _eval_dense_update(report, data, conf)
+        if report.ok and conf.fused_jobs is not None:
+            if conf.fused_jobs < 1:
+                report.error(
+                    "fused-jobs-invalid",
+                    f"--fused-jobs must be >= 1, got {conf.fused_jobs}",
+                )
+            else:
+                _eval_stacked_update(report, conf.fused_jobs, conf)
         ring_trace = None
         if report.ok and (sharded or samples >= 2):
             ring_trace = _eval_sharded_update(report, data, samples, conf)
@@ -1416,6 +1474,31 @@ def validate_plan(
                 f"device, past {DENSE_HBM_FRACTION:.0%} of the "
                 f"{_DEFAULT_DEVICE_BYTES >> 30} GiB default budget; use "
                 "the sharded strategy (and a samples axis)",
+            )
+    if conf.fused_jobs is not None and conf.fused_jobs >= 1:
+        # The stacked program's HBM liveness is K× the per-job dense
+        # liveness (K accumulator lanes resident at once, same working
+        # buffers per lane) — the rejection that caps a batch group's
+        # size BEFORE devices are touched. The group ceiling rides the
+        # geometry either way, so serve admission and graftcheck plan
+        # agree on the largest K a cohort admits.
+        from spark_examples_tpu.ops.batched import max_fused_jobs
+
+        K = int(conf.fused_jobs)
+        fused_need = K * dense_need
+        ceiling = max_fused_jobs(N, accum_bytes=accum_bytes)
+        report.geometry["fused_jobs"] = K
+        report.geometry["max_fused_jobs"] = ceiling
+        report.geometry["fused_group_hbm_bytes"] = fused_need
+        if fused_need > DENSE_HBM_FRACTION * _DEFAULT_DEVICE_BYTES:
+            report.error(
+                "fused-group-exceeds-hbm",
+                f"a fused group of {K} jobs with N={N} needs ~"
+                f"{fused_need / (1 << 30):.1f} GiB of stacked working "
+                f"buffers per device, past {DENSE_HBM_FRACTION:.0%} of "
+                f"the {_DEFAULT_DEVICE_BYTES >> 30} GiB default budget "
+                f"(this cohort admits at most {ceiling} fused job(s)); "
+                "shrink the group or serve the jobs serially",
             )
     return report
 

@@ -1486,6 +1486,28 @@ def dense_range_spec(
     )
 
 
+def stacked_range_spec(
+    jobs: int, num_samples: int, block_size: int
+) -> RangeKernelSpec:
+    """The fused batch groups' stacked-jobs update under the packed-byte
+    contract. Unlike the dense spec's data axis, the jobs axis lanes are
+    INDEPENDENT accumulators that never sum together at finalize (each
+    job takes its own slice), so one drain step grows any single entry by
+    at most ``block_size`` rows — not ``jobs * block_size``."""
+    from spark_examples_tpu.check.ir import stacked_kernel_spec
+
+    ir_spec = stacked_kernel_spec(jobs, num_samples, block_size)
+    return RangeKernelSpec(
+        name=f"ranges:{ir_spec.name}",
+        build=ir_spec.build,
+        input_contracts=(None, PACKED_BYTE),
+        rows_per_flush=block_size,
+        max_count=HAS_VARIATION.hi,
+        operand_window_dtype="bfloat16",
+        accum_dtype="float32",
+    )
+
+
 def counts_range_spec(
     data: int, num_samples: int, block_size: int
 ) -> RangeKernelSpec:
@@ -1684,6 +1706,10 @@ def default_specs(
     for data in sorted({d for d, _ in meshes}):
         specs.append(dense_range_spec(data, num_samples, block_size))
         specs.append(counts_range_spec(data, num_samples, block_size))
+    # The fused batch groups' stacked program, same group sizes as the
+    # ir matrix.
+    for jobs in (2, 4):
+        specs.append(stacked_range_spec(jobs, num_samples, block_size))
     for data, samples in meshes:
         if samples < 2:
             continue
@@ -1794,5 +1820,6 @@ __all__ = [
     "devicegen_range_spec",
     "hier_range_spec",
     "ring_range_spec",
+    "stacked_range_spec",
     "run_audit",
 ]

@@ -455,6 +455,20 @@ def build_pca_parser(
         ),
     )
     parser.add_argument(
+        "--fused-jobs",
+        type=int,
+        default=None,
+        metavar="K",
+        help=(
+            "Validate/audit the configuration as one lane of a K-job "
+            "fused batch group (the serving daemon's stacked device "
+            "program; ops/batched.py): `graftcheck plan` charges HBM "
+            "for K stacked accumulators and rejects over-budget groups, "
+            "and `graftcheck ir`/`ranges` audit the stacked kernel. "
+            "Plan-time only — a batch run ignores it."
+        ),
+    )
+    parser.add_argument(
         "--blocks-per-dispatch",
         type=int,
         default=None,
@@ -575,6 +589,7 @@ class PcaConf(GenomicsConf):
     mesh_shape: Optional[str] = None
     block_size: int = 1024
     ingest: str = "auto"
+    fused_jobs: Optional[int] = None
     blocks_per_dispatch: Optional[int] = None
     ring_pack_bits: str = "auto"
     reduce_schedule: str = "auto"

@@ -90,6 +90,8 @@ from spark_examples_tpu.obs.metrics import (
     PREFETCH_QUEUE_OCCUPANCY,
     SERVE_BATCH_JOBS,
     SERVE_BATCHES,
+    SERVE_FUSED_GROUPS,
+    SERVE_FUSED_JOBS,
     SERVE_JOBS_DONE,
     SERVE_JOBS_INFLIGHT,
     SERVE_JOBS_STOLEN,
@@ -322,6 +324,18 @@ class Heartbeat:
             segment = f"batched {int(batches)} groups"
             if batch_jobs:
                 segment += f" ({int(batch_jobs)} jobs)"
+            parts.append(segment)
+
+        # Fused dispatch yield: batch groups that ran as ONE stacked
+        # device program (serve/executor.py:execute_fused_batch), with
+        # the mean group size — "fused 3 K-job groups (K≈4.0)" says the
+        # one-program-per-group promise is actually engaging.
+        fused = self.registry.value(SERVE_FUSED_GROUPS)
+        if fused:
+            fused_jobs = self.registry.value(SERVE_FUSED_JOBS)
+            segment = f"fused {int(fused)} K-job group(s)"
+            if fused_jobs:
+                segment += f" (K≈{fused_jobs / fused:.1f})"
             parts.append(segment)
 
         # Cost-calibration segment (obs/calibration.py fold, sampled via

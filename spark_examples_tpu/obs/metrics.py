@@ -132,6 +132,14 @@ SERVE_SLICES_BUSY = "serve_slices_busy"
 SERVE_BATCHES = "serve_batches_total"
 SERVE_BATCH_JOBS = "serve_batch_jobs_total"
 
+#: Fused batch execution (``pipeline/fused.py``): groups that ran as ONE
+#: stacked device program (a leading jobs axis over the Gramian update),
+#: and the jobs that rode them. A group counted under SERVE_BATCHES but
+#: not here fell back to serial back-to-back dispatch (ineligible mix or
+#: stacked-HBM cap).
+SERVE_FUSED_GROUPS = "serve_fused_groups_total"
+SERVE_FUSED_JOBS = "serve_fused_jobs_total"
+
 #: Jobs replayed from the on-disk job journal (``serve/journal.py``) at
 #: daemon startup — each one an admission a previous incarnation
 #: acknowledged and this one honored.
@@ -325,6 +333,15 @@ _WELL_KNOWN_COUNTER_HELP = {
     SERVE_BATCH_JOBS: (
         "Small jobs that rode a multi-job dispatch group (continuous "
         "batching over the admission queue)."
+    ),
+    SERVE_FUSED_GROUPS: (
+        "Dispatch groups executed as ONE stacked device program "
+        "(pipeline/fused.py) — one dispatch and one reduction per step "
+        "for the whole group."
+    ),
+    SERVE_FUSED_JOBS: (
+        "Jobs that rode a fused stacked device program instead of a "
+        "serial back-to-back dispatch."
     ),
     SERVE_JOURNAL_REPLAYED: (
         "Accepted-but-unfinished jobs replayed from the job journal at "
@@ -960,6 +977,8 @@ __all__ = [
     "SERVE_SLICES_BUSY",
     "SERVE_BATCHES",
     "SERVE_BATCH_JOBS",
+    "SERVE_FUSED_GROUPS",
+    "SERVE_FUSED_JOBS",
     "SERVE_JOURNAL_REPLAYED",
     "SERVE_LEASE_RENEWALS",
     "SERVE_JOBS_STOLEN",

@@ -1,0 +1,289 @@
+"""Stacked-jobs fused dispatch: one device program per batch group.
+
+Continuous batching (``serve/queue.py:pop_batch``) coalesces small jobs
+that share a region-invariant compile fingerprint. Until now a group only
+shared warm jit caches — K jobs still paid K dispatch + reduction + host
+round-trips each, with the MXU mostly idle between them. This module
+stacks the group: the dense Gramian update ``G[d] += X[d]ᵀ X[d]``
+(``ops/gramian.py:_dense_update``) is ALREADY batched over a leading
+axis, so a K-job group runs with the jobs axis in that slot — a
+``(K, N, N)`` accumulator fed ``(K, B, ceil(N/8))`` bit-packed operands,
+ONE einsum dispatch per step for the whole group, and per-job results
+sliced out on host. No new kernel exists to audit separately by
+construction: the stacked program is the same shared constructor
+``check/ir.py`` traces, instantiated with ``jobs`` in the leading slot
+(``check/ir.py:stacked_kernel_spec`` / ``check/ranges.py:
+stacked_range_spec`` audit it as a first-class subject).
+
+Byte-identity argument (CI-asserted, never assumed):
+
+- each lane reproduces ``GramianAccumulator``'s ``data=1`` host staging
+  EXACTLY — same zero-padded tail, same ``np.packbits`` big-endian pack,
+  same operand/accumulator dtypes — so step t of lane k carries the
+  identical operand bytes the serial job's flush t would ship;
+- the einsum contracts over ``(b, n/m)`` only: lead-axis slice k of the
+  stacked update equals the serial ``data=1`` update on lane k's
+  operands, entry for entry;
+- a lane that runs out of blocks (ragged groups) receives all-zero
+  packed operands: ``XᵀX`` of a zero block is exactly zero, and Gramian
+  entries are non-negative counts accumulated from +0.0, so ``x + 0.0``
+  is bitwise ``x`` — padding steps are byte-identity, no masking needed;
+- the serial finalize for ``data=1`` (``data_axis_sum``) is a
+  dtype-preserving sum over a singleton axis — numerically the slice
+  itself — so ``stacked.G[k]`` IS the serial job's finalized Gramian.
+
+The one semantic the stack cannot carry: a mid-stream dtype-ladder climb
+(``_maybe_switch_accumulator``) is per-accumulator, and lanes at
+different ladder positions cannot share one stacked buffer. Groups whose
+projected per-entry count could cross the f32 exact window are
+:class:`FusedIneligible` and fall back to serial execution — small jobs
+(the only fusable class, ≤ ``SMALL_JOB_MAX_SITES`` sites) sit orders of
+magnitude below the 2^24 trigger, so the gate is a guard rail, not a
+path.
+
+HBM: the stacked accumulator charges K× the dense per-job liveness
+(:func:`max_fused_jobs`, the same ``_DENSE_BUFFERS``/
+``DENSE_HBM_FRACTION`` rule the dense strategy and ``check/plan.py``
+share), so group size is capped before devices are touched and
+``graftcheck plan --fused-jobs K`` proves a group device-free.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from spark_examples_tpu.ops.contracts import (
+    EXACT_F32_LIMIT,
+    flush_entry_increment,
+)
+from spark_examples_tpu.ops.gramian import (
+    _DEFAULT_DEVICE_BYTES,
+    _DENSE_BUFFERS,
+    DENSE_HBM_FRACTION,
+    _dense_update,
+    _operand_dtypes,
+)
+
+
+class FusedIneligible(RuntimeError):
+    """This group (or one member) cannot ride the stacked program.
+
+    A scheduling signal, not an error surface: the caller falls back to
+    serial back-to-back execution, which is always semantically valid.
+    """
+
+
+def max_fused_jobs(
+    num_samples: int,
+    accum_bytes: int = 4,
+    device_bytes: Optional[int] = None,
+) -> int:
+    """Largest jobs axis whose stacked liveness fits the dense HBM rule.
+
+    The stacked program holds K× the dense strategy's per-job working
+    set (``_DENSE_BUFFERS`` simultaneous N×N accumulator-dtype buffers),
+    so K is bounded by the same ``DENSE_HBM_FRACTION`` budget the
+    dense/sharded auto-switch and the plan validator's
+    ``dense-exceeds-hbm`` rule use — ONE rule, three consumers, no
+    drifted constants. ``device_bytes=None`` uses the device-free default
+    (the validator must not query real devices); the daemon may pass a
+    measured budget. Always at least 1: a single job is just the dense
+    strategy, gated by its own rule."""
+    budget = _DEFAULT_DEVICE_BYTES if device_bytes is None else device_bytes
+    per_job = _DENSE_BUFFERS * int(num_samples) ** 2 * int(accum_bytes)
+    return max(1, int((DENSE_HBM_FRACTION * budget) // per_job))
+
+
+class StackedJobsAccumulator:
+    """K independent dense Gramian lanes, one device program per step.
+
+    Feed lane ``k`` host ``(b, N)`` uint8 has-variation rows with
+    :meth:`add_rows`; each lane stages into its own ``(block_size, N)``
+    buffer with EXACTLY ``GramianAccumulator``'s ``data=1`` flush
+    semantics (zero-padded tail, ``np.packbits`` along the samples axis).
+    Full lane blocks queue as pending operands; a stacked step dispatches
+    as soon as every lane can contribute one (a finished lane contributes
+    zeros), so host memory stays O(K × block) when lanes are fed in
+    lockstep. :meth:`finalize` drains every lane and returns the
+    ``(K, N, N)`` device accumulator; :meth:`job_slice` is one job's
+    finalized Gramian, byte-identical to its serial run.
+    """
+
+    def __init__(
+        self,
+        num_jobs: int,
+        num_samples: int,
+        block_size: int = 1024,
+        exact_int: bool = False,
+        pipeline_depth: int = 2,
+    ):
+        import jax.numpy as jnp
+
+        if num_jobs < 1:
+            raise ValueError(f"num_jobs must be >= 1, got {num_jobs}")
+        self.num_jobs = int(num_jobs)
+        self.num_samples = int(num_samples)
+        self.block_size = int(block_size)
+        self.exact_int = bool(exact_int)
+        # Same dtype resolution as the serial dense accumulator with no
+        # mesh: the process default backend — the fused group runs on the
+        # same slice its serial members would.
+        self.operand_dtype, self.accum_dtype = _operand_dtypes(
+            exact_int, None
+        )
+        k, b, n = self.num_jobs, self.block_size, self.num_samples
+        self._staging = [np.zeros((b, n), dtype=np.uint8) for _ in range(k)]
+        self._fill = [0] * k
+        self._pending: List[List[np.ndarray]] = [[] for _ in range(k)]
+        self._finished = [False] * k
+        self._entry_bound = [0] * k
+        self.rows_seen = [0] * k
+        self.steps = 0
+        # XᵀX of a zero block is exactly zero (the ragged-lane pad).
+        self._zero_op = np.packbits(
+            np.zeros((1, b, n), dtype=np.uint8), axis=-1
+        )
+        self.G = jnp.zeros((k, n, n), self.accum_dtype)
+        # Same bounded async feed as the serial accumulator's
+        # double-buffered path: block on the update issued
+        # ``pipeline_depth`` steps ago, keep the newest in flight.
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self._in_flight: List[object] = []
+
+    # -------------------------------------------------------------- feeding
+
+    def add_rows(self, lane: int, rows: np.ndarray) -> None:
+        """Stage host rows into one lane; pack full blocks and dispatch
+        any stacked step the group can now afford."""
+        if self._finished[lane]:
+            raise RuntimeError(f"lane {lane} already finished")
+        rows = np.asarray(rows, dtype=np.uint8)
+        if rows.ndim != 2 or rows.shape[1] != self.num_samples:
+            raise ValueError(
+                f"expected (b, {self.num_samples}) rows, got {rows.shape}"
+            )
+        self.rows_seen[lane] += rows.shape[0]
+        staging, offset = self._staging[lane], 0
+        capacity = staging.shape[0]
+        while offset < rows.shape[0]:
+            take = min(capacity - self._fill[lane], rows.shape[0] - offset)
+            staging[self._fill[lane] : self._fill[lane] + take] = rows[
+                offset : offset + take
+            ]
+            self._fill[lane] += take
+            offset += take
+            if self._fill[lane] == capacity:
+                self._pack_lane(lane)
+        self._drain()
+
+    def finish_lane(self, lane: int) -> None:
+        """One lane's stream is complete: pack its zero-padded partial
+        tail (the serial accumulator's finalize flush) and let shorter
+        lanes ride zero operands from here on."""
+        if self._finished[lane]:
+            return
+        if self._fill[lane]:
+            self._pack_lane(lane)
+        self._finished[lane] = True
+        self._drain()
+
+    def _pack_lane(self, lane: int) -> None:
+        """EXACTLY ``GramianAccumulator._flush`` for ``data=1``: pad the
+        tail with zero rows (they contribute nothing to XᵀX), prove the
+        dtype-ladder position is stable, bit-pack along samples."""
+        fill = self._fill[lane]
+        block = self._staging[lane]
+        if fill < block.shape[0]:
+            block = block.copy()
+            block[fill:] = 0
+        max_count = int(block.max(initial=0))
+        if max_count > 1:
+            # Count-valued rows (same-set joins) ride the unpacked counts
+            # kernel serially; the stacked path declares only the packed
+            # {0,1} contract — refuse, don't approximate.
+            raise FusedIneligible(
+                f"lane {lane} staged count-valued rows (max {max_count}); "
+                "stacked dispatch covers has-variation {0,1} rows only"
+            )
+        increment = flush_entry_increment(fill, max_count)
+        next_bound = self._entry_bound[lane] + increment
+        if not self.exact_int and next_bound > EXACT_F32_LIMIT:
+            # The serial accumulator would climb the dtype ladder HERE —
+            # a per-lane event the shared stacked buffer cannot carry.
+            # The executor's static gate keeps fusable (small) jobs far
+            # below this; reaching it means fall back to serial.
+            raise FusedIneligible(
+                f"lane {lane} projects {next_bound} per-entry counts, past "
+                f"the f32 exact window ({EXACT_F32_LIMIT}); the serial "
+                "path would switch accumulator dtype mid-stream"
+            )
+        self._entry_bound[lane] = next_bound
+        shaped = block.reshape(1, self.block_size, self.num_samples)
+        self._pending[lane].append(np.packbits(shaped, axis=-1))
+        self._fill[lane] = 0
+
+    # ----------------------------------------------------------- dispatching
+
+    def _step_ready(self) -> bool:
+        """A stacked step can dispatch iff every lane can contribute an
+        operand — a pending packed block, or zeros once finished — and at
+        least one lane contributes real work (all-zero steps are dropped,
+        they exist only between real blocks of a ragged drain)."""
+        any_real = False
+        for lane in range(self.num_jobs):
+            if self._pending[lane]:
+                any_real = True
+            elif not self._finished[lane]:
+                return False
+        return any_real
+
+    def _drain(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        while self._step_ready():
+            ops = [
+                self._pending[lane].pop(0)
+                if self._pending[lane]
+                else self._zero_op
+                for lane in range(self.num_jobs)
+            ]
+            X = np.concatenate(ops, axis=0)
+            self.G = _dense_update(
+                self.G, jnp.asarray(X), self.operand_dtype, self.num_samples
+            )
+            self.steps += 1
+            self._in_flight.append(self.G)
+            if len(self._in_flight) > self.pipeline_depth:
+                jax.block_until_ready(self._in_flight.pop(0))  # graftcheck: disable=GC007 -- this IS the bounded in-flight window the rule recommends: waits only for the stacked step issued pipeline_depth iterations ago (same double-buffered feed as GramianAccumulator._flush), never the step just dispatched
+
+    # -------------------------------------------------------------- results
+
+    def finalize(self):
+        """Drain every lane (callers must have :meth:`finish_lane`'d them
+        all) and return the stacked ``(K, N, N)`` device accumulator."""
+        for lane in range(self.num_jobs):
+            if not self._finished[lane]:
+                raise RuntimeError(
+                    f"finalize before finish_lane({lane}) — lane streams "
+                    "must be complete"
+                )
+        self._drain()
+        self._in_flight.clear()
+        return self.G
+
+    def job_slice(self, lane: int):
+        """Lane ``k``'s finalized Gramian, on device. The serial
+        ``data=1`` finalize (``data_axis_sum``) is a dtype-preserving sum
+        over a singleton leading axis — the slice itself — so this is
+        byte-identical to the serial job's ``finalize_device()``."""
+        return self.G[lane]
+
+
+__all__ = [
+    "FusedIneligible",
+    "StackedJobsAccumulator",
+    "max_fused_jobs",
+]

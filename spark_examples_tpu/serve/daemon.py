@@ -57,7 +57,11 @@ import time
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
-from spark_examples_tpu.serve.executor import ExecutionOutcome, execute_job
+from spark_examples_tpu.serve.executor import (
+    ExecutionOutcome,
+    execute_fused_batch,
+    execute_job,
+)
 from spark_examples_tpu.serve.journal import (
     DEFAULT_LEASE_SECONDS,
     JobJournal,
@@ -78,6 +82,7 @@ from spark_examples_tpu.serve.protocol import (
 )
 from spark_examples_tpu.obs.trace import mint_trace_id, normalize_trace_id
 from spark_examples_tpu.serve.queue import (
+    DEFAULT_AGE_CAP_SECONDS,
     DEFAULT_BATCH_LINGER_SECONDS,
     DEFAULT_BATCH_MAX_JOBS,
     DEFAULT_LARGE_CAPACITY,
@@ -122,6 +127,7 @@ MEM_LIMIT_CODES = frozenset(
         "host-mem-over-budget",
         "dense-exceeds-hbm",
         "sharded-exceeds-hbm",
+        "fused-group-exceeds-hbm",
     }
 )
 
@@ -157,6 +163,12 @@ _RESERVED_FLAG_FIELDS = (
     # client-placed matrix file.
     ("grm_out", "--grm-out"),
 )
+# NOT reserved: --fused-jobs. It is a pure plan directive — admission
+# validates the K-lane stacked geometry (an over-HBM group is a
+# structured 413 via MEM_LIMIT_CODES) but group MEMBERSHIP stays the
+# daemon's dispatch decision: the flag is fingerprint-invariant
+# (utils/cache.py:_NON_GEOMETRY_FIELDS) and nothing in the execution
+# path reads it, so a declared K can neither force nor split a group.
 
 
 def _parse_job_flags(flags, kind: str = "pca"):
@@ -211,6 +223,9 @@ class PcaService:
         small_site_limit: int = SMALL_JOB_MAX_SITES,
         batch_max_jobs: int = DEFAULT_BATCH_MAX_JOBS,
         batch_linger_seconds: float = DEFAULT_BATCH_LINGER_SECONDS,
+        batch_fuse: bool = True,
+        ordering: str = "cost",
+        age_cap_seconds: float = DEFAULT_AGE_CAP_SECONDS,
         persistent_cache: bool = False,
         replica_id: Optional[str] = None,
         lease_seconds: float = DEFAULT_LEASE_SECONDS,
@@ -276,9 +291,18 @@ class PcaService:
         self.small_site_limit = int(small_site_limit)
         self.batch_max_jobs = int(batch_max_jobs)
         self.batch_linger_seconds = float(batch_linger_seconds)
+        #: Run multi-job batch groups as ONE stacked device program when
+        #: the group is eligible (pipeline/fused.py preflight); ``False``
+        #: restores the serial per-job dispatch loop unconditionally.
+        self.batch_fuse = bool(batch_fuse)
         self.persistent_cache = bool(persistent_cache)
         self._executor = executor if executor is not None else execute_job
-        self._queue = BoundedJobQueue(small_capacity, large_capacity)
+        self._queue = BoundedJobQueue(
+            small_capacity,
+            large_capacity,
+            ordering=ordering,
+            age_cap_seconds=age_cap_seconds,
+        )
         # (job-state flips and table reads only; the queue's and
         # journal's own leaf locks are never taken while holding it:
         # admission puts and journal appends happen outside.)
@@ -485,6 +509,22 @@ class PcaService:
         self._batches = well_known_counter(self.registry, SERVE_BATCHES)
         self._batch_jobs = well_known_counter(
             self.registry, SERVE_BATCH_JOBS
+        )
+        from spark_examples_tpu.obs.metrics import (
+            SERVE_FUSED_GROUPS,
+            SERVE_FUSED_JOBS,
+        )
+
+        self._fused_groups = well_known_counter(
+            self.registry, SERVE_FUSED_GROUPS
+        )
+        self._fused_jobs = well_known_counter(
+            self.registry, SERVE_FUSED_JOBS
+        )
+        self._serial_jobs = self.registry.counter(
+            "serve_serial_jobs_total",
+            "Jobs dispatched as their own device program (the non-fused "
+            "path; fused vs serial partitions every executed job).",
         )
         self._journal_replayed = well_known_counter(
             self.registry, SERVE_JOURNAL_REPLAYED
@@ -797,6 +837,11 @@ class PcaService:
             # the adopter's warm state.
             cost_prediction=self._cost_from_record(record),
         )
+        job.cost_estimate_seconds = (
+            job.cost_prediction.best_estimate_seconds
+            if job.cost_prediction is not None
+            else None
+        )
         if count_replayed:
             self._journal_replayed.inc(1)
             self._replayed_jobs += 1
@@ -1104,6 +1149,14 @@ class PcaService:
             batch_key=self._batch_key(conf, request.kind),
             trace_id=normalize_trace_id(trace_id) or mint_trace_id(),
             cost_prediction=prediction,
+        )
+        # The queue orders each class lane by this calibrated estimate
+        # (SJF; serve/queue.py) — stamped here so the queue itself stays
+        # free of cost-model imports.
+        job.cost_estimate_seconds = (
+            prediction.best_estimate_seconds
+            if prediction is not None
+            else None
         )
         with self._lock:
             self._table[job.id] = job
@@ -1483,6 +1536,13 @@ class PcaService:
                 SERVE_JOB_WALL_SECONDS, "compile"
             ),
             "calibration": fold.summary(),
+            # Fused vs serial partitions every executed job: the fleet's
+            # live answer to "is batch fusion actually engaging?".
+            "dispatch": {
+                "fused_groups": int(self._fused_groups.value),
+                "fused_jobs": int(self._fused_jobs.value),
+                "serial_jobs": int(self._serial_jobs.value),
+            },
             "counters": {
                 "jobs_stolen": int(self._jobs_stolen.value),
                 "worker_restarts": int(self._worker_restarts.value),
@@ -1531,6 +1591,7 @@ class PcaService:
             plan_geometry=job.plan_geometry,
             slice_name=job.slice,
             batch_size=job.batch_size,
+            fused_size=job.fused_size,
             trace=job.trace_id,
             cost=self._job_cost_doc_locked(job),
         )
@@ -1568,12 +1629,46 @@ class PcaService:
             self._run_batch(worker, batch)
 
     def _run_batch(self, worker: _SliceWorker, batch: List[Job]) -> None:
-        """One dispatch group: the batch's jobs back to back on this
-        slice's warm caches. Results are identical to serial execution —
-        batching only removes inter-job queue latency and re-pops."""
+        """One dispatch group: the batch's jobs on this slice's warm
+        caches. When fusion is on and the group preflights eligible, the
+        whole group runs as ONE stacked device program
+        (:meth:`_run_fused`); otherwise the jobs run back to back.
+        Results are identical either way — batching and fusion only
+        remove inter-job queue latency, re-pops, and per-job dispatch."""
         if len(batch) > 1:
             self._batches.inc(1)
             self._batch_jobs.inc(len(batch))
+        if (
+            self.batch_fuse
+            and len(batch) > 1
+            # Custom executors (embedders, test stubs) know nothing of
+            # fused groups — fusion exists only for the real executor.
+            and self._executor is execute_job
+        ):
+            from spark_examples_tpu.pipeline.fused import (
+                FusedIneligible,
+                preflight_fused,
+            )
+
+            try:
+                # Device-free eligibility check BEFORE any lifecycle
+                # mutation: an ineligible group falls through to the
+                # serial loop with zero observable difference.
+                preflight_fused(
+                    [job.conf for job in batch],
+                    [job.request.kind for job in batch],
+                )
+            except FusedIneligible as e:
+                self._trace_event(
+                    "fuse-ineligible",
+                    job=batch[0],
+                    tid=worker.spec.name,
+                    reason=str(e),
+                    group=len(batch),
+                )
+            else:
+                self._run_fused(worker, batch)
+                return
         with self._lock:
             worker.pending_batch = list(batch)
         for job in batch:
@@ -1585,7 +1680,68 @@ class PcaService:
         with self._lock:
             worker.pending_batch = []
 
-    def _run_job(self, worker: _SliceWorker, job: Job) -> None:
+    def _run_fused(self, worker: _SliceWorker, batch: List[Job]) -> None:
+        """One ELIGIBLE dispatch group as one stacked device program:
+        predispatch every member (the same fences and journal boundary
+        the serial path crosses), hand the survivors to
+        ``executor.execute_fused_batch`` as one call, then settle each
+        member with its own outcome. A member that expires or loses its
+        lease at predispatch drops out of the group — the stacked
+        program runs over the survivors only."""
+        with self._lock:
+            worker.pending_batch = list(batch)
+        dispatched: List[Job] = []
+        for job in batch:
+            job.batch_size = len(batch)
+            job.fused_size = len(batch)
+            with self._lock:
+                if job in worker.pending_batch:
+                    worker.pending_batch.remove(job)
+            if self._predispatch_job(worker, job):
+                dispatched.append(job)
+        with self._lock:
+            worker.pending_batch = []
+        if not dispatched:
+            return
+        # The journaled began records carry the PLANNED group size; the
+        # envelope reports what actually dispatched.
+        for job in dispatched:
+            job.fused_size = len(dispatched)
+        started = time.perf_counter()
+        outcomes: Optional[List[ExecutionOutcome]] = None
+        error: Optional[str] = None
+        try:
+            with self.spans.span(
+                f"fused group x{len(dispatched)} "
+                f"[{dispatched[0].request.kind}/{worker.spec.name}]"
+            ):
+                outcomes = execute_fused_batch(dispatched, self.run_dir)
+        except Exception as e:  # noqa: BLE001 — the group FAILS, the service lives
+            # Past predispatch every member's device_began is journaled:
+            # a failure fails the WHOLE group (no silent serial retry —
+            # the requeue-once boundary holds for fused members too).
+            error = f"{type(e).__name__}: {e}"
+        wall = time.perf_counter() - started
+        # Amortized marginal cost: the group shared one device program,
+        # so each member's measured wall — the quantity the calibration
+        # ledger learns per geometry — is its share of the group's.
+        seconds = wall / len(dispatched)
+        if error is None:
+            self._fused_groups.inc(1)
+            self._fused_jobs.inc(len(dispatched))
+        for idx, job in enumerate(dispatched):
+            outcome = outcomes[idx] if outcomes is not None else None
+            self._settle_job(worker, job, outcome, error, seconds)
+
+    def _predispatch_job(self, worker: _SliceWorker, job: Job) -> bool:
+        """Everything between dequeue and the executor call: queue-wait
+        stamping, the deadline and lease fences, the running flip, the
+        durable requeue-once boundary. Returns False when the job
+        terminated (expired or abandoned) before device work — the
+        caller must not execute it. Shared verbatim by the serial path
+        (:meth:`_run_job`) and the fused group path (:meth:`_run_fused`),
+        so a fused member's lifecycle records are indistinguishable from
+        a serial member's up to the executor call."""
         now = time.time()
         # Queue wait is a fact the moment the worker holds the job,
         # whatever happens next (run, expire, lease-lost abandon).
@@ -1606,7 +1762,7 @@ class PcaService:
                 self._mark_terminal_locked(job)
             self._journal_terminal(job)
             self._completed.labels(status="failed").inc()
-            return
+            return False
         if (
             self._lease_store is not None
             and not self._lease_store.still_owner(job.id)
@@ -1628,7 +1784,7 @@ class PcaService:
             self._trace_event(
                 "abandoned", job=job, flush=True, reason="lease-lost"
             )
-            return
+            return False
         with self._lock:
             job.status = "running"
             job.started_unix = now
@@ -1648,6 +1804,7 @@ class PcaService:
             job_class=job.job_class,
             kind=job.request.kind,
             batch_size=job.batch_size,
+            **({"fused_size": job.fused_size} if job.fused_size > 1 else {}),
             # Durable on THIS replica's segment before any kill-point:
             # the post-mortem report's queue-wait source for a job whose
             # owner (and its histograms) died mid-run.
@@ -1669,7 +1826,11 @@ class PcaService:
         # not silently re-run the job on restart, whichever replica
         # replays or steals it.
         if self._journal is not None:
-            self._journal.began(job.id, epoch=self._lease_epoch(job.id))
+            self._journal.began(
+                job.id,
+                epoch=self._lease_epoch(job.id),
+                fused_size=job.fused_size,
+            )
         self._trace_event(
             "device-began",
             job=job,
@@ -1689,6 +1850,12 @@ class PcaService:
         # (the executor's callable signature stays (job, run_dir) for
         # embedders and test stubs).
         job.slice_devices = worker.devices
+        return True
+
+    def _run_job(self, worker: _SliceWorker, job: Job) -> None:
+        if not self._predispatch_job(worker, job):
+            return
+        self._serial_jobs.inc(1)
         started = time.perf_counter()
         outcome: Optional[ExecutionOutcome] = None
         error: Optional[str] = None
@@ -1700,6 +1867,22 @@ class PcaService:
         except Exception as e:  # noqa: BLE001 — the job FAILS, the service lives
             error = f"{type(e).__name__}: {e}"
         seconds = time.perf_counter() - started
+        self._settle_job(worker, job, outcome, error, seconds)
+
+    def _settle_job(
+        self,
+        worker: _SliceWorker,
+        job: Job,
+        outcome: Optional[ExecutionOutcome],
+        error: Optional[str],
+        seconds: float,
+    ) -> None:
+        """Everything after the executor returns: the pre-publish lease
+        fence, the terminal flip, tracing, journaling, counters, and the
+        calibration pair. For a fused group member ``seconds`` is the
+        group wall divided by the group size — the amortized marginal
+        cost, which is exactly what the calibration ledger should learn
+        for a job that rode a shared device program."""
         if (
             self._lease_store is not None
             and not self._lease_store.still_owner(job.id)
@@ -2122,7 +2305,15 @@ class PcaService:
         left behind by a settled job is skipped, and compaction sweeps
         it. Stolen jobs keep their original deadline budget — an
         expired one fails with the structured ``deadline-exceeded`` code
-        at re-dispatch instead of running late."""
+        at re-dispatch instead of running late.
+
+        Candidates are claimed in descending calibrated-cost order (cost
+        unknown sorts last): when several replicas race over a dead
+        owner's orphans, each claim is one lease link and loses work to
+        contention — spending the first, least-contended claims on the
+        most expensive stranded jobs recovers the most stranded seconds
+        per scan. File order breaks ties, so the scan stays
+        deterministic for a given journal."""
         store = self._lease_store
         assert store is not None
         if self.draining or self._journal is None:
@@ -2137,10 +2328,11 @@ class PcaService:
             return
         pending, _max_seq = replay_journal(self._journal.path)
         alive_peers = {p["id"] for p in peers if p["alive"]}
+        candidates = []
         for record in pending:
             if record.job_id in expired:
                 # A dead owner's expired lease — the normal steal.
-                self._steal_one(record)
+                candidates.append(record)
                 continue
             owner = record.accepted_record.get("replica")
             if (
@@ -2154,7 +2346,21 @@ class PcaService:
                 # lease claim (or a solo daemon's journal was adopted by
                 # replicas). Its heartbeat is stale/absent, so the job
                 # is orphaned — reclaim it like any expired lease.
-                self._steal_one(record)
+                candidates.append(record)
+        for record in sorted(
+            enumerate(candidates),
+            key=lambda pair: (-self._record_steal_cost(pair[1]), pair[0]),
+        ):
+            self._steal_one(record[1])
+
+    def _record_steal_cost(self, record) -> float:
+        """The journaled admission estimate of one steal candidate, for
+        highest-cost-first claim ordering; ``-inf`` when the record
+        predates cost predictions (those sort last, in file order)."""
+        prediction = self._cost_from_record(record)
+        if prediction is None:
+            return float("-inf")
+        return float(prediction.best_estimate_seconds)
 
     def _steal_one(self, record) -> None:
         store = self._lease_store

@@ -28,12 +28,13 @@ executor's additions are service concerns only:
 
 from __future__ import annotations
 
+import contextlib
 import io
 import os
 import sys
 import threading
 from dataclasses import dataclass
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Sequence
 
 from spark_examples_tpu.serve.queue import Job
 
@@ -63,6 +64,35 @@ class _ThreadStdoutRouter(io.TextIOBase):
 
     def flush(self) -> None:
         self._target().flush()
+
+
+class _SwitchableSink(io.TextIOBase):
+    """The fused group's per-phase stdout target: one worker thread runs
+    K jobs' phases interleaved, so thread routing alone cannot separate
+    their output — this sink stacks the CURRENT target, and the fused
+    runner's per-job phases push each job's log for their duration. The
+    default (bottom-of-stack) target catches group-phase output that
+    belongs to no single job."""
+
+    def __init__(self, default):
+        self._stack = [default]
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, text: str) -> int:
+        return self._stack[-1].write(text)
+
+    def flush(self) -> None:
+        self._stack[-1].flush()
+
+    @contextlib.contextmanager
+    def routed(self, sink):
+        self._stack.append(sink)
+        try:
+            yield
+        finally:
+            self._stack.pop()
 
 
 @dataclass
@@ -163,4 +193,118 @@ def execute_job(job: Job, run_dir: str) -> ExecutionOutcome:
     )
 
 
-__all__ = ["ExecutionOutcome", "execute_job", "job_directory"]
+def execute_fused_batch(
+    jobs: Sequence[Job], run_dir: str
+) -> List[ExecutionOutcome]:
+    """Run a batch group as ONE stacked device program
+    (``pipeline/fused.py``), one outcome per job in group order.
+
+    Raises ``FusedIneligible`` BEFORE any side effect (no job directory,
+    no log, no device work) when the group cannot ride the stacked
+    program — the daemon catches it and falls back to the serial
+    per-job loop, which is always valid. Any exception past preflight
+    fails the whole group, exactly as a serial executor exception fails
+    its one job."""
+    from spark_examples_tpu.obs.manifest import validate_manifest
+    from spark_examples_tpu.pipeline.fused import (
+        preflight_fused,
+        run_fused_pipeline,
+    )
+    from spark_examples_tpu.utils.cache import (
+        batch_compile_fingerprint,
+        compile_fingerprint,
+        fused_group_fingerprint,
+        geometry_seen,
+    )
+
+    kinds = [job.request.kind for job in jobs]
+    confs = [job.conf for job in jobs]
+    preflight_fused(confs, kinds)
+
+    warm: List[bool] = []
+    files: List = []
+    group_warm = geometry_seen(
+        fused_group_fingerprint(
+            batch_compile_fingerprint(confs[0], kind=kinds[0]), len(jobs)
+        )
+    )
+    with contextlib.ExitStack() as stack:
+        for job in jobs:
+            job_dir = job_directory(run_dir, job.id)
+            os.makedirs(job_dir, exist_ok=True)
+            job.conf.metrics_json = os.path.join(job_dir, "manifest.json")
+            # Warm-vs-cold per member: the member geometry AND the
+            # group's stacked geometry must both be warm — a known job
+            # shape still compiles cold stacked kernels the first time
+            # its group size appears.
+            warm.append(
+                group_warm
+                and geometry_seen(
+                    compile_fingerprint(job.conf, kind=job.request.kind)
+                )
+            )
+            files.append(
+                stack.enter_context(
+                    open(
+                        os.path.join(job_dir, "stdout.log"),
+                        "w",
+                        encoding="utf-8",
+                    )
+                )
+            )
+        previous = sys.stdout
+        # Group-phase prints (nothing per-job by the runner's contract)
+        # land in the FIRST member's log rather than the daemon's stdout.
+        switch = _SwitchableSink(files[0])
+        sys.stdout = _ThreadStdoutRouter(
+            previous, threading.get_ident(), switch
+        )
+        try:
+            pipelines = run_fused_pipeline(
+                confs,
+                kinds,
+                devices=getattr(jobs[0], "slice_devices", None),
+                stdout_factory=lambda j: switch.routed(files[j]),
+            )
+        finally:
+            sys.stdout = previous
+
+    outcomes: List[ExecutionOutcome] = []
+    for job, pipeline, was_warm in zip(jobs, pipelines, warm):
+        if pipeline.manifest_path is None:
+            raise RuntimeError(
+                f"fused job {job.id} completed but its manifest was not "
+                f"written (expected {job.conf.metrics_json})"
+            )
+        errors = validate_manifest(pipeline.manifest)
+        if errors:
+            raise RuntimeError(
+                f"fused job {job.id} produced an invalid run manifest: "
+                + "; ".join(errors)
+            )
+        result: Dict = (
+            {"similarity": pipeline.similarity_summary}
+            if job.request.kind == "similarity"
+            else {"pc_lines": pipeline.lines}
+        )
+        outcomes.append(
+            ExecutionOutcome(
+                result=result,
+                manifest_path=pipeline.manifest_path,
+                compile_cache="warm" if was_warm else "cold",
+                conformance=(
+                    pipeline.manifest.get("conformance")
+                    if isinstance(pipeline.manifest, dict)
+                    else None
+                ),
+            )
+        )
+    return outcomes
+
+
+__all__ = [
+    "ExecutionOutcome",
+    "execute_fused_batch",
+    "execute_job",
+    "job_directory",
+]

@@ -44,6 +44,7 @@ from spark_examples_tpu.serve.journal import (
 )
 from spark_examples_tpu.serve.protocol import error_doc
 from spark_examples_tpu.serve.queue import (
+    DEFAULT_AGE_CAP_SECONDS,
     DEFAULT_BATCH_LINGER_SECONDS,
     DEFAULT_BATCH_MAX_JOBS,
     DEFAULT_LARGE_CAPACITY,
@@ -364,6 +365,39 @@ def serve_main(argv: Optional[Sequence[str]] = None) -> int:
         ),
     )
     parser.add_argument(
+        "--no-batch-fuse",
+        action="store_true",
+        help=(
+            "Run every batch group's jobs back to back as separate "
+            "device programs instead of fusing an eligible group into "
+            "ONE stacked program (fusion is on by default; results are "
+            "byte-identical either way)."
+        ),
+    )
+    parser.add_argument(
+        "--serve-ordering",
+        choices=("cost", "fifo"),
+        default="cost",
+        metavar="POLICY",
+        help=(
+            "Queue ordering within each class lane: 'cost' (default) "
+            "serves by calibrated estimate — shortest-job-first, "
+            "deadline jobs by slack, starvation-capped by "
+            "--serve-age-cap-seconds; 'fifo' preserves admission order."
+        ),
+    )
+    parser.add_argument(
+        "--serve-age-cap-seconds",
+        type=float,
+        default=DEFAULT_AGE_CAP_SECONDS,
+        metavar="S",
+        help=(
+            "Starvation bound for --serve-ordering=cost: a job queued "
+            "this long jumps ahead of cost ordering (FIFO among aged "
+            "jobs; default %(default)s)."
+        ),
+    )
+    parser.add_argument(
         "--replica-id",
         default=None,
         metavar="ID",
@@ -459,6 +493,11 @@ def serve_main(argv: Optional[Sequence[str]] = None) -> int:
             f"--batch-linger-seconds must be >= 0, got "
             f"{ns.batch_linger_seconds}"
         )
+    if ns.serve_age_cap_seconds <= 0:
+        parser.error(
+            f"--serve-age-cap-seconds must be > 0, got "
+            f"{ns.serve_age_cap_seconds}"
+        )
     if ns.lease_seconds <= 0:
         parser.error(
             f"--lease-seconds must be > 0, got {ns.lease_seconds}"
@@ -500,6 +539,9 @@ def serve_main(argv: Optional[Sequence[str]] = None) -> int:
         small_site_limit=ns.serve_small_site_limit,
         batch_max_jobs=ns.batch_max_jobs,
         batch_linger_seconds=ns.batch_linger_seconds,
+        batch_fuse=not ns.no_batch_fuse,
+        ordering=ns.serve_ordering,
+        age_cap_seconds=ns.serve_age_cap_seconds,
         persistent_cache=not ns.no_persistent_cache,
         replica_id=ns.replica_id,
         lease_seconds=ns.lease_seconds,

@@ -216,8 +216,20 @@ class JobJournal:
             record["cost"] = dict(cost)
         self._append(self._stamped(record, None))
 
-    def began(self, job_id: str, epoch: Optional[int] = None) -> None:
-        self._append(self._stamped({"event": "began", "id": job_id}, epoch))
+    def began(
+        self,
+        job_id: str,
+        epoch: Optional[int] = None,
+        fused_size: Optional[int] = None,
+    ) -> None:
+        # ``fused_size`` (additive, >1 only for stacked-group members) is
+        # stamped at the began record rather than the accepted record:
+        # group membership is a DISPATCH fact — it does not exist at
+        # admission time, and a replayed/stolen job may re-run serial.
+        record = {"event": "began", "id": job_id}
+        if fused_size is not None and fused_size > 1:
+            record["fused_size"] = int(fused_size)
+        self._append(self._stamped(record, epoch))
 
     def terminal(
         self, job_id: str, status: str, epoch: Optional[int] = None

@@ -223,12 +223,16 @@ def job_doc(
     plan_geometry: Optional[Mapping] = None,
     slice_name: Optional[str] = None,
     batch_size: Optional[int] = None,
+    fused_size: Optional[int] = None,
     trace: Optional[str] = None,
     cost: Optional[Mapping] = None,
 ) -> Dict:
     """The job envelope (submit response and ``GET /v1/jobs/<id>``).
     ``slice``/``batch_size`` are execution attribution (which executor
     slice ran the job, how many jobs rode its dispatch group);
+    ``fused_size`` (additive) is the stacked-program group size when the
+    job rode fused batch execution — 1 means a serial dispatch, even
+    inside a multi-job batch group;
     ``trace`` echoes the job's distributed-tracing id (the client-sent
     ``X-Trace-Id`` when one rode the submit, a server-minted id
     otherwise); ``cost`` is the admission-time cost prediction
@@ -257,6 +261,7 @@ def job_doc(
             ),
             "slice": slice_name,
             "batch_size": batch_size,
+            "fused_size": fused_size,
             "cost": dict(cost) if cost is not None else None,
         },
     }

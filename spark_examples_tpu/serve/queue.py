@@ -18,12 +18,30 @@ worker frees, every queued small job whose batch fingerprint
 (``utils/cache.py:batch_compile_fingerprint`` — region-invariant compile
 geometry) matches the head job coalesces into one dispatch group, up to
 ``max_batch`` jobs, optionally lingering up to ``linger_seconds`` for
-more compatible arrivals. Both bounds are hard: latency is traded for
-throughput only inside the declared window, never unboundedly. Jobs in
-a group execute back to back on the slice's warm jit caches and keep
-their individual results/manifests (byte-identical to serial execution
-— CI-asserted), so batching is a scheduling decision, not a semantics
-change.
+more compatible arrivals. The linger clock is anchored at the FIRST
+group member's enqueue time, not the pop call: a group that is already
+full (or whose head already waited out the window in the queue) is
+dispatched immediately — the latency budget is spent once per job, not
+once per pop. Both bounds are hard: latency is traded for throughput
+only inside the declared window, never unboundedly. A group runs as ONE
+stacked device program when eligible (``serve/executor.py:
+execute_fused_batch``) and back to back on warm jit caches otherwise;
+either way every job keeps its individual result/manifest
+(byte-identical to serial execution — CI-asserted), so batching is a
+scheduling decision, not a semantics change.
+
+**Cost-ordered scheduling** (``ordering="cost"``, the default): within
+each class lane the queue serves the job with the smallest calibrated
+cost estimate first (shortest-job-first — the admission-time
+``CostPrediction`` stamped on ``Job.cost_estimate_seconds``), jobs
+carrying a deadline sort ahead by slack (deadline minus now minus
+estimate — the job closest to missing its promise runs first), and a
+job queued longer than ``age_cap_seconds`` jumps to the front of its
+lane outright, so SJF can never starve an expensive job behind an
+endless stream of cheap ones. Ties break FIFO on the admission sequence
+number, so ordering is deterministic: the same queue state always pops
+the same job. ``ordering="fifo"`` keeps the historical arrival order
+(the bench harness's control arm).
 
 Both classes are bounded; an admission past capacity raises
 :class:`QueueFull`, which the HTTP layer surfaces as 429 backpressure
@@ -67,6 +85,13 @@ DEFAULT_LARGE_CAPACITY = 4
 #: for larger groups under bursty traffic).
 DEFAULT_BATCH_MAX_JOBS = 8
 DEFAULT_BATCH_LINGER_SECONDS = 0.0
+
+#: Starvation guard for cost-ordered lanes: a job queued at least this
+#: long outranks every estimate-ordered peer in its lane (FIFO among the
+#: aged), so shortest-job-first degrades gracefully to FIFO under
+#: sustained cheap-job pressure instead of parking expensive jobs
+#: forever. ``--serve-age-cap-seconds`` overrides.
+DEFAULT_AGE_CAP_SECONDS = 30.0
 
 
 class QueueFull(Exception):
@@ -136,6 +161,26 @@ class Job:
     #: accepted record, compared against the measured wall clock at the
     #: terminal (the calibration ledger's input pair).
     cost_prediction: Optional[object] = None
+    #: The prediction's calibrated best-estimate seconds, copied out by
+    #: the daemon at admission so the queue can ORDER on it without
+    #: reaching into the opaque prediction object (this module stays
+    #: obs-free). ``None`` sorts last within its tier.
+    cost_estimate_seconds: Optional[float] = None
+    #: Monotonic clock at FIRST admission, stamped by :meth:`put` and
+    #: preserved across requeues/steals within a process: the linger
+    #: anchor (a group member's latency budget starts when it queued,
+    #: not when a worker popped) and the age-cap starvation guard both
+    #: read it.
+    enqueued_monotonic: Optional[float] = None
+    #: Process-wide admission sequence number (stamped with
+    #: ``enqueued_monotonic``): the deterministic FIFO tiebreak of the
+    #: cost ordering — equal keys pop in admission order, always.
+    enqueue_seq: int = -1
+    #: How many jobs shared this job's FUSED device program (1 = ran as
+    #: its own program, even inside a back-to-back group). Distinct from
+    #: ``batch_size`` (the dispatch-group size): a group can be popped
+    #: together yet fall back to serial execution.
+    fused_size: int = 1
     #: When a worker dequeued the job (the queue-wait measurement's end;
     #: ``submitted_unix`` is its start). Distinct from ``started_unix``
     #: so batched jobs that ride a group but execute back-to-back keep
@@ -176,22 +221,37 @@ def classify_conf(conf, small_site_limit: int = SMALL_JOB_MAX_SITES) -> str:
 
 
 class BoundedJobQueue:
-    """Two bounded FIFO lanes + one condition variable. ``pop`` always
+    """Two bounded class lanes + one condition variable. ``pop`` always
     serves the small lane first (the batching contract); within a lane,
-    admission order is preserved."""
+    ``ordering="cost"`` (default) serves by calibrated estimate —
+    deadline slack first, then shortest-job-first, age-capped, FIFO
+    tiebreak — and ``ordering="fifo"`` preserves admission order."""
 
     def __init__(
         self,
         small_capacity: int = DEFAULT_SMALL_CAPACITY,
         large_capacity: int = DEFAULT_LARGE_CAPACITY,
+        ordering: str = "cost",
+        age_cap_seconds: float = DEFAULT_AGE_CAP_SECONDS,
     ):
         if small_capacity < 1 or large_capacity < 1:
             raise ValueError(
                 f"queue capacities must be >= 1, got small={small_capacity} "
                 f"large={large_capacity}"
             )
+        if ordering not in ("cost", "fifo"):
+            raise ValueError(
+                f"queue ordering must be 'cost' or 'fifo', got {ordering!r}"
+            )
+        if age_cap_seconds <= 0:
+            raise ValueError(
+                f"age cap must be > 0 seconds, got {age_cap_seconds}"
+            )
+        self.ordering = ordering
+        self.age_cap_seconds = float(age_cap_seconds)
         self.small_capacity = int(small_capacity)
         self.large_capacity = int(large_capacity)
+        self._enqueue_seq = 0
         # lock order: queue lock is a leaf — nothing else is acquired
         # while holding it (machine-checked by `graftcheck lockgraph`).
         self._lock = threading.Lock()
@@ -241,6 +301,16 @@ class BoundedJobQueue:
                 )
                 if enforce_capacity and len(lane) >= capacity:
                     raise QueueFull(job.job_class, capacity)
+                # First-admission stamps only: a requeued (crashed-worker)
+                # or stolen job keeps its original linger anchor, age
+                # clock, and FIFO position — its latency budget was spent
+                # from the moment the CLIENT's job first queued, and the
+                # tiebreak must not reward a requeue with a newer slot.
+                if job.enqueued_monotonic is None:
+                    job.enqueued_monotonic = time.monotonic()
+                if job.enqueue_seq < 0:
+                    job.enqueue_seq = self._enqueue_seq
+                    self._enqueue_seq += 1
                 lane.append(job)
                 # notify_all, not notify: per-slice workers wait for
                 # DIFFERENT classes on this one condition, and waking only
@@ -310,15 +380,56 @@ class BoundedJobQueue:
             raise ValueError(f"no known job class in {classes!r}")
         return lanes
 
+    def _priority_key(self, job: Job, now_mono: float, now_unix: float):
+        """The cost ordering's total order within one lane. Three tiers:
+
+        - **0 — aged**: queued at least ``age_cap_seconds`` — FIFO among
+          themselves (the starvation guard: an expensive job cannot wait
+          forever behind a stream of cheap arrivals);
+        - **1 — deadline**: sorted by slack (``deadline - now -
+          estimate``): the job closest to breaking its promise first;
+        - **2 — everything else**: shortest calibrated estimate first
+          (``None`` — no prediction stamped — sorts last).
+
+        Every tier tiebreaks on the admission sequence number, so equal
+        keys pop in admission order — the ordering is a deterministic
+        function of queue state, test- and CI-assertable."""
+        seq = job.enqueue_seq
+        queued_for = (
+            now_mono - job.enqueued_monotonic
+            if job.enqueued_monotonic is not None
+            else 0.0
+        )
+        if queued_for >= self.age_cap_seconds:
+            return (0, float(seq), seq)
+        estimate = job.cost_estimate_seconds
+        if job.deadline_unix is not None:
+            slack = job.deadline_unix - now_unix - (estimate or 0.0)
+            return (1, slack, seq)
+        return (2, estimate if estimate is not None else float("inf"), seq)
+
+    def _take_locked(self, lane: Deque[Job]) -> Job:
+        """Remove and return the next job of one (non-empty) lane under
+        the configured ordering. Caller holds the queue lock."""
+        if self.ordering == "fifo":
+            return lane.popleft()
+        now_mono, now_unix = time.monotonic(), time.time()
+        best = min(
+            lane, key=lambda job: self._priority_key(job, now_mono, now_unix)
+        )
+        lane.remove(best)
+        return best
+
     def pop(
         self,
         timeout: Optional[float] = None,
         classes: Optional[Sequence[str]] = None,
     ) -> Optional[Job]:
         """Next job for a worker serving ``classes`` (``None`` = both) —
-        every queued small job ahead of any large one. Returns ``None``
-        on timeout or when the queue is closed and empty of those classes
-        (check :meth:`drained_for` to distinguish)."""
+        every queued small job ahead of any large one; within the lane,
+        the configured ordering picks (see :meth:`_priority_key`).
+        Returns ``None`` on timeout or when the queue is closed and empty
+        of those classes (check :meth:`drained_for` to distinguish)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._nonempty:
             lanes = self._lanes(classes)
@@ -333,7 +444,7 @@ class BoundedJobQueue:
                 self._nonempty.wait(remaining)
             for lane in lanes:
                 if lane:
-                    return lane.popleft()
+                    return self._take_locked(lane)
             return None  # unreachable; keeps the type checker honest
 
     def pop_batch(
@@ -348,7 +459,15 @@ class BoundedJobQueue:
         ``max_batch`` jobs, lingering up to ``linger_seconds`` for more
         compatible arrivals when the group is not yet full. Large jobs
         never batch (group of one). Non-matching small jobs keep their
-        queue order untouched. Returns ``[]`` on timeout/closed-empty."""
+        queue order untouched. Returns ``[]`` on timeout/closed-empty.
+
+        The linger clock anchors at the FIRST group member's enqueue
+        time: a head job that already sat in the queue for the whole
+        window (or a group already full at pop time) dispatches with ZERO
+        added wait — the worker never re-spends a latency budget the job
+        already paid queuing. ``pop_batch`` therefore never returns later
+        than ``first-member-enqueue + linger_seconds`` (plus lock
+        wakeups), regardless of when the worker called it."""
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         first = self.pop(timeout=timeout, classes=classes)
@@ -361,7 +480,12 @@ class BoundedJobQueue:
         ):
             return [first]
         batch = [first]
-        linger_deadline = time.monotonic() + max(0.0, float(linger_seconds))
+        anchor = (
+            first.enqueued_monotonic
+            if first.enqueued_monotonic is not None
+            else time.monotonic()
+        )
+        linger_deadline = anchor + max(0.0, float(linger_seconds))
         with self._nonempty:
             while len(batch) < max_batch:
                 matched = [
@@ -438,6 +562,7 @@ __all__ = [
     "DEFAULT_LARGE_CAPACITY",
     "DEFAULT_BATCH_MAX_JOBS",
     "DEFAULT_BATCH_LINGER_SECONDS",
+    "DEFAULT_AGE_CAP_SECONDS",
     "QueueFull",
     "QueueClosed",
     "Job",

@@ -96,6 +96,12 @@ _NON_GEOMETRY_FIELDS = frozenset(
         "grm_out",
         "ld_out",
         "assoc_out",
+        # The plan validator's stacked-group knob (`graftcheck plan
+        # --fused-jobs K`): it sizes the ADMISSION question, not the
+        # per-job program — a job's compile geometry is the same whether
+        # it later rides a fused group or runs serially (the group's own
+        # geometry is keyed by fused_group_fingerprint).
+        "fused_jobs",
     }
 )
 
@@ -156,6 +162,17 @@ def batch_compile_fingerprint(conf, kind: str = "pca") -> str:
     return _fingerprint_doc(
         conf, kind, _NON_GEOMETRY_FIELDS | _REGION_FIELDS
     )
+
+
+def fused_group_fingerprint(batch_fingerprint: str, num_jobs: int) -> str:
+    """The fused batch group's OWN compile geometry: a K-lane stacked
+    program (``ops/batched.py``) traces ``(K, N, N)`` shapes no serial
+    member ever compiles, so warm-vs-cold attribution for fused dispatch
+    is keyed by (shared batch fingerprint, jobs-axis size) — a repeat
+    group of the same shape and size rides warm stacked kernels, a new K
+    is honestly a miss even when every member geometry is warm."""
+    blob = f"fused:{batch_fingerprint}:{int(num_jobs)}"
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
 
 
 def geometry_seen(key: str) -> bool:
@@ -258,6 +275,7 @@ __all__ = [
     "enable_persistent_compile_cache",
     "compile_fingerprint",
     "batch_compile_fingerprint",
+    "fused_group_fingerprint",
     "geometry_seen",
     "record_geometry",
     "attach_geometry_ledger",

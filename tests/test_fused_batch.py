@@ -1,0 +1,441 @@
+"""Fused batch execution (PR 19): the stacked-jobs kernel's byte parity
+with serial accumulation (group sizes 1/2/cap, ragged lanes, interleaved
+feeds), ``preflight_fused``'s refusal matrix, the cost-ordered queue
+(deterministic SJF pops, deadline slack, the age-cap starvation guard,
+the linger anchor), steal-targeting-by-cost, and the daemon end-to-end:
+one device program per group, byte-identical results, fused-vs-serial
+dispatch counters, and the over-HBM fused group's structured 413."""
+
+import time
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.ops.batched import (
+    FusedIneligible,
+    StackedJobsAccumulator,
+    max_fused_jobs,
+)
+from spark_examples_tpu.serve.daemon import (
+    MEM_LIMIT_CODES,
+    PcaService,
+    _parse_job_flags,
+)
+from spark_examples_tpu.serve.protocol import parse_request, request_doc
+from spark_examples_tpu.serve.queue import (
+    SMALL_CLASS,
+    BoundedJobQueue,
+    Job,
+)
+
+TINY_FLAGS = ["--num-samples", "8", "--references", "1:0:50000"]
+
+
+# ------------------------------------------------- stacked kernel parity
+
+
+def _serial_gramian(rows_per_lane, num_samples, block_size):
+    """Each lane through its own serial dense accumulator — the byte
+    reference the stacked program must reproduce exactly."""
+    import jax
+
+    from spark_examples_tpu.ops.gramian import GramianAccumulator
+
+    slices = []
+    for rows in rows_per_lane:
+        acc = GramianAccumulator(
+            num_samples=num_samples, mesh=None, block_size=block_size
+        )
+        if len(rows):
+            acc.add_rows(rows)
+        slices.append(np.asarray(jax.device_get(acc.finalize_device())))
+    return slices
+
+
+def _lane_rows(num_lanes, num_samples, seed=11):
+    """Ragged {0,1} row streams: lengths straddle block boundaries so
+    every lane exercises a zero-padded partial tail, and lane lengths
+    differ so the stacked drain pads finished lanes with zero operands."""
+    rng = np.random.default_rng(seed)
+    lengths = [3 + 4 * lane + lane % 2 for lane in range(num_lanes)]
+    return [
+        rng.integers(0, 2, size=(n, num_samples)).astype(np.uint8)
+        for n in lengths
+    ]
+
+
+def _cap_device_bytes(num_samples, cap):
+    """A synthetic device budget whose ``max_fused_jobs`` is exactly
+    ``cap`` — the parity matrix's "max" row is tied to the real cap
+    formula instead of a hand-picked constant."""
+    from spark_examples_tpu.ops.gramian import _DENSE_BUFFERS, DENSE_HBM_FRACTION
+
+    per_job = _DENSE_BUFFERS * num_samples**2 * 4
+    return int(cap * per_job / DENSE_HBM_FRACTION) + 1
+
+
+@pytest.mark.parametrize("group", ["one", "two", "max"])
+def test_stacked_parity_matrix(group):
+    """Group sizes 1, 2, and the HBM cap: every lane's slice of the
+    stacked ``(K, N, N)`` accumulator is byte-identical to its serial
+    run, with a small block size forcing ragged multi-step drains."""
+    import jax
+
+    num_samples, block_size = 16, 4
+    if group == "max":
+        device_bytes = _cap_device_bytes(num_samples, 5)
+        k = max_fused_jobs(num_samples, device_bytes=device_bytes)
+        assert k == 5
+    else:
+        k = {"one": 1, "two": 2}[group]
+    rows_per_lane = _lane_rows(k, num_samples)
+    stacked = StackedJobsAccumulator(
+        num_jobs=k, num_samples=num_samples, block_size=block_size
+    )
+    # Interleave feeds in uneven chunks: lanes hit block boundaries at
+    # different steps, so the lockstep drain queues pending operands.
+    cursors = [0] * k
+    chunk = 3
+    while any(cursors[i] < len(rows_per_lane[i]) for i in range(k)):
+        for lane in range(k):
+            rows = rows_per_lane[lane]
+            if cursors[lane] < len(rows):
+                stacked.add_rows(
+                    lane, rows[cursors[lane] : cursors[lane] + chunk]
+                )
+                cursors[lane] += chunk
+    for lane in range(k):
+        stacked.finish_lane(lane)
+    stacked.finalize()
+    serial = _serial_gramian(rows_per_lane, num_samples, block_size)
+    for lane in range(k):
+        fused = np.asarray(jax.device_get(stacked.job_slice(lane)))
+        assert fused.dtype == serial[lane].dtype
+        assert fused.tobytes() == serial[lane].tobytes(), (
+            f"lane {lane} of {k} diverged from its serial run"
+        )
+    # Lockstep accounting: the stacked program stepped once per LONGEST
+    # lane's block count, not once per lane-block.
+    longest_blocks = max(
+        -(-len(rows) // block_size) for rows in rows_per_lane
+    )
+    assert stacked.steps == longest_blocks
+
+
+def test_stacked_ragged_last_group_with_empty_lane():
+    """The ragged extreme: one lane contributes nothing at all (its
+    slice is the zero matrix, same as a serial run over zero rows) while
+    the others drain multi-block streams over its zero-operand pads."""
+    import jax
+
+    num_samples, block_size = 16, 4
+    rows_per_lane = [
+        np.zeros((0, num_samples), dtype=np.uint8),
+        _lane_rows(1, num_samples, seed=3)[0][:5],
+        _lane_rows(1, num_samples, seed=5)[0][:3] .repeat(4, axis=0)[:11],
+    ]
+    stacked = StackedJobsAccumulator(
+        num_jobs=3, num_samples=num_samples, block_size=block_size
+    )
+    stacked.finish_lane(0)  # empty lane finishes before any feed
+    stacked.add_rows(1, rows_per_lane[1])
+    stacked.add_rows(2, rows_per_lane[2])
+    stacked.finish_lane(1)
+    stacked.finish_lane(2)
+    stacked.finalize()
+    serial = _serial_gramian(rows_per_lane, num_samples, block_size)
+    for lane in range(3):
+        fused = np.asarray(jax.device_get(stacked.job_slice(lane)))
+        assert fused.tobytes() == serial[lane].tobytes()
+    assert not np.asarray(jax.device_get(stacked.job_slice(0))).any()
+
+
+def test_stacked_refuses_count_valued_rows():
+    """The stacked contract covers {0,1} has-variation rows only; a
+    count-valued block (same-set join) must refuse, not approximate."""
+    stacked = StackedJobsAccumulator(num_jobs=2, num_samples=16, block_size=4)
+    counts = np.full((4, 16), 2, dtype=np.uint8)
+    with pytest.raises(FusedIneligible, match="count-valued"):
+        stacked.add_rows(0, counts)
+
+
+# ------------------------------------------------------ preflight refusals
+
+
+def _conf(flags, kind="pca"):
+    return _parse_job_flags(["--pca-backend", "tpu", *flags], kind=kind)
+
+
+def test_preflight_refuses_mixed_kind_group():
+    from spark_examples_tpu.pipeline.fused import preflight_fused
+
+    confs = [_conf(TINY_FLAGS), _conf(TINY_FLAGS)]
+    with pytest.raises(FusedIneligible, match="mixed-kind"):
+        preflight_fused(confs, ["pca", "similarity"])
+    with pytest.raises(FusedIneligible, match="no stacked device program"):
+        preflight_fused(confs, ["grm", "grm"])
+
+
+def test_preflight_refuses_mismatched_geometry():
+    from spark_examples_tpu.pipeline.fused import preflight_fused
+
+    narrow = _conf(TINY_FLAGS)
+    wide = _conf(["--num-samples", "16", "--references", "1:0:50000"])
+    with pytest.raises(FusedIneligible, match="cohort width"):
+        preflight_fused([narrow, wide], ["pca", "pca"])
+
+
+def test_preflight_accepts_then_caps_group_size():
+    """An eligible pair passes (returns K); the same pair against a toy
+    device budget whose cap is 1 refuses with the cap named."""
+    from spark_examples_tpu.pipeline.fused import preflight_fused
+
+    confs = [_conf(TINY_FLAGS), _conf(TINY_FLAGS)]
+    assert preflight_fused(confs, ["pca", "pca"]) == 2
+    tiny_budget = _cap_device_bytes(8, 1)
+    with pytest.raises(FusedIneligible, match="max_fused_jobs=1"):
+        preflight_fused(confs, ["pca", "pca"], device_bytes=tiny_budget)
+
+
+# ------------------------------------------------------ cost-ordered queue
+
+
+def _qjob(job_id, estimate=None, deadline_unix=None, queued_ago=None):
+    job = Job(
+        id=job_id,
+        request=parse_request(request_doc(TINY_FLAGS)),
+        conf=None,
+        job_class=SMALL_CLASS,
+        submitted_unix=time.time(),
+        deadline_unix=deadline_unix,
+        cost_estimate_seconds=estimate,
+    )
+    if queued_ago is not None:
+        # Backdate the first-admission stamp (put() only stamps None):
+        # age-dependent behavior tests stay sleep-free and deterministic.
+        job.enqueued_monotonic = time.monotonic() - queued_ago
+    return job
+
+
+def test_cost_ordered_pop_is_deterministic():
+    """SJF within the lane: cheapest estimate first, missing estimates
+    last, equal keys in admission order — twice, identically."""
+    for _ in range(2):
+        q = BoundedJobQueue(ordering="cost")
+        q.put(_qjob("slow", estimate=40.0))
+        q.put(_qjob("none-1"))  # no prediction stamped -> sorts last
+        q.put(_qjob("fast", estimate=0.2))
+        q.put(_qjob("mid-1", estimate=5.0))
+        q.put(_qjob("mid-2", estimate=5.0))  # tie -> admission order
+        q.put(_qjob("none-2"))
+        order = [q.pop(timeout=1).id for _ in range(6)]
+        assert order == ["fast", "mid-1", "mid-2", "slow", "none-1", "none-2"]
+
+
+def test_fifo_ordering_preserves_admission_order():
+    q = BoundedJobQueue(ordering="fifo")
+    q.put(_qjob("first", estimate=40.0))
+    q.put(_qjob("second", estimate=0.1))
+    assert [q.pop(timeout=1).id for _ in range(2)] == ["first", "second"]
+
+
+def test_age_cap_starvation_guard():
+    """A job queued past the age cap outranks every estimate-ordered
+    peer — FIFO among the aged — so SJF cannot park an expensive job
+    behind an endless stream of cheap arrivals."""
+    q = BoundedJobQueue(ordering="cost", age_cap_seconds=5.0)
+    q.put(_qjob("aged-expensive", estimate=100.0, queued_ago=6.0))
+    q.put(_qjob("aged-older", estimate=50.0, queued_ago=8.0))
+    q.put(_qjob("fresh-cheap", estimate=0.1))
+    order = [q.pop(timeout=1).id for _ in range(3)]
+    # Both aged jobs first, in their own admission order (enqueue_seq:
+    # aged-expensive was admitted first), then the cost-ordered rest.
+    assert order == ["aged-expensive", "aged-older", "fresh-cheap"]
+
+
+def test_deadline_slack_orders_ahead_of_estimates():
+    """Deadline-carrying jobs sort by slack (deadline - now - estimate)
+    ahead of the estimate tier: the job closest to breaking its promise
+    runs first."""
+    now = time.time()
+    q = BoundedJobQueue(ordering="cost")
+    q.put(_qjob("cheap", estimate=0.1))
+    q.put(_qjob("roomy-deadline", estimate=1.0, deadline_unix=now + 500))
+    q.put(_qjob("tight-deadline", estimate=1.0, deadline_unix=now + 50))
+    order = [q.pop(timeout=1).id for _ in range(3)]
+    assert order == ["tight-deadline", "roomy-deadline", "cheap"]
+
+
+def test_pop_batch_linger_anchor_already_spent():
+    """Satellite regression: the linger clock anchors at the FIRST
+    member's enqueue time. A head job that already waited out the window
+    in the queue dispatches with zero added wait, regardless of the
+    linger the pop call declares."""
+    q = BoundedJobQueue()
+    stale = _qjob("stale", queued_ago=10.0)
+    stale.batch_key = "shared"
+    q.put(stale)
+    t0 = time.monotonic()
+    batch = q.pop_batch(timeout=1, linger_seconds=5.0, max_batch=4)
+    waited = time.monotonic() - t0
+    assert [job.id for job in batch] == ["stale"]
+    assert waited < 1.0, f"pop re-spent the linger budget: {waited:.2f}s"
+    # Control arm: a FRESH head job does linger (bounded by the window).
+    fresh = _qjob("fresh")
+    fresh.batch_key = "shared"
+    q.put(fresh)
+    t0 = time.monotonic()
+    batch = q.pop_batch(timeout=1, linger_seconds=0.2, max_batch=4)
+    waited = time.monotonic() - t0
+    assert [job.id for job in batch] == ["fresh"]
+    assert waited >= 0.15, f"fresh head did not linger: {waited:.3f}s"
+
+
+# -------------------------------------------------------- steal by cost
+
+
+def test_steal_claims_highest_cost_first(tmp_path, monkeypatch):
+    """A survivor replica's steal scan claims a dead owner's orphans in
+    descending journaled-estimate order (cost unknown last, file order
+    among ties): the first, least-contended claims recover the most
+    stranded seconds."""
+    from spark_examples_tpu.serve.journal import (
+        JobJournal,
+        LeaseStore,
+        journal_path,
+    )
+
+    run_dir = str(tmp_path / "rd")
+    claimed = []
+    monkeypatch.setattr(
+        PcaService, "_steal_one", lambda self, record: claimed.append(
+            record.job_id
+        )
+    )
+    survivor = PcaService(
+        run_dir=run_dir,
+        replica_id="b",
+        small_slices=0,
+        lease_seconds=1.0,
+        lease_grace_seconds=0.1,
+        steal_interval_seconds=3600.0,  # scan only when the test calls it
+        persistent_cache=False,
+    ).start()
+    try:
+        # Replica "a" dies AFTER the survivor is up (planting the state
+        # first would let the survivor's startup replay adopt it): a
+        # stale heartbeat plus three accepted jobs whose leases expire
+        # immediately, with distinct journaled estimates.
+        LeaseStore(
+            run_dir, "a", lease_seconds=1.0, clock=lambda: time.time() - 60.0
+        ).heartbeat()
+        journal = JobJournal(journal_path(run_dir), replica="a")
+        stale_store = LeaseStore(
+            run_dir, "a", lease_seconds=0.01, grace_seconds=0.0
+        )
+        for job_id, cost in (
+            ("job-a-000001", {"predicted_seconds": 2.0}),
+            ("job-a-000002", None),  # pre-cost journal record
+            ("job-a-000003", {"predicted_seconds": 90.0}),
+        ):
+            journal.accepted(
+                job_id,
+                request_doc(TINY_FLAGS),
+                SMALL_CLASS,
+                time.time(),
+                None,
+                cost=cost,
+            )
+            epoch = stale_store.claim(job_id)
+            journal.lease(job_id, epoch)
+        journal.close()
+        # Leases (ttl 10 ms) must be expired PAST the survivor's grace
+        # window (0.1 s) before the scan may treat them as orphaned.
+        time.sleep(0.25)
+        survivor._steal_expired()
+    finally:
+        survivor.stop(timeout=30)
+    assert claimed == ["job-a-000003", "job-a-000001", "job-a-000002"]
+
+
+# ----------------------------------------------------------- daemon e2e
+
+
+def test_service_fused_group_byte_identical_and_counted(tmp_path):
+    """Two identical small jobs inside the linger window ride ONE
+    stacked device program (fused_size 2 on both envelopes); a singleton
+    resubmit runs serially; all three emit byte-identical result rows;
+    the dispatch counters partition fused vs serial."""
+    service = PcaService(
+        run_dir=str(tmp_path / "serve"),
+        small_slices=0,
+        batch_max_jobs=2,
+        batch_linger_seconds=2.0,
+    ).start()
+    try:
+        ids = []
+        for _ in range(2):
+            status, body = service.submit(request_doc(TINY_FLAGS))
+            assert status == 202, body
+            ids.append(body["job"]["id"])
+        fused = [_wait_done(service, jid) for jid in ids]
+        status, body = service.submit(request_doc(TINY_FLAGS))
+        assert status == 202, body
+        serial = _wait_done(service, body["job"]["id"])
+        dispatch = service.fleet_stats()["dispatch"]
+    finally:
+        service.stop(timeout=60)
+    for job in fused:
+        assert job["fused_size"] == 2, job
+    assert serial["fused_size"] == 1
+    reference = serial["result"]["pc_lines"]
+    for job in fused:
+        assert job["result"]["pc_lines"] == reference
+    assert dispatch["fused_groups"] == 1
+    assert dispatch["fused_jobs"] == 2
+    assert dispatch["serial_jobs"] == 1
+
+
+def _wait_done(service, job_id, timeout=300.0):
+    deadline = time.time() + timeout
+    while True:
+        _, doc = service.job_status(job_id)
+        job = doc["job"]
+        if job["status"] in ("done", "failed", "cancelled"):
+            assert job["status"] == "done", job
+            return job
+        assert time.time() < deadline, f"timed out waiting on {job_id}"
+        time.sleep(0.02)
+
+
+def test_fused_over_hbm_group_is_413(tmp_path):
+    """``--fused-jobs`` rides admission as a plan directive: a group
+    whose K× stacked charge exceeds the HBM budget is a structured 413
+    naming the cohort's fused ceiling, and the code is a declared
+    memory-limit code (the 400-vs-413 contract)."""
+    assert "fused-group-exceeds-hbm" in MEM_LIMIT_CODES
+    service = PcaService(run_dir=str(tmp_path / "serve"), small_slices=0)
+    try:
+        status, body = service.submit(
+            request_doc(
+                [
+                    "--num-samples",
+                    "20000",
+                    "--references",
+                    "1:0:50000",
+                    "--pca-backend",
+                    "tpu",
+                    "--fused-jobs",
+                    "12",
+                ]
+            )
+        )
+    finally:
+        service.stop(timeout=30)
+    assert status == 413
+    assert body["error"]["code"] == "plan-rejected"
+    codes = [i["code"] for i in body["plan"]["issues"]]
+    assert "fused-group-exceeds-hbm" in codes
+    geometry = body["plan"]["geometry"]
+    assert geometry["fused_jobs"] == 12
+    assert 1 <= geometry["max_fused_jobs"] < 12

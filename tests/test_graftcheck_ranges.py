@@ -170,9 +170,9 @@ def test_interpreter_unpack_tightens_packed_bytes():
 def test_shipped_matrix_proves_clean():
     report = run_audit()
     assert report.ok, "\n".join(f.format() for f in report.findings)
-    # 2x(dense+counts) + 3 meshes x (2 pack x 2 dtype + 1 counts-ring
-    # + 1 devicegen-ring)
-    assert len(report.audits) == 22
+    # 2x(dense+counts) + 2 stacked fused-group sizes + 3 meshes x
+    # (2 pack x 2 dtype + 1 counts-ring + 1 devicegen-ring)
+    assert len(report.audits) == 24
     for audit in report.audits:
         assert audit.facts["entry_increment"] is not None
         assert (
