@@ -145,6 +145,40 @@ lint_rc=0
 env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck lint spark_examples_tpu || lint_rc=$?
 env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck typecheck || lint_rc=$?
 
+echo "== proto stage (graftcheck proto: replica-protocol model checking) =="
+proto_rc=0
+# The declared 2-replica / 2-job / 2-crash matrix, exhaustively (the
+# report echoes its bounds and explored-state count). stalls=0 here;
+# the lease expiry/steal/adoption dimension follows at jobs=1 —
+# together the two exhaustive runs reach every transition type the
+# model has (see check/proto.py:check_protocol).
+env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck proto || proto_rc=$?
+PROTO_TMP=$(mktemp -d)
+env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck proto \
+  --jobs 1 --stalls 2 --json > "$PROTO_TMP/stall.json" || proto_rc=$?
+env JAX_PLATFORMS=cpu python - "$PROTO_TMP/stall.json" <<'PYEOF' || proto_rc=$?
+import json, sys
+doc = json.load(open(sys.argv[1]))
+bounds = ", ".join(f"{k}={v}" for k, v in sorted(doc["bounds"].items()))
+if not doc["exhausted"] or doc["states"] <= 0:
+    print(f"proto stall run NOT exhaustive at [{bounds}]"); sys.exit(1)
+if doc["uncovered_windows"]:
+    print("proto stall run uncovered crash windows:",
+          doc["uncovered_windows"]); sys.exit(1)
+if not doc["ok"]:
+    print("proto stall run findings:")
+    for f in doc["findings"]:
+        print(" ", f)
+    sys.exit(1)
+print(f"proto stall run OK: {doc['states']} states explored at "
+      f"[{bounds}], 0 findings, 0 uncovered crash windows")
+PYEOF
+rm -rf "$PROTO_TMP"
+# The checker's own test suite: every planted single-decision protocol
+# bug must be caught by its matching GP rule at its witness bounds.
+env JAX_PLATFORMS=cpu python -m spark_examples_tpu graftcheck proto \
+  --mutations || proto_rc=$?
+
 echo "== ir stage (graftcheck ir + lockgraph) =="
 ir_rc=0
 IR_TMP=$(mktemp -d /tmp/graftcheck-ir.XXXXXX)
@@ -1471,6 +1505,7 @@ fi
 
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 if [ "$lint_rc" -ne 0 ]; then exit "$lint_rc"; fi
+if [ "$proto_rc" -ne 0 ]; then exit "$proto_rc"; fi
 if [ "$ir_rc" -ne 0 ]; then exit "$ir_rc"; fi
 if [ "$rg_rc" -ne 0 ]; then exit "$rg_rc"; fi
 if [ "$sched_rc" -ne 0 ]; then exit "$sched_rc"; fi

@@ -29,7 +29,7 @@ Package layout:
   center → pca), mirroring ``src/main/python/variants_pca.py:19-152``
 """
 
-__version__ = "0.11.0"
+__version__ = "0.12.0"
 
 # jax-version shims (shard_map location, jax.enable_x64) — imported first so
 # every submodule and test sees one resolved API surface. jax itself is

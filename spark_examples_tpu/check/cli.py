@@ -22,6 +22,10 @@ on them:
                   [--plan-devices N]
                   [--host-mem-budget BYTES] [--json]
                                               0 plan OK / 2 rejected
+    graftcheck proto [--replicas N] [--jobs N] [--crashes N]
+                  [--stalls N] [--max-states N] [--mutations] [--json]
+                                              0 clean (or every planted
+                                              bug caught) / 1 findings
     graftcheck sanitize [--modes m1,m2] [--strict]
                                               0 clean or skipped / 1 FAIL
     graftcheck typecheck [--strict] [--update-baseline]
@@ -358,6 +362,125 @@ def _cmd_plan(argv: Sequence[str]) -> int:
     return 0 if report.ok else 2
 
 
+def _cmd_proto(argv: Sequence[str]) -> int:
+    from spark_examples_tpu.check.proto import (
+        check_protocol,
+        run_mutation_harness,
+    )
+
+    parser = argparse.ArgumentParser(prog="graftcheck proto")
+    parser.add_argument(
+        "--replicas",
+        type=int,
+        default=None,
+        help="Replica bound for the explored state space (default 2).",
+    )
+    parser.add_argument(
+        "--jobs",
+        type=int,
+        default=None,
+        help="Job bound for the explored state space (default 2).",
+    )
+    parser.add_argument(
+        "--crashes",
+        type=int,
+        default=None,
+        help="Crash budget (process or host crashes, default 2).",
+    )
+    parser.add_argument(
+        "--stalls",
+        type=int,
+        default=None,
+        help=(
+            "Lease-clock aging budget: each stall ages one live lease "
+            "one notch on the live/lapsed/stale abstract clock "
+            "(clean-run default 0 — pair with a --jobs 1 --stalls 2 "
+            "run for the expiry/steal dimension; with --mutations, "
+            "each planted bug defaults to its own witness bounds)."
+        ),
+    )
+    parser.add_argument(
+        "--max-states",
+        type=int,
+        default=2_000_000,
+        help=(
+            "Safety cap on explored states; hitting it means the run "
+            "was NOT exhaustive and fails (default 2000000)."
+        ),
+    )
+    parser.add_argument(
+        "--mutations",
+        action="store_true",
+        help=(
+            "Run the mutation harness instead of the clean check: each "
+            "planted single-decision bug must trip its matching GP rule."
+        ),
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="Emit the machine-readable report."
+    )
+    ns = parser.parse_args(list(argv))
+    if any(
+        bound is not None and bound < floor
+        for bound, floor in (
+            (ns.replicas, 1),
+            (ns.jobs, 1),
+            (ns.crashes, 0),
+            (ns.stalls, 0),
+        )
+    ):
+        print(
+            "graftcheck proto: bounds must be >= 1 replica/job and >= 0 "
+            "crashes/stalls",
+            file=sys.stderr,
+        )
+        return 2
+    if ns.mutations:
+        import json as _json
+
+        outcomes = run_mutation_harness(
+            replicas=ns.replicas,
+            jobs=ns.jobs,
+            crashes=ns.crashes,
+            stalls=ns.stalls,
+            max_states=ns.max_states,
+        )
+        if ns.json:
+            print(_json.dumps([o.to_json() for o in outcomes], indent=2))
+        else:
+            for o in outcomes:
+                verdict = "caught" if o.caught else "MISSED"
+                bounds = ",".join(
+                    f"{k}={v}" for k, v in sorted(o.bounds.items())
+                )
+                print(
+                    f"  {verdict:6s} {o.name}: expected {o.expected}, "
+                    f"tripped {','.join(o.tripped) or '(none)'} "
+                    f"({o.states} states at [{bounds}])"
+                )
+            caught = sum(1 for o in outcomes if o.caught)
+            print(
+                f"graftcheck proto: {caught}/{len(outcomes)} planted "
+                f"bugs caught"
+            )
+        return 0 if all(o.caught for o in outcomes) else 1
+    report = check_protocol(
+        **{
+            name: value
+            for name, value in (
+                ("replicas", ns.replicas),
+                ("jobs", ns.jobs),
+                ("crashes", ns.crashes),
+                ("stalls", ns.stalls),
+            )
+            if value is not None
+        },
+        max_states=ns.max_states,
+    )
+    print(report.to_json() if ns.json else report.format())
+    return 0 if report.ok else 1
+
+
 def _cmd_sanitize(argv: Sequence[str]) -> int:
     from spark_examples_tpu.check.sanitize import DEFAULT_MODES, run_sanitize
 
@@ -403,6 +526,7 @@ _SUBCOMMANDS = {
     "lockgraph": _cmd_lockgraph,
     "hostmem": _cmd_hostmem,
     "plan": _cmd_plan,
+    "proto": _cmd_proto,
     "sanitize": _cmd_sanitize,
     "typecheck": _cmd_typecheck,
 }

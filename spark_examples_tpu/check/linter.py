@@ -88,6 +88,16 @@ _FILE_OPEN_FNS = ("open", "io.open", "gzip.open", "bz2.open", "lzma.open")
 #: abstraction), exempt from GC012 by construction.
 _STREAM_MODULE = "sources/stream.py"
 
+#: The one module allowed to construct journal protocol records (it IS
+#: the protocol: its record constructors are the shapes `graftcheck
+#: proto` proves the coordination protocol against), exempt from GC013
+#: by construction.
+_JOURNAL_MODULE = "serve/journal.py"
+
+#: GC013: the protocol event names whose dict-literal construction is
+#: reserved to serve/journal.py.
+_JOURNAL_EVENTS = ("accepted", "began", "terminal", "lease")
+
 #: numpy calls that are trace-time constants, not host compute: dtype
 #: constructors used as astype/array arguments. These run on Python
 #: scalars/metadata, never on traced values, and are pervasive legitimate
@@ -515,6 +525,33 @@ class _LintVisitor(ast.NodeVisitor):
                 )
         self.generic_visit(node)
 
+    # ------------------------------------------- GC013 (journal records)
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        """GC013: a journal protocol record built as a dict literal
+        outside serve/journal.py — matched on the shape itself (an
+        ``"event"`` key naming a protocol event), so the rule catches a
+        hand-rolled record whatever it is assigned to or passed into."""
+        if self.relpath != _JOURNAL_MODULE:
+            for key, value in zip(node.keys, node.values):
+                if (
+                    isinstance(key, ast.Constant)
+                    and key.value == "event"
+                    and isinstance(value, ast.Constant)
+                    and value.value in _JOURNAL_EVENTS
+                ):
+                    self.emit(
+                        "GC013",
+                        node,
+                        f"journal {value.value!r} record constructed as a "
+                        "dict literal outside serve/journal.py; use "
+                        f"journal.{value.value}_record(...) (or the "
+                        "JobJournal method) so the record shape stays one "
+                        "`graftcheck proto` has proven",
+                    )
+                    break
+        self.generic_visit(node)
+
     # ----------------------------------------------------------------- call
 
     def visit_Call(self, node: ast.Call) -> None:
@@ -618,6 +655,24 @@ class _LintVisitor(ast.NodeVisitor):
                 "route the read through sources/stream.py "
                 "(open_binary/iter_byte_windows) so the hostmem totality "
                 "proof covers it",
+            )
+
+        # GC013: a journal appender's private _append outside journal.py
+        # (the public record methods are the protocol surface; _append
+        # would smuggle an arbitrary record past the proven shapes).
+        if (
+            self.relpath != _JOURNAL_MODULE
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "_append"
+            and "journal" in (_dotted(node.func.value, self.alias) or "").lower()
+        ):
+            self.emit(
+                "GC013",
+                node,
+                "journal._append() called outside serve/journal.py — the "
+                "appender's private seam bypasses the record constructors "
+                "`graftcheck proto` proves the protocol against; use the "
+                "JobJournal record methods",
             )
 
         # GC011: narrowing cast without a range justification.

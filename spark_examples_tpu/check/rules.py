@@ -180,6 +180,20 @@ RULES: Dict[str, Rule] = {
             scope=("sources/*", "pipeline/*"),
         ),
         Rule(
+            "GC013",
+            "journal-record-outside-journal",
+            "A journal protocol record (a dict literal with an `event` "
+            "key naming accepted/began/terminal/lease) is constructed — "
+            "or a journal appender's `_append` is called — outside "
+            "serve/journal.py. The record constructors there are the "
+            "protocol's ONLY writers: `graftcheck proto` proves the "
+            "coordination protocol against exactly those shapes, so a "
+            "hand-rolled record elsewhere is a write the proof does not "
+            "cover. Route it through journal.accepted_record/"
+            "began_record/terminal_record/lease_record (or the JobJournal "
+            "methods).",
+        ),
+        Rule(
             "GC010",
             "host-numpy-under-jit",
             "A host `np.*` call inside a jit/shard_map-decorated kernel "
@@ -516,6 +530,76 @@ LOCK_RULES: Dict[str, Rule] = {
 }
 
 
+#: ``graftcheck proto`` rule catalogue (``check/proto.py``): invariants of
+#: the replica coordination protocol, checked by exhaustive explicit-state
+#: exploration with the SHIPPED serve/journal.py fold and lease arbitration
+#: as the transition oracle. GP findings anchor to a witness trace (a
+#: concrete crash/steal/append history), not a source line, so their
+#: ``path`` is the protocol model's name and ``line`` is 0. There is no
+#: escape hatch for a GP finding: a protocol counterexample is fixed, not
+#: justified.
+PROTO_RULES: Dict[str, Rule] = {
+    rule.id: rule
+    for rule in [
+        Rule(
+            "GP001",
+            "double-effective-terminal",
+            "One job reaches two terminal records that BOTH survive the "
+            "fold's epoch fencing (or two replicas both publish its "
+            "result): the journal's truth about the job's outcome is "
+            "ambiguous — a deposed replica's late write settled a job "
+            "its stealer also settled.",
+        ),
+        Rule(
+            "GP002",
+            "device-began-reexecution",
+            "A job whose `began` record is journaled executes device "
+            "work a second time in a later replica life: the "
+            "requeue-once boundary is violated — device state under a "
+            "crashed update cannot be trusted for a silent retry.",
+        ),
+        Rule(
+            "GP003",
+            "acked-job-lost",
+            "A job whose admission was acknowledged (202 sent after the "
+            "durable accepted record) becomes invisible: no journal "
+            "record folds it as pending, no effective terminal exists, "
+            "and no replica holds it in memory — after every crash is "
+            "recovered, nobody will ever settle it.",
+        ),
+        Rule(
+            "GP004",
+            "lease-epoch-reissued",
+            "A journaled lease record re-issues the job's highest "
+            "already-journaled lease epoch under a DIFFERENT replica "
+            "(the min-epoch claim guard failed): fold fencing cannot "
+            "order same-epoch writers, so a zombie terminal would "
+            "survive fencing. A lower-than-max straggler append is "
+            "benign — the max-fold absorbs it.",
+        ),
+        Rule(
+            "GP005",
+            "steal-of-live-owner",
+            "A replica successfully link-claims a fencing epoch over a "
+            "lease that is still live — or expired but within the grace "
+            "window — while its owner is alive: the grace asymmetry "
+            "(owners abandon at expiry, stealers wait past expiry+grace) "
+            "is violated and owner and stealer can run concurrently.",
+        ),
+        Rule(
+            "GP006",
+            "uncovered-crash-transition",
+            "The model reaches a crash transition in a protocol window "
+            "that no registered utils/faults.py KILL_POINT covers: the "
+            "chaos matrix cannot rehearse this crash, so its recovery "
+            "story is proven only in the model, never on the real "
+            "daemon. Register a kill-point for the window (and enroll "
+            "it in the chaos matrix) in the same change.",
+        ),
+    ]
+}
+
+
 #: Every rule id any graftcheck layer can emit, for Finding.rule lookup.
 ALL_RULES: Dict[str, Rule] = {
     **RULES,
@@ -524,6 +608,7 @@ ALL_RULES: Dict[str, Rule] = {
     **SCHED_RULES,
     **LOCK_RULES,
     **HOSTMEM_RULES,
+    **PROTO_RULES,
 }
 
 
@@ -620,6 +705,7 @@ __all__ = [
     "SCHED_RULES",
     "LOCK_RULES",
     "HOSTMEM_RULES",
+    "PROTO_RULES",
     "ALL_RULES",
     "HOT_PATH_GLOBS",
     "HOSTMEM_GLOBS",

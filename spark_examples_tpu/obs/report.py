@@ -256,6 +256,23 @@ def build_fleet_report(run_dir: str) -> Dict:
         statuses[job["status"] or "unsettled"] = (
             statuses.get(job["status"] or "unsettled", 0) + 1
         )
+
+    # The protocol post-mortem: the SAME protocol_summary fold
+    # `graftcheck proto` asserts GP001-GP006 over, run on this fleet's
+    # real journal — fence epochs, fenced-vs-effective terminal
+    # verdicts, steal counts. One code path for the proof and the
+    # report.
+    protocol = None
+    if have_journal:
+        from spark_examples_tpu.serve.journal import (
+            iter_journal_records,
+            protocol_summary,
+        )
+
+        protocol = protocol_summary(
+            iter_journal_records(journal_path(run_dir))
+        )
+
     return {
         "run_dir": os.path.abspath(run_dir),
         "jobs": jobs,
@@ -274,6 +291,7 @@ def build_fleet_report(run_dir: str) -> Dict:
         "classes": classes,
         "calibration": fold_calibration(ledger_path).summary(),
         "recorder": recorder,
+        "protocol": protocol,
     }
 
 
@@ -304,6 +322,35 @@ def render_fleet_report(doc: Dict) -> str:
             f"{len(recorder['replicas'])} replica(s): "
             + ", ".join(recorder["replicas"])
         )
+    protocol = doc.get("protocol")
+    if protocol:
+        proto_totals = protocol["totals"]
+        lines.append(
+            f"protocol: accepted {proto_totals['accepted']}, settled "
+            f"{proto_totals['settled']}, pending "
+            f"{proto_totals['pending']}; terminals "
+            f"{proto_totals['terminals']} "
+            f"({proto_totals['effective_terminals']} effective, "
+            f"{proto_totals['fenced_terminals']} fenced); steals "
+            f"{proto_totals['steals']}; max lease epoch "
+            f"{proto_totals['max_lease_epoch']}"
+        )
+        for job_id, info in sorted(protocol["jobs"].items()):
+            fenced = [t for t in info["terminals"] if not t["effective"]]
+            if not fenced and not info["steals"]:
+                continue
+            # Only the jobs with protocol drama get a line: a fenced
+            # terminal is a zombie write the fold absorbed, a steal is
+            # a replica takeover — both are what a post-mortem reads
+            # this block for.
+            fence_text = ", ".join(
+                f"{t['status']}@e{t['epoch']}" for t in fenced
+            )
+            lines.append(
+                f"  job {job_id}: fence e{info['fence']} "
+                f"owner {info['owner'] or '-'}; steals {info['steals']}"
+                + (f"; fenced terminals: {fence_text}" if fenced else "")
+            )
     calibration = doc.get("calibration") or {}
     if calibration.get("samples"):
         ratio = calibration.get("ratio")

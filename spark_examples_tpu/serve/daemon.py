@@ -68,10 +68,13 @@ from spark_examples_tpu.serve.journal import (
     LeaseStore,
     RunDirLock,
     acquire_run_dir_lock,
+    adoption_action,
     compact_journal,
     compact_journal_shared,
     journal_path,
     replay_journal,
+    revalidate_pending,
+    steal_candidates,
 )
 from spark_examples_tpu.serve.protocol import (
     ProtocolError,
@@ -745,7 +748,10 @@ class PcaService:
                     # must leave the job claimable by any other replica.
                     faults.kill_point("serve.steal.pre-claim")
                 epoch = self._lease_store.claim(
-                    record.job_id, steal=True, min_epoch=record.lease_epoch
+                    record.job_id,
+                    steal=True,
+                    min_epoch=record.lease_epoch,
+                    min_replica=record.lease_replica,
                 )
                 if epoch is None:
                     continue  # a live peer's job (or we lost the race)
@@ -754,6 +760,9 @@ class PcaService:
                     continue  # settled between our fold and our claim
                 record = fresh
                 stolen = foreign
+                # Registered kill-point: claimed on disk, lease record
+                # not yet journaled (same window as the submit path).
+                faults.kill_point("serve.lease.post-claim")
                 self._journal.lease(record.job_id, epoch, stolen=stolen)
                 if stolen:
                     self._jobs_stolen.inc(1)
@@ -853,10 +862,12 @@ class PcaService:
             device_began=record.device_began,
             from_replica=record.lease_replica,
         )
-        if record.device_began:
+        if adoption_action(record.device_began) == "fail":
             # The requeue-once boundary holds ACROSS replica lives: the
             # journaled began flag was written by whichever life started
-            # the device work, and no later life may silently re-run it.
+            # the device work, and no later life may silently re-run it
+            # (the policy itself is journal.adoption_action — shared
+            # with the model checker).
             with self._lock:
                 self._table[job.id] = job
                 self._fail_crashed_locked(
@@ -1177,6 +1188,11 @@ class PcaService:
             job_class=job.job_class,
             kind=job.request.kind,
         )
+        # Registered kill-point: accepted record durable, lease NOT yet
+        # claimed — the one-record orphan window. A kill here strands a
+        # journaled job with no lease file; the steal scan's orphan
+        # branch must reclaim it off the dead owner's stale heartbeat.
+        faults.kill_point("serve.submit.post-accept")
         if self._lease_store is not None:
             # Lease the job the moment it is durably accepted: from here
             # on a dead replica's work is visibly expired, stealable
@@ -1201,6 +1217,26 @@ class PcaService:
                     "resubmit",
                     retry_after_seconds=5.0,
                 )
+            # Post-claim stale-fold fence — found by `graftcheck proto`:
+            # if this replica stalled between the accepted append and
+            # the claim, a restarting peer may have adopted AND settled
+            # the job; enqueueing it now would re-run finished device
+            # work. Same revalidation the replay/steal paths use.
+            if self._journal is not None:
+                if self._revalidate_claim(job.id, epoch) is None:
+                    with self._lock:
+                        del self._table[job.id]
+                    self._rejected.labels(code="lease-unavailable").inc()
+                    return 503, error_doc(
+                        "lease-unavailable",
+                        f"lost the lease race for {job.id} (a peer "
+                        "replica adopted it between our accept and our "
+                        "claim); resubmit",
+                        retry_after_seconds=5.0,
+                    )
+            # Registered kill-point: lease file linked, its journal
+            # record not yet appended (the fold's fence lags the disk).
+            faults.kill_point("serve.lease.post-claim")
             if self._journal is not None:
                 self._journal.lease(job.id, epoch)
             self._trace_event("lease", job=job, epoch=epoch)
@@ -2328,25 +2364,16 @@ class PcaService:
             return
         pending, _max_seq = replay_journal(self._journal.path)
         alive_peers = {p["id"] for p in peers if p["alive"]}
-        candidates = []
-        for record in pending:
-            if record.job_id in expired:
-                # A dead owner's expired lease — the normal steal.
-                candidates.append(record)
-                continue
-            owner = record.accepted_record.get("replica")
-            if (
-                record.lease_epoch == 0
-                and owner != self.replica_id
-                and owner not in alive_peers
-                and store.current(record.job_id) is None
-            ):
-                # Accepted but never leased: the owner died in the
-                # one-record window between the accepted append and its
-                # lease claim (or a solo daemon's journal was adopted by
-                # replicas). Its heartbeat is stale/absent, so the job
-                # is orphaned — reclaim it like any expired lease.
-                candidates.append(record)
+        # Candidate selection (expired foreign leases + accepted-but-
+        # never-leased orphans of dead owners) is the pure
+        # journal.steal_candidates — shared with the model checker.
+        candidates = steal_candidates(
+            pending,
+            expired,
+            self.replica_id,
+            alive_peers,
+            lambda job_id: store.current(job_id) is not None,
+        )
         for record in sorted(
             enumerate(candidates),
             key=lambda pair: (-self._record_steal_cost(pair[1]), pair[0]),
@@ -2370,13 +2397,19 @@ class PcaService:
         # claimable by any other replica.
         faults.kill_point("serve.steal.pre-claim")
         epoch = store.claim(
-            record.job_id, steal=True, min_epoch=record.lease_epoch
+            record.job_id,
+            steal=True,
+            min_epoch=record.lease_epoch,
+            min_replica=record.lease_replica,
         )
         if epoch is None:
             return  # another stealer won the link race (or owner woke)
         fresh = self._revalidate_claim(record.job_id, epoch)
         if fresh is None:
             return  # settled between our fold and our claim
+        # Registered kill-point: claimed on disk, lease record not yet
+        # journaled (same window as the submit path).
+        faults.kill_point("serve.lease.post-claim")
         self._journal.lease(record.job_id, epoch, stolen=True)
         self._jobs_stolen.inc(1)
         self._trace_event(
@@ -2415,16 +2448,16 @@ class PcaService:
         lease unlink, so a re-fold AFTER a successful claim necessarily
         sees it: a settled (or higher-fenced) job abandons the claim
         before any lease record is journaled or any work adopted.
-        Returns the re-folded pending record to adopt, or ``None``."""
+        Returns the re-folded pending record to adopt, or ``None``. The
+        fence itself is the pure journal.revalidate_pending — shared
+        with the model checker."""
         assert self._journal is not None and self._lease_store is not None
         pending, _max_seq = replay_journal(self._journal.path)
-        for record in pending:
-            if record.job_id == job_id:
-                if record.lease_epoch <= epoch:
-                    # Re-folded, not the caller's snapshot: the record's
-                    # began/deadline facts are as fresh as the fence.
-                    return record
-                break
+        record = revalidate_pending(pending, job_id, epoch)
+        if record is not None:
+            # Re-folded, not the caller's snapshot: the record's
+            # began/deadline facts are as fresh as the fence.
+            return record
         self._lease_store.release(job_id)
         return None
 

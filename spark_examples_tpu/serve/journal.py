@@ -64,7 +64,16 @@ import os
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import (
+    Callable,
+    Dict,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    Set,
+    Tuple,
+)
 
 #: Journal filename under the service run directory.
 JOURNAL_BASENAME = "jobs.journal.jsonl"
@@ -120,6 +129,461 @@ class PendingJob:
     #: re-prediction under the adopter's warm state). ``None`` on
     #: journals written before the cost observatory existed.
     cost: Optional[Dict] = None
+
+
+# -------------------------------------------------------- protocol core
+#
+# Pure transition functions — the single source of truth for every
+# protocol decision. The runtime halves below (JobJournal / LeaseStore /
+# serve/daemon.py) delegate here; `graftcheck proto` (check/proto.py)
+# runs the SAME functions unchanged against an in-memory filesystem
+# model, so what the model checker proves is what the fleet ships.
+# Nothing in this section touches the filesystem or a clock: records in,
+# decisions out.
+
+
+def stamped_record(
+    record: Dict, replica: Optional[str], epoch: Optional[int]
+) -> Dict:
+    """Stamp the writing replica and its lease epoch onto a record
+    (``None`` replica = single-replica mode: records stay epoch-less and
+    the fold applies no fencing)."""
+    if replica is not None:
+        record["replica"] = replica
+    if epoch is not None:
+        record["epoch"] = int(epoch)
+    return record
+
+
+def accepted_record(
+    job_id: str,
+    request_doc: Dict,
+    job_class: str,
+    submitted_unix: float,
+    deadline_unix: Optional[float],
+    replica: Optional[str] = None,
+    trace_id: Optional[str] = None,
+    cost: Optional[Dict] = None,
+) -> Dict:
+    """The durable admission fact. The replica stamp lets the steal scan
+    attribute a job that was accepted but never leased (its owner died
+    in the one-record window between this append and the lease claim) to
+    a dead peer via the heartbeat file instead of leaving it orphaned.
+    The trace id and cost prediction ride the same record so a stolen
+    job keeps ONE span tree and ONE admission estimate across replica
+    lives (compaction rewrites accepted records verbatim, so both
+    survive every rewrite for free)."""
+    record: Dict = {
+        "event": "accepted",
+        "id": job_id,
+        "request": request_doc,
+        "job_class": job_class,
+        "submitted_unix": submitted_unix,
+        "deadline_unix": deadline_unix,
+    }
+    if trace_id is not None:
+        record["trace"] = trace_id
+    if cost is not None:
+        record["cost"] = dict(cost)
+    return stamped_record(record, replica, None)
+
+
+def began_record(
+    job_id: str,
+    replica: Optional[str] = None,
+    epoch: Optional[int] = None,
+    fused_size: Optional[int] = None,
+) -> Dict:
+    """The requeue-once boundary. ``fused_size`` (additive, >1 only for
+    stacked-group members) is stamped here rather than on the accepted
+    record: group membership is a DISPATCH fact — it does not exist at
+    admission time, and a replayed/stolen job may re-run serial."""
+    record: Dict = {"event": "began", "id": job_id}
+    if fused_size is not None and fused_size > 1:
+        record["fused_size"] = int(fused_size)
+    return stamped_record(record, replica, epoch)
+
+
+def terminal_record(
+    job_id: str,
+    status: str,
+    replica: Optional[str] = None,
+    epoch: Optional[int] = None,
+) -> Dict:
+    return stamped_record(
+        {"event": "terminal", "id": job_id, "status": status}, replica, epoch
+    )
+
+
+def lease_record(
+    job_id: str,
+    epoch: int,
+    replica: Optional[str] = None,
+    stolen: bool = False,
+) -> Dict:
+    """One successful lease claim/steal — the fold's fencing input."""
+    record = stamped_record({"event": "lease", "id": job_id}, replica, epoch)
+    if stolen:
+        record["stolen"] = True
+    return record
+
+
+def terminal_fsync(status: str) -> bool:
+    """The terminal durability policy: done/failed terminals flush
+    without fsync — it is the worker's hot path (every batched job pays
+    it), and losing one in a crash only downgrades a finished job's
+    post-restart status to the ``began``-pinned structured failure
+    (never a re-run, never a resurrection; the per-job manifest on disk
+    keeps the truth). A lost CANCELLED record would be worse — the job
+    would replay and RUN after the user cancelled it — so cancels stay
+    fsync'd, as do the admission-path tombstones ("rejected"). The model
+    checker reads this SAME predicate to decide which journal suffix a
+    crash may drop."""
+    return status not in ("done", "failed")
+
+
+class _FoldTables:
+    """The fold's intermediate per-job tables, computed in ONE pass and
+    consumed by both readers: :func:`fold_records` (the replay) and
+    :func:`protocol_summary` (the post-mortem / model-checker view).
+    Keeping one accumulator guarantees the proof and the report can
+    never disagree about what a journal means."""
+
+    def __init__(self, records: Iterable[Dict]):
+        self.pending: Dict[str, PendingJob] = {}
+        self.began: Set[str] = set()
+        #: Per job: every terminal as ``(status, epoch)`` in file order.
+        self.terminals: Dict[str, List[Tuple[Optional[str], Optional[int]]]]
+        self.terminals = {}
+        self.lease_epoch: Dict[str, int] = {}
+        self.lease_replica: Dict[str, str] = {}
+        self.steals: Dict[str, int] = {}
+        self.lease_records: Dict[str, int] = {}
+        self.max_seq = 0
+        for record in records:
+            job_id = record.get("id")
+            if not isinstance(job_id, str):
+                continue
+            if job_id.startswith("job-"):
+                # Both id grammars: solo `job-000042` and replica-stamped
+                # `job-<replica>-000042` — the sequence is the last
+                # segment.
+                try:
+                    self.max_seq = max(
+                        self.max_seq, int(job_id.rsplit("-", 1)[-1])
+                    )
+                except ValueError:
+                    pass
+            event = record["event"]
+            if event == "accepted":
+                request = record.get("request")
+                job_class = record.get("job_class")
+                if not isinstance(request, dict) or not isinstance(
+                    job_class, str
+                ):
+                    continue
+                trace = record.get("trace")
+                cost = record.get("cost")
+                self.pending[job_id] = PendingJob(
+                    job_id=job_id,
+                    request_doc=request,
+                    job_class=job_class,
+                    submitted_unix=float(
+                        record.get("submitted_unix") or 0.0
+                    ),
+                    deadline_unix=(
+                        float(record["deadline_unix"])
+                        if record.get("deadline_unix") is not None
+                        else None
+                    ),
+                    accepted_record=record,
+                    trace_id=trace if isinstance(trace, str) else None,
+                    cost=cost if isinstance(cost, dict) else None,
+                )
+            elif event == "began":
+                self.began.add(job_id)
+            elif event == "terminal":
+                epoch = record.get("epoch")
+                status = record.get("status")
+                self.terminals.setdefault(job_id, []).append(
+                    (
+                        status if isinstance(status, str) else None,
+                        int(epoch) if isinstance(epoch, int) else None,
+                    )
+                )
+            elif event == "lease":
+                epoch = record.get("epoch")
+                if not isinstance(epoch, int):
+                    continue
+                self.lease_records[job_id] = (
+                    self.lease_records.get(job_id, 0) + 1
+                )
+                if record.get("stolen"):
+                    self.steals[job_id] = self.steals.get(job_id, 0) + 1
+                if epoch > self.lease_epoch.get(job_id, 0):
+                    self.lease_epoch[job_id] = epoch
+                    replica = record.get("replica")
+                    if isinstance(replica, str):
+                        self.lease_replica[job_id] = replica
+
+    def effective(self, job_id: str, epoch: Optional[int]) -> bool:
+        """Does a terminal at ``epoch`` survive fencing? Valid iff
+        epoch-less (no fencing in play) or at/above the job's highest
+        journaled lease epoch — decided after the full read, so a
+        steal's lease record fences a terminal that landed earlier in
+        the file."""
+        fence = self.lease_epoch.get(job_id, 0)
+        return epoch is None or epoch >= fence
+
+    def settled(self) -> Set[str]:
+        return {
+            job_id
+            for job_id, terms in self.terminals.items()
+            if any(self.effective(job_id, e) for _status, e in terms)
+        }
+
+
+def fold_records(records: Iterable[Dict]) -> Tuple[List[PendingJob], int]:
+    """Fold raw journal records into ``(pending_jobs, max_seq)`` — the
+    pure core of :func:`replay_journal` (same contract; see there). The
+    model checker calls THIS directly on its in-memory journal."""
+    tables = _FoldTables(records)
+    settled = tables.settled()
+    survivors = []
+    for job in tables.pending.values():
+        if job.job_id in settled:
+            continue
+        job.device_began = job.job_id in tables.began
+        job.lease_epoch = tables.lease_epoch.get(job.job_id, 0)
+        job.lease_replica = tables.lease_replica.get(job.job_id)
+        survivors.append(job)
+    return survivors, tables.max_seq
+
+
+def protocol_summary(records: Iterable[Dict]) -> Dict:
+    """Per-run protocol facts from the SAME one-pass fold tables the
+    replay uses: per job its fence epoch, every terminal with its
+    fencing verdict, began/steal counts; plus run totals. ``obs report``
+    renders this for post-mortems and ``graftcheck proto`` asserts
+    invariants over it (GP001's "two effective terminals" is literally a
+    filter over ``jobs[*].terminals[*].effective``) — one code path for
+    the proof and the report."""
+    tables = _FoldTables(records)
+    settled = tables.settled()
+    job_ids = sorted(
+        set(tables.pending)
+        | set(tables.terminals)
+        | set(tables.lease_epoch)
+        | tables.began
+    )
+    jobs: Dict[str, Dict] = {}
+    effective_total = 0
+    fenced_total = 0
+    for job_id in job_ids:
+        terminals = [
+            {
+                "status": status,
+                "epoch": epoch,
+                "effective": tables.effective(job_id, epoch),
+            }
+            for status, epoch in tables.terminals.get(job_id, [])
+        ]
+        effective = sum(1 for t in terminals if t["effective"])
+        effective_total += effective
+        fenced_total += len(terminals) - effective
+        jobs[job_id] = {
+            "fence": tables.lease_epoch.get(job_id, 0),
+            "owner": tables.lease_replica.get(job_id),
+            "began": job_id in tables.began,
+            "settled": job_id in settled,
+            "steals": tables.steals.get(job_id, 0),
+            "leases": tables.lease_records.get(job_id, 0),
+            "terminals": terminals,
+        }
+    return {
+        "jobs": jobs,
+        "totals": {
+            "accepted": len(tables.pending),
+            "settled": len(settled),
+            "pending": len(tables.pending) - len(tables.pending.keys() & settled),
+            "began": len(tables.began),
+            "terminals": sum(len(t) for t in tables.terminals.values()),
+            "effective_terminals": effective_total,
+            "fenced_terminals": fenced_total,
+            "steals": sum(tables.steals.values()),
+            "max_lease_epoch": max(tables.lease_epoch.values(), default=0),
+        },
+    }
+
+
+def arbitrate_claim(
+    view: Optional["LeaseView"],
+    replica: str,
+    now: float,
+    grace_seconds: float,
+    steal: bool = False,
+    min_epoch: int = 0,
+    min_replica: Optional[str] = None,
+) -> Tuple[str, int]:
+    """Pure lease-claim arbitration: given the job's current on-disk
+    lease view (highest epoch, or ``None``), decide what ``replica`` may
+    do. Returns one of:
+
+    - ``("deny", 0)`` — the job is someone else's (live foreign lease,
+      or expired-past-grace without ``steal``);
+    - ``("adopt", epoch)`` — our own UNEXPIRED lease (a fast restart of
+      THIS replica id): adopt it at its epoch and renew, no new link;
+    - ``("claim", epoch)`` — link-claim this epoch: fresh job (epoch 1),
+      our own expired lease (epoch+1), or a foreign lease expired past
+      the grace window with ``steal=True`` (epoch+1; exactly one
+      concurrent stealer wins the link race).
+
+    ``min_epoch`` is the job's highest JOURNALED lease epoch as the
+    caller folded it, and ``min_replica`` the replica that journaled it:
+    a granted claim always exceeds ``min_epoch``, so a claim made from a
+    stale fold (the previous owner settled and unlinked its lease files
+    meanwhile) can never re-issue a fenced epoch. Adopting our own
+    unexpired lease keeps its epoch — but ONLY while the journaled fence
+    is consistent with it (below our epoch, or at our epoch and
+    journaled by US). An own live link at an epoch some OTHER replica
+    already journaled is the debris of a stale-fold claim that never got
+    revalidated (the claimant crashed in the post-claim window): its
+    epoch is fenced, so it is re-claimed above the fence instead of
+    adopted — found by `graftcheck proto` (GP004 witness: accepter
+    stalls across a peer's adopt-and-settle, links the settled epoch,
+    host-crash drops the terminal, restart adopts the leftover link)."""
+    if view is None:
+        epoch = 1
+    elif view.replica == replica:
+        if now <= view.expires_unix and (
+            view.epoch > int(min_epoch)
+            or (view.epoch == int(min_epoch) and min_replica == replica)
+        ):
+            return ("adopt", view.epoch)
+        epoch = view.epoch + 1
+    elif now > view.expires_unix + grace_seconds:
+        if not steal:
+            return ("deny", 0)
+        epoch = view.epoch + 1
+    else:
+        return ("deny", 0)
+    return ("claim", max(epoch, int(min_epoch) + 1))
+
+
+def owner_valid(
+    view: Optional["LeaseView"], replica: str, epoch: int, now: float
+) -> bool:
+    """The ownership fence: does ``replica`` hold the job's HIGHEST
+    epoch, unexpired, right now? Checked before every renewal, every
+    terminal write and every result publication — a deposed or expired
+    owner abandons."""
+    return (
+        view is not None
+        and view.epoch == epoch
+        and view.replica == replica
+        and now <= view.expires_unix
+    )
+
+
+def foreign_expired(
+    view: "LeaseView", replica: str, now: float, grace_seconds: float
+) -> bool:
+    """Steal-candidate predicate: the lease belongs to another replica
+    and expired past the grace window (its owner died — a healthy owner
+    renews at TTL/3 and abandons at expiry, so the asymmetric window
+    keeps an owner's last-moment publish and a stealer's claim from
+    overlapping under skewed clocks)."""
+    return (
+        view.replica != replica
+        and now > view.expires_unix + grace_seconds
+    )
+
+
+def revalidate_pending(
+    pending: List[PendingJob], job_id: str, epoch: int
+) -> Optional[PendingJob]:
+    """Post-claim fence against a STALE FOLD: between the fold a steal
+    decision was made from and the claim itself, the job's previous
+    holder may have settled it and released its lease — which is exactly
+    what would have made the claim succeed at a fresh epoch. The
+    settle's terminal write strictly precedes the lease unlink, so a
+    re-fold AFTER a successful claim necessarily sees it. Given the
+    RE-FOLDED pending set, returns the record to adopt, or ``None`` —
+    settled (absent) or fenced above our epoch — in which case the
+    caller must release the claim before any work is adopted."""
+    for record in pending:
+        if record.job_id == job_id:
+            if record.lease_epoch <= epoch:
+                return record
+            break
+    return None
+
+
+def adoption_action(device_began: bool) -> str:
+    """What adopting a replayed/stolen pending job does: ``"requeue"``
+    (re-enter the queue with the one free retry consumed) — unless the
+    journal says device work began, in which case ``"fail"`` with a
+    structured error: the requeue-once boundary holds ACROSS replica
+    lives, and device state under a crashed update cannot be trusted
+    for a silent retry."""
+    return "fail" if device_began else "requeue"
+
+
+def steal_candidates(
+    pending: List[PendingJob],
+    expired: Set[str],
+    replica: str,
+    alive_peers: Set[str],
+    lease_present: Callable[[str], bool],
+) -> List[PendingJob]:
+    """Which pending jobs may ``replica`` try to steal? The journal fold
+    (NOT the lease file) decides live-ness of the job itself: a lease
+    left behind by a settled job never appears in ``pending``. Two
+    flavors, in file order:
+
+    - ``expired`` — jobs whose highest lease is foreign and expired past
+      grace (:func:`foreign_expired`): the normal steal;
+    - orphans — accepted but never leased (``lease_epoch == 0``), whose
+      accepting replica is not us, not heartbeating, and left no lease
+      file: the owner died in the one-record window between the
+      accepted append and its lease claim (or a solo daemon's journal
+      was adopted by replicas)."""
+    candidates = []
+    for record in pending:
+        if record.job_id in expired:
+            candidates.append(record)
+            continue
+        owner = record.accepted_record.get("replica")
+        if (
+            record.lease_epoch == 0
+            and owner != replica
+            and owner not in alive_peers
+            and not lease_present(record.job_id)
+        ):
+            candidates.append(record)
+    return candidates
+
+
+def compacted_records(pending: List[PendingJob]) -> List[Dict]:
+    """The rewrite set for compaction: each still-pending job's accepted
+    record VERBATIM (trace + cost ride along), its began flag, and (when
+    the job was ever leased) ONE lease record at the highest epoch —
+    fencing must survive the rewrite or a zombie's late terminal would
+    settle a compacted job."""
+    records: List[Dict] = []
+    for job in pending:
+        records.append(job.accepted_record)
+        if job.device_began:
+            records.append(began_record(job.job_id))
+        if job.lease_epoch > 0:
+            records.append(
+                lease_record(
+                    job.job_id,
+                    job.lease_epoch,
+                    replica=job.lease_replica,
+                )
+            )
+    return records
 
 
 class JobJournal:
@@ -194,27 +658,18 @@ class JobJournal:
         trace_id: Optional[str] = None,
         cost: Optional[Dict] = None,
     ) -> None:
-        # The replica stamp lets the steal scan attribute a job that was
-        # accepted but never leased (its owner died in the one-record
-        # window between this append and the lease claim) to a dead peer
-        # via the heartbeat file instead of leaving it orphaned. The
-        # trace id and cost prediction ride the same record so a stolen
-        # job keeps ONE span tree and ONE admission estimate across
-        # replica lives (compaction rewrites accepted records verbatim,
-        # so both survive every rewrite for free).
-        record = {
-            "event": "accepted",
-            "id": job_id,
-            "request": request_doc,
-            "job_class": job_class,
-            "submitted_unix": submitted_unix,
-            "deadline_unix": deadline_unix,
-        }
-        if trace_id is not None:
-            record["trace"] = trace_id
-        if cost is not None:
-            record["cost"] = dict(cost)
-        self._append(self._stamped(record, None))
+        self._append(
+            accepted_record(
+                job_id,
+                request_doc,
+                job_class,
+                submitted_unix,
+                deadline_unix,
+                replica=self.replica,
+                trace_id=trace_id,
+                cost=cost,
+            )
+        )
 
     def began(
         self,
@@ -222,31 +677,24 @@ class JobJournal:
         epoch: Optional[int] = None,
         fused_size: Optional[int] = None,
     ) -> None:
-        # ``fused_size`` (additive, >1 only for stacked-group members) is
-        # stamped at the began record rather than the accepted record:
-        # group membership is a DISPATCH fact — it does not exist at
-        # admission time, and a replayed/stolen job may re-run serial.
-        record = {"event": "began", "id": job_id}
-        if fused_size is not None and fused_size > 1:
-            record["fused_size"] = int(fused_size)
-        self._append(self._stamped(record, epoch))
+        self._append(
+            began_record(
+                job_id,
+                replica=self.replica,
+                epoch=epoch,
+                fused_size=fused_size,
+            )
+        )
 
     def terminal(
         self, job_id: str, status: str, epoch: Optional[int] = None
     ) -> None:
-        # done/failed terminals flush without fsync — it is the worker's
-        # hot path (every batched job pays it), and losing one in a crash
-        # only downgrades a finished job's post-restart status to the
-        # `began`-pinned structured failure (never a re-run, never a
-        # resurrection; the per-job manifest on disk keeps the truth).
-        # A lost CANCELLED record would be worse — the job would replay
-        # and RUN after the user cancelled it — so cancels stay fsync'd,
-        # as do the admission-path tombstones ("rejected").
+        # Durability policy (and its rationale): :func:`terminal_fsync`.
         self._append(
-            self._stamped(
-                {"event": "terminal", "id": job_id, "status": status}, epoch
+            terminal_record(
+                job_id, status, replica=self.replica, epoch=epoch
             ),
-            fsync=status not in ("done", "failed"),
+            fsync=terminal_fsync(status),
         )
 
     def lease(
@@ -255,17 +703,11 @@ class JobJournal:
         """One successful lease claim/steal — the fold's fencing input,
         always fsync'd (a stale-epoch zombie write is only provably
         stale if the higher lease record is durable)."""
-        record = self._stamped({"event": "lease", "id": job_id}, epoch)
-        if stolen:
-            record["stolen"] = True
-        self._append(record)
-
-    def _stamped(self, record: Dict, epoch: Optional[int]) -> Dict:
-        if self.replica is not None:
-            record["replica"] = self.replica
-        if epoch is not None:
-            record["epoch"] = int(epoch)
-        return record
+        self._append(
+            lease_record(
+                job_id, epoch, replica=self.replica, stolen=stolen
+            )
+        )
 
     def close(self) -> None:
         with self._lock:
@@ -280,7 +722,7 @@ class JobJournal:
 # ---------------------------------------------------------------- replay
 
 
-def _iter_records(path: str):
+def _iter_records(path: str) -> Iterator[Dict]:
     """Yield parsed journal records; a torn/corrupt line (mid-write kill)
     is skipped — by the write protocol it can only be the LAST line a
     crashed appender produced, and its client never got the 202."""
@@ -301,7 +743,7 @@ def _iter_records(path: str):
                 yield record
 
 
-def iter_journal_records(path: str):
+def iter_journal_records(path: str) -> Iterator[Dict]:
     """Public raw-record iterator (the ``trace export`` verb correlates the
     journal's admission/lease/terminal facts with flight-recorder events;
     the fold below stays the replay semantics)."""
@@ -325,80 +767,11 @@ def replay_journal(path: str) -> Tuple[List[PendingJob], int]:
     so the job it failed to settle is settled (or re-run) by its current
     owner instead, and never double-completed. Epoch-less terminals
     (single-replica mode) always count. A ``began`` record pins the
-    no-silent-re-run policy regardless of which replica's life wrote it."""
-    pending: Dict[str, PendingJob] = {}
-    began: set = set()
-    terminals: Dict[str, List[Optional[int]]] = {}
-    lease_epoch: Dict[str, int] = {}
-    lease_replica: Dict[str, str] = {}
-    max_seq = 0
-    for record in _iter_records(path):
-        job_id = record.get("id")
-        if not isinstance(job_id, str):
-            continue
-        if job_id.startswith("job-"):
-            # Both id grammars: solo `job-000042` and replica-stamped
-            # `job-<replica>-000042` — the sequence is the last segment.
-            try:
-                max_seq = max(max_seq, int(job_id.rsplit("-", 1)[-1]))
-            except ValueError:
-                pass
-        event = record["event"]
-        if event == "accepted":
-            request = record.get("request")
-            job_class = record.get("job_class")
-            if not isinstance(request, dict) or not isinstance(
-                job_class, str
-            ):
-                continue
-            trace = record.get("trace")
-            cost = record.get("cost")
-            pending[job_id] = PendingJob(
-                job_id=job_id,
-                request_doc=request,
-                job_class=job_class,
-                submitted_unix=float(record.get("submitted_unix") or 0.0),
-                deadline_unix=(
-                    float(record["deadline_unix"])
-                    if record.get("deadline_unix") is not None
-                    else None
-                ),
-                accepted_record=record,
-                trace_id=trace if isinstance(trace, str) else None,
-                cost=cost if isinstance(cost, dict) else None,
-            )
-        elif event == "began":
-            began.add(job_id)
-        elif event == "terminal":
-            epoch = record.get("epoch")
-            terminals.setdefault(job_id, []).append(
-                int(epoch) if isinstance(epoch, int) else None
-            )
-        elif event == "lease":
-            epoch = record.get("epoch")
-            if isinstance(epoch, int) and epoch > lease_epoch.get(job_id, 0):
-                lease_epoch[job_id] = epoch
-                replica = record.get("replica")
-                if isinstance(replica, str):
-                    lease_replica[job_id] = replica
-    settled: set = set()
-    for job_id, epochs in terminals.items():
-        fence = lease_epoch.get(job_id, 0)
-        # Valid iff epoch-less (no fencing in play) or at/above the
-        # job's highest journaled lease epoch; decided after the full
-        # read so a steal's lease record fences a terminal that landed
-        # earlier in the file.
-        if any(e is None or e >= fence for e in epochs):
-            settled.add(job_id)
-    survivors = []
-    for job in pending.values():
-        if job.job_id in settled:
-            continue
-        job.device_began = job.job_id in began
-        job.lease_epoch = lease_epoch.get(job.job_id, 0)
-        job.lease_replica = lease_replica.get(job.job_id)
-        survivors.append(job)
-    return survivors, max_seq
+    no-silent-re-run policy regardless of which replica's life wrote it.
+
+    The fold itself is the pure :func:`fold_records`; this wrapper only
+    binds it to a file."""
+    return fold_records(_iter_records(path))
 
 
 # ----------------------------------------------------------- compaction
@@ -408,27 +781,12 @@ def _rewrite_journal(path: str, pending: List[PendingJob]) -> None:
     """Atomic rewrite holding only still-pending jobs' records: the
     accepted record, the began flag, and (when the job was ever leased)
     one lease record at the highest epoch — fencing must survive the
-    rewrite or a zombie's late terminal would settle a compacted job."""
+    rewrite or a zombie's late terminal would settle a compacted job.
+    The record set is the pure :func:`compacted_records`."""
     tmp = f"{path}.{os.getpid()}.tmp"
     with open(tmp, "w", encoding="utf-8") as f:
-        for job in pending:
-            f.write(json.dumps(job.accepted_record, sort_keys=True) + "\n")
-            if job.device_began:
-                f.write(
-                    json.dumps(
-                        {"event": "began", "id": job.job_id}, sort_keys=True
-                    )
-                    + "\n"
-                )
-            if job.lease_epoch > 0:
-                record: Dict = {
-                    "event": "lease",
-                    "id": job.job_id,
-                    "epoch": job.lease_epoch,
-                }
-                if job.lease_replica is not None:
-                    record["replica"] = job.lease_replica
-                f.write(json.dumps(record, sort_keys=True) + "\n")
+        for record in compacted_records(pending):
+            f.write(json.dumps(record, sort_keys=True) + "\n")
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
@@ -635,7 +993,11 @@ class LeaseStore:
     # ------------------------------------------------------------ protocol
 
     def claim(
-        self, job_id: str, steal: bool = False, min_epoch: int = 0
+        self,
+        job_id: str,
+        steal: bool = False,
+        min_epoch: int = 0,
+        min_replica: Optional[str] = None,
     ) -> Optional[int]:
         """Acquire the job's lease; returns the held epoch or ``None``.
 
@@ -650,30 +1012,35 @@ class LeaseStore:
           ``steal=True``, link-claim epoch+1 (exactly one concurrent
           stealer wins); without, ``None`` — admission never steals.
 
-        ``min_epoch`` is the job's highest JOURNALED lease epoch as the
-        caller folded it: the granted epoch always exceeds it, so a
+        ``min_epoch``/``min_replica`` are the job's highest JOURNALED
+        lease epoch and its journaling replica as the caller folded
+        them: the granted epoch always exceeds ``min_epoch``, so a
         claim made from a stale fold (the previous owner settled and
         unlinked its lease files meanwhile) can never re-issue a fenced
-        epoch. Stale-fold claims are additionally re-validated against
-        the journal by the caller (``serve/daemon.py``) before any work
-        is adopted."""
-        view = self.current(job_id)
-        if view is None:
-            epoch = 1
-        elif view.replica == self.replica:
-            if self._clock() <= view.expires_unix:
-                with self._lock:
-                    self._owned[job_id] = view.epoch
-                self.renew(job_id)
-                return view.epoch
-            epoch = view.epoch + 1
-        elif self._clock() > view.expires_unix + self.grace_seconds:
-            if not steal:
-                return None
-            epoch = view.epoch + 1
-        else:
+        epoch — and an own live link at an epoch journaled by a
+        DIFFERENT replica is re-claimed above it, not adopted (see
+        :func:`arbitrate_claim`). Stale-fold claims are additionally
+        re-validated against the journal by the caller
+        (``serve/daemon.py``) before any work is adopted.
+
+        The decision itself is the pure :func:`arbitrate_claim`; this
+        method only binds it to the on-disk view and the link file."""
+        verdict, epoch = arbitrate_claim(
+            self.current(job_id),
+            self.replica,
+            self._clock(),
+            self.grace_seconds,
+            steal=steal,
+            min_epoch=min_epoch,
+            min_replica=min_replica,
+        )
+        if verdict == "deny":
             return None
-        epoch = max(epoch, int(min_epoch) + 1)
+        if verdict == "adopt":
+            with self._lock:
+                self._owned[job_id] = epoch
+            self.renew(job_id)
+            return epoch
         if not self._try_claim_file(job_id, epoch):
             return None
         with self._lock:
@@ -686,18 +1053,15 @@ class LeaseStore:
         job — when we no longer hold it: a higher epoch exists (stolen),
         the file vanished, or our own expiry already passed (a renewal
         thread stalled past the TTL must not resurrect itself: by then a
-        stealer may legitimately be mid-claim inside the grace window)."""
+        stealer may legitimately be mid-claim inside the grace window).
+        Validity is the same :func:`owner_valid` fence the publish path
+        checks."""
         with self._lock:
             epoch = self._owned.get(job_id)
         if epoch is None:
             return False
         view = self.current(job_id)
-        if (
-            view is None
-            or view.epoch != epoch
-            or view.replica != self.replica
-            or self._clock() > view.expires_unix
-        ):
+        if not owner_valid(view, self.replica, epoch, self._clock()):
             self.forget(job_id)
             return False
         tmp = self._write_tmp(self._lease_doc(job_id, epoch))
@@ -707,17 +1071,14 @@ class LeaseStore:
     def still_owner(self, job_id: str) -> bool:
         """The pre-publish fence: do we hold the job's HIGHEST epoch,
         unexpired, right now? Checked before every terminal write and
-        result publication — a deposed or expired owner abandons."""
+        result publication — a deposed or expired owner abandons. The
+        predicate is the pure :func:`owner_valid`."""
         with self._lock:
             epoch = self._owned.get(job_id)
         if epoch is None:
             return False
-        view = self.current(job_id)
-        return (
-            view is not None
-            and view.epoch == epoch
-            and view.replica == self.replica
-            and self._clock() <= view.expires_unix
+        return owner_valid(
+            self.current(job_id), self.replica, epoch, self._clock()
         )
 
     def owned_jobs(self) -> Dict[str, int]:
@@ -748,13 +1109,13 @@ class LeaseStore:
 
     def expired_foreign(self) -> List[LeaseView]:
         """Steal candidates: every job whose HIGHEST lease belongs to
-        another replica and expired past the grace window."""
+        another replica and expired past the grace window — the pure
+        :func:`foreign_expired` over every on-disk view."""
         now = self._clock()
         return [
             view
             for view in self._scan().values()
-            if view.replica != self.replica
-            and now > view.expires_unix + self.grace_seconds
+            if foreign_expired(view, self.replica, now, self.grace_seconds)
         ]
 
     # ---------------------------------------------------------- liveness
@@ -929,10 +1290,25 @@ __all__ = [
     "PendingJob",
     "RunDirBusy",
     "RunDirLock",
+    "accepted_record",
     "acquire_run_dir_lock",
+    "adoption_action",
+    "arbitrate_claim",
+    "began_record",
     "compact_journal",
     "compact_journal_shared",
+    "compacted_records",
+    "fold_records",
+    "foreign_expired",
     "iter_journal_records",
     "journal_path",
+    "lease_record",
+    "owner_valid",
+    "protocol_summary",
     "replay_journal",
+    "revalidate_pending",
+    "stamped_record",
+    "steal_candidates",
+    "terminal_fsync",
+    "terminal_record",
 ]

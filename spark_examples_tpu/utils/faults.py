@@ -48,7 +48,7 @@ import signal
 import threading
 import time
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 #: Registered control-flow kill-points (site → where it lives). The chaos
 #: matrix (tests/test_faults.py, ci.sh faults stage) iterates the driver.*
@@ -89,6 +89,21 @@ KILL_POINTS: Dict[str, str] = {
         "identified and the fencing epoch is about to be link-claimed "
         "(a kill here must leave the job claimable by any other replica "
         "— no half-taken lease)"
+    ),
+    "serve.submit.post-accept": (
+        "serve/daemon.py:submit — the accepted record is durably "
+        "journaled, the lease NOT yet claimed (a kill here strands an "
+        "accepted-but-never-leased job: the orphan-adoption branch of "
+        "the steal scan must reclaim it via the dead owner's stale "
+        "heartbeat)"
+    ),
+    "serve.lease.post-claim": (
+        "serve/daemon.py:submit/_replay_journal/_steal_one — a lease "
+        "epoch was link-claimed on disk, its journal `lease` record NOT "
+        "yet appended (a kill here leaves an unjournaled lease file: "
+        "the fold's fence stays below the claimed epoch until a later "
+        "claimant re-journals above it, and the expired file itself "
+        "makes the job stealable)"
     ),
     "analysis.pre-manifest": (
         "analyses/base.py:finish_analysis_run — every site streamed and "
@@ -357,6 +372,22 @@ def snapshot() -> Tuple[int, Dict[str, int]]:
         return _injected, dict(_hits)
 
 
+def registered_kill_points() -> Dict[str, str]:
+    """The closed kill-point catalogue, ``{site: where it lives}`` — a
+    defensive copy. ``graftcheck proto``'s GP006 rule compares every
+    model-reachable crash transition against THIS set: a protocol state
+    the model can crash in that no registered site covers is a chaos-
+    matrix blind spot, reported as a finding."""
+    return dict(KILL_POINTS)
+
+
+def registered_io_points() -> Dict[str, str]:
+    """The closed IO-point catalogue, ``{site: where it lives}`` — a
+    defensive copy (same introspection contract as
+    :func:`registered_kill_points`)."""
+    return dict(IO_POINTS)
+
+
 __all__ = [
     "ENV_VAR",
     "KILL_POINTS",
@@ -373,5 +404,7 @@ __all__ = [
     "remove_flush_hook",
     "kill_point",
     "io_point",
+    "registered_io_points",
+    "registered_kill_points",
     "snapshot",
 ]

@@ -572,11 +572,24 @@ def test_fleet_stats_metrics_and_postmortem_report(tmp_path, capsys):
     assert report["calibration"]["samples"] == 1
     assert report["classes"]["small"]["wall_seconds"]["count"] == 1
     assert report["totals"]["journaled"] == 1
+    # The protocol block is the SAME protocol_summary fold `graftcheck
+    # proto` proves GP001-GP006 over, run on this fleet's real journal.
+    protocol = report["protocol"]
+    assert protocol["jobs"][job_id]["settled"] is True
+    assert protocol["jobs"][job_id]["began"] is True
+    terminals = protocol["jobs"][job_id]["terminals"]
+    assert any(
+        t["status"] == "done" and t["effective"] for t in terminals
+    )
+    assert protocol["totals"]["accepted"] == 1
+    assert protocol["totals"]["effective_terminals"] >= 1
+    assert protocol["totals"]["fenced_terminals"] == 0
     # Text mode renders the same facts.
     assert report_main(["report", "--run-dir", run_dir]) == 0
     text = capsys.readouterr().out
     assert "fleet report:" in text and job_id in text
     assert "predicted" in text and "queue wait" in text
+    assert "protocol: accepted 1, settled 1" in text
 
 
 def test_report_cli_exit_codes(tmp_path, capsys):

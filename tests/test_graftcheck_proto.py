@@ -1,0 +1,220 @@
+"""``graftcheck proto``: the protocol model checker's own tests.
+
+Bounds here are deliberately SMALL — the full default matrix is
+``ci.sh``'s stage. What the unit tests pin is the contract: a clean
+protocol explores to exhaustion with zero findings and full
+crash-window coverage; every planted single-decision bug is caught by
+its matching GP rule; and the CLI/report surfaces around both stay
+stable. The kill-point registry<->call-site consistency scan rides
+along (it is GP006's other half: the registry the model checks against
+must describe real code).
+"""
+
+import json
+import re
+
+from spark_examples_tpu.check.cli import main as graftcheck_main
+from spark_examples_tpu.check.proto import (
+    MUTATIONS,
+    Mutations,
+    check_protocol,
+    run_mutation_harness,
+)
+from spark_examples_tpu.utils import faults
+
+
+def test_clean_protocol_small_matrix_is_clean():
+    report = check_protocol(replicas=2, jobs=1, crashes=1, stalls=1)
+    assert report.exhausted
+    assert report.ok
+    assert report.findings == []
+    assert report.states > 100
+    assert report.transitions > report.states
+    assert report.uncovered_windows == []
+    # Every serve-phase crash window the model can reach must have been
+    # reached even at this small bound — a shrinking window set would
+    # mean the model lost transitions, not that the protocol improved.
+    assert set(report.crash_windows) == {
+        "serve.submit.post-accept",
+        "serve.lease.post-claim",
+        "serve.worker.claim",
+        "serve.worker.mid-job",
+    }
+
+
+def test_clean_protocol_two_jobs_is_clean():
+    report = check_protocol(replicas=2, jobs=2, crashes=1, stalls=0)
+    assert report.exhausted and report.ok, [
+        f.format() for f in report.findings
+    ]
+
+
+def test_report_json_shape():
+    report = check_protocol(replicas=2, jobs=1, crashes=1, stalls=0)
+    doc = json.loads(report.to_json())
+    assert doc["tool"] == "graftcheck-proto"
+    assert doc["ok"] is True and doc["exhausted"] is True
+    assert doc["bounds"] == {
+        "replicas": 2,
+        "jobs": 1,
+        "crashes": 1,
+        "stalls": 0,
+    }
+    assert doc["states"] > 0 and doc["transitions"] > 0
+    assert doc["findings"] == [] and doc["uncovered_windows"] == []
+    # The formatted report must declare its bounds (ci.sh echoes them).
+    text = report.format()
+    assert "bounds [crashes=1, jobs=1, replicas=2, stalls=0]" in text
+    assert "exhaustive" in text
+
+
+def test_max_states_cap_fails_closed():
+    report = check_protocol(replicas=2, jobs=1, crashes=2, stalls=2,
+                            max_states=50)
+    assert not report.exhausted
+    assert not report.ok  # a capped run is NOT a proof
+
+
+def test_mutation_harness_catches_every_planted_bug():
+    # Per-mutation witness bounds (each run early-stops at its first
+    # expected finding) keep this inside the tier-1 budget.
+    outcomes = run_mutation_harness()
+    assert len(outcomes) == len(MUTATIONS) >= 8
+    missed = [o.name for o in outcomes if not o.caught]
+    assert missed == [], missed
+    for outcome in outcomes:
+        assert outcome.expected in outcome.tripped
+        assert outcome.states > 0
+        assert set(outcome.bounds) == {
+            "replicas", "jobs", "crashes", "stalls",
+        }
+
+
+def test_mutation_harness_bound_override_applies_everywhere():
+    # stalls=0 removes lease expiry entirely: the graceless-steal bug
+    # CANNOT trip (no steal ever happens), and the harness must report
+    # that as a miss instead of silently restoring witness bounds.
+    outcomes = run_mutation_harness(jobs=1, stalls=0)
+    by_name = {o.name: o for o in outcomes}
+    assert not by_name["graceless-steal"].caught
+    assert by_name["graceless-steal"].bounds["stalls"] == 0
+
+
+def test_mutation_findings_carry_witness_traces():
+    mutation = next(m for m in MUTATIONS if m.name == "graceless-steal")
+    report = check_protocol(
+        replicas=2,
+        jobs=1,
+        crashes=1,
+        stalls=1,
+        mutations=mutation.mutations,
+        stop_on_rule="GP005",
+    )
+    findings = [f for f in report.findings if f.rule_id == "GP005"]
+    assert findings
+    assert "[witness:" in findings[0].detail
+
+
+def test_gp006_trips_on_unregistered_crash_window():
+    report = check_protocol(
+        replicas=2,
+        jobs=1,
+        crashes=1,
+        stalls=0,
+        mutations=Mutations(unregistered_crash_site=True),
+        stop_on_rule="GP006",
+    )
+    assert any(f.rule_id == "GP006" for f in report.findings)
+    assert report.uncovered_windows
+
+
+def test_cli_proto_clean(capsys):
+    rc = graftcheck_main(
+        ["proto", "--replicas", "2", "--jobs", "1", "--crashes", "1",
+         "--stalls", "1"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "bounds [crashes=1, jobs=1, replicas=2, stalls=1]" in out
+    assert "clean: every reachable state satisfies GP001-GP006" in out
+
+
+def test_cli_proto_json(capsys):
+    rc = graftcheck_main(
+        ["proto", "--replicas", "2", "--jobs", "1", "--crashes", "1",
+         "--stalls", "0", "--json"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["ok"] and doc["states"] > 0
+
+
+def test_cli_proto_rejects_nonsense_bounds(capsys):
+    assert graftcheck_main(["proto", "--replicas", "0"]) == 2
+    assert graftcheck_main(["proto", "--crashes", "-1"]) == 2
+
+
+def test_cli_proto_mutations_json(capsys):
+    rc = graftcheck_main(["proto", "--mutations", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    outcomes = json.loads(out)
+    assert len(outcomes) >= 8
+    assert all(o["caught"] for o in outcomes)
+    assert {o["expected"] for o in outcomes} == {
+        "GP001", "GP002", "GP003", "GP004", "GP005", "GP006",
+    }
+
+
+# --------------------------------------------------- kill-point registry
+
+
+_KILL_POINT_CALL = re.compile(r'kill_point\(\s*"([^"]+)"\s*\)')
+
+
+def _kill_point_call_sites():
+    """Every string-literal ``kill_point("...")`` call in the package,
+    ``{site: [relpath, ...]}``."""
+    import os
+
+    import spark_examples_tpu
+
+    root = os.path.dirname(os.path.abspath(spark_examples_tpu.__file__))
+    sites = {}
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for filename in filenames:
+            if not filename.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, filename)
+            rel = os.path.relpath(path, root)
+            if rel == os.path.join("utils", "faults.py"):
+                continue  # the registry itself, not a call site
+            with open(path, "r", encoding="utf-8") as handle:
+                for match in _KILL_POINT_CALL.finditer(handle.read()):
+                    sites.setdefault(match.group(1), []).append(rel)
+    return sites
+
+
+def test_kill_point_registry_matches_call_sites():
+    registry = faults.registered_kill_points()
+    sites = _kill_point_call_sites()
+    # Every call site names a registered point: an unregistered literal
+    # is a chaos window the matrix (and GP006) cannot see.
+    unregistered = sorted(set(sites) - set(registry))
+    assert unregistered == [], unregistered
+    # Every registered point is called somewhere: a dangling registry
+    # entry would let GP006 claim coverage no code provides.
+    dangling = sorted(set(registry) - set(sites))
+    assert dangling == [], dangling
+
+
+def test_kill_point_registry_locations_name_real_modules():
+    import os
+
+    import spark_examples_tpu
+
+    root = os.path.dirname(os.path.abspath(spark_examples_tpu.__file__))
+    for site, where in faults.registered_kill_points().items():
+        module = where.split(":", 1)[0].split(" ", 1)[0]
+        assert os.path.exists(os.path.join(root, module)), (site, where)

@@ -482,3 +482,56 @@ def test_gc012_exemptions():
     assert all(
         r != "GC012" for r, _ in _lint_ids(reader_src, "sources/stream.py")
     )
+
+
+def test_gc013_journal_record_dict_literal_flagged():
+    # A hand-rolled protocol record anywhere outside serve/journal.py is
+    # a finding — whatever it is assigned to or passed into: the record
+    # shapes are exactly what `graftcheck proto` proves the coordination
+    # protocol against.
+    src = """
+    def settle(journal, job_id):
+        journal.append({"event": "terminal", "id": job_id,
+                        "status": "done"})
+    """
+    assert ("GC013", 3) in _lint_ids(src, "serve/daemon.py")
+    assert ("GC013", 3) in _lint_ids(src, "pipeline/fixture.py")
+
+
+def test_gc013_every_protocol_event_name_covered():
+    for event in ("accepted", "began", "terminal", "lease"):
+        src = f"""
+        def f():
+            return {{"event": "{event}", "id": "j-1"}}
+        """
+        assert any(
+            r == "GC013" for r, _ in _lint_ids(src, "serve/daemon.py")
+        ), event
+
+
+def test_gc013_private_append_seam_flagged():
+    src = """
+    def f(journal, record):
+        journal._append(record)
+    """
+    assert ("GC013", 3) in _lint_ids(src, "serve/daemon.py")
+
+
+def test_gc013_exemptions():
+    # The journal module IS the protocol: its own constructors are the
+    # one place the record shapes may be spelled out.
+    src = """
+    def terminal_record(job_id, status):
+        return {"event": "terminal", "id": job_id, "status": status}
+    """
+    assert all(
+        r != "GC013" for r, _ in _lint_ids(src, "serve/journal.py")
+    )
+    # Non-protocol event dicts (metrics, traces) are out of scope.
+    trace_src = """
+    def f(name):
+        return {"event": "heartbeat", "name": name}
+    """
+    assert all(
+        r != "GC013" for r, _ in _lint_ids(trace_src, "serve/daemon.py")
+    )
